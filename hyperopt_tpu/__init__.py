@@ -1,0 +1,131 @@
+"""hyperopt_tpu: a TPU-native hyperparameter-optimization framework.
+
+Capabilities of the reference (``mvanveen/hyperopt``; see SURVEY.md), built
+idiomatically on JAX/XLA: the ``fmin`` driver, ``hp.*`` search-space DSL
+(including conditional ``hp.choice`` spaces), a ``Trials`` store, and the
+``suggest``-function plugin boundary -- plus jitted/vmapped TPE kernels
+(``tpe_jax``), a compiled space sampler, an on-device ``JaxTrials`` history
+and mesh-sharded candidate scoring (``hyperopt_tpu.parallel``).
+
+Quick start::
+
+    from hyperopt_tpu import fmin, hp, tpe_jax
+
+    best = fmin(lambda x: (x - 3) ** 2, hp.uniform("x", -10, 10),
+                algo=tpe_jax.suggest, max_evals=100)
+"""
+
+from . import (
+    anneal,
+    base,
+    early_stop,
+    exceptions,
+    hp,
+    mix,
+    pyll,
+    rand,
+    tpe,
+)
+from .base import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    JOB_STATES,
+    STATUS_FAIL,
+    STATUS_NEW,
+    STATUS_OK,
+    STATUS_RUNNING,
+    STATUS_STRINGS,
+    STATUS_SUSPENDED,
+    Trials,
+    trials_from_docs,
+)
+from .exceptions import (
+    AllTrialsFailed,
+    DuplicateLabel,
+    HyperoptTpuError,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+from .fmin import (
+    FMinIter,
+    fmin,
+    fmin_pass_expr_memo_ctrl,
+    generate_trials_to_calculate,
+    partial,
+    space_eval,
+)
+from .early_stop import no_progress_loss
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "__version__",
+    "anneal",
+    "base",
+    "early_stop",
+    "exceptions",
+    "fmin",
+    "FMinIter",
+    "fmin_pass_expr_memo_ctrl",
+    "generate_trials_to_calculate",
+    "hp",
+    "mix",
+    "no_progress_loss",
+    "partial",
+    "pyll",
+    "rand",
+    "space_eval",
+    "tpe",
+    "Ctrl",
+    "Domain",
+    "Trials",
+    "trials_from_docs",
+    "AllTrialsFailed",
+    "DuplicateLabel",
+    "HyperoptTpuError",
+    "InvalidLoss",
+    "InvalidResultStatus",
+    "InvalidTrial",
+    "JOB_STATES",
+    "JOB_STATE_DONE",
+    "JOB_STATE_ERROR",
+    "JOB_STATE_NEW",
+    "JOB_STATE_RUNNING",
+    "STATUS_FAIL",
+    "STATUS_NEW",
+    "STATUS_OK",
+    "STATUS_RUNNING",
+    "STATUS_STRINGS",
+    "STATUS_SUSPENDED",
+]
+
+
+def __getattr__(name):
+    # heavier JAX-facing modules load lazily so `import hyperopt_tpu` stays
+    # cheap on hosts without an accelerator
+    lazy = {
+        "tpe_jax",
+        "rand_jax",
+        "anneal_jax",
+        "jax_trials",
+        "ops",
+        "parallel",
+        "distributed",
+        "models",
+        "atpe",
+        "criteria",
+        "plotting",
+        "graphviz",
+    }
+    if name in lazy:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

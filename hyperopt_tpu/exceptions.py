@@ -1,0 +1,43 @@
+"""Exception types for hyperopt_tpu.
+
+Capability parity with the reference's ``hyperopt/exceptions.py`` (see
+SURVEY.md SS2: AllTrialsFailed, DuplicateLabel, InvalidTrial,
+InvalidResultStatus, InvalidLoss).  Reference mount was empty; spec of
+record is SURVEY.md.
+"""
+
+
+class HyperoptTpuError(Exception):
+    """Base class for all hyperopt_tpu errors."""
+
+
+class PyllImportError(HyperoptTpuError):
+    """A pyll graph references an unknown scope symbol."""
+
+
+class DuplicateLabel(HyperoptTpuError):
+    """The same hyperparameter label was used for two different nodes."""
+
+
+class InvalidTrial(HyperoptTpuError, ValueError):
+    """A trial document failed validation."""
+
+
+class InvalidResultStatus(HyperoptTpuError, ValueError):
+    """An objective returned a result dict with a bad ``status``."""
+
+
+class InvalidLoss(HyperoptTpuError, ValueError):
+    """An objective returned a loss that is not a finite float (or None)."""
+
+
+class InvalidAnnotatedParameter(HyperoptTpuError, ValueError):
+    """An ``hp.*`` call was malformed (bad label or arguments)."""
+
+
+class AllTrialsFailed(HyperoptTpuError):
+    """Every trial in the experiment errored; there is no argmin."""
+
+
+class CompileError(HyperoptTpuError):
+    """The space compiler could not lower a search space to a JAX sampler."""

@@ -1,0 +1,476 @@
+"""The optimization driver: ``fmin`` / ``FMinIter`` / ``space_eval``.
+
+Capability parity with the reference's ``hyperopt/fmin.py`` (SURVEY.md SS2,
+SS3.1): ask the algo for new trial docs at the plugin seam, enqueue,
+evaluate synchronously (``serial_evaluate``) or wait for async backends
+(``block_until_done``), apply stopping rules (max_evals / timeout /
+loss_threshold / early_stop_fn), checkpoint trials to
+``trials_save_file`` each round, and return the argmin config.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+import timeit
+
+import numpy as np
+
+from . import base, progress as progress_mod
+from .base import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    STATUS_OK,
+    Trials,
+    spec_from_misc,
+    trials_from_docs,
+)
+from .exceptions import AllTrialsFailed, InvalidAnnotatedParameter
+from .pyll.base import as_apply, rec_eval
+from .pyll_utils import expr_to_config
+from .utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "fmin",
+    "FMinIter",
+    "space_eval",
+    "generate_trials_to_calculate",
+    "fmin_pass_expr_memo_ctrl",
+    "partial",
+    "StopExperiment",
+]
+
+
+class StopExperiment:
+    """Sentinel an algo may return instead of new trials to halt fmin."""
+
+
+def fmin_pass_expr_memo_ctrl(f):
+    """Decorator: objective wants raw (expr, memo, ctrl) instead of a
+    materialized config (parity: reference ``fmin_pass_expr_memo_ctrl``)."""
+    f.fmin_pass_expr_memo_ctrl = True
+    return f
+
+
+def partial(fn, **kwargs):
+    """functools.partial that preserves algo attributes (convenience)."""
+    import functools
+
+    rval = functools.partial(fn, **kwargs)
+    functools.update_wrapper(rval, fn, updated=[])
+    return rval
+
+
+def space_eval(space, hp_assignment):
+    """Substitute {label: value} into a space -> the concrete config object
+    the objective would receive (choices resolve to their chosen option)."""
+    space = as_apply(space)
+    hps = expr_to_config(space)
+    memo = {}
+    for label, info in hps.items():
+        if label in hp_assignment:
+            memo[info.node] = hp_assignment[label]
+    return rec_eval(space, memo=memo)
+
+
+def generate_trials_to_calculate(points):
+    """Seed a Trials object with explicit configs to evaluate first.
+
+    ``points`` is a list of dicts {label: value} (choice values are
+    indices).  Parity: reference ``fmin.generate_trials_to_calculate``.
+    """
+    trials = Trials()
+    new_ids = trials.new_trial_ids(len(points))
+    miscs = [
+        {
+            "tid": tid,
+            "cmd": None,
+            "workdir": None,
+            "idxs": {key: [tid] for key in point},
+            "vals": {key: [point[key]] for key in point},
+        }
+        for tid, point in zip(new_ids, points)
+    ]
+    results = [{"status": base.STATUS_NEW} for _ in points]
+    docs = trials.new_trial_docs(new_ids, [None] * len(points), results, miscs)
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return trials
+
+
+class FMinIter:
+    """Object-based fmin: step the ask/evaluate loop explicitly."""
+
+    catch_eval_exceptions = False
+    pickle_protocol = pickle.HIGHEST_PROTOCOL
+
+    def __init__(
+        self,
+        algo,
+        domain,
+        trials,
+        rstate,
+        asynchronous=None,
+        max_queue_len=1,
+        poll_interval_secs=0.1,
+        max_evals=float("inf"),
+        timeout=None,
+        loss_threshold=None,
+        verbose=False,
+        show_progressbar=True,
+        early_stop_fn=None,
+        trials_save_file="",
+    ):
+        self.algo = algo
+        self.domain = domain
+        self.trials = trials
+        self.rstate = rstate
+        self.asynchronous = (
+            trials.asynchronous if asynchronous is None else asynchronous
+        )
+        self.max_queue_len = max_queue_len
+        self.poll_interval_secs = poll_interval_secs
+        self.max_evals = max_evals
+        self.timeout = timeout
+        self.loss_threshold = loss_threshold
+        self.start_time = timeit.default_timer()
+        self.verbose = verbose
+        self.show_progressbar = show_progressbar
+        self.early_stop_fn = early_stop_fn
+        self.early_stop_args = []
+        self.trials_save_file = trials_save_file
+
+        if self.asynchronous:
+            # async workers fetch the Domain by attachment (SURVEY.md SS3.4)
+            if "FMinIter_Domain" not in trials.attachments:
+                try:
+                    trials.attachments["FMinIter_Domain"] = pickle.dumps(
+                        domain, protocol=self.pickle_protocol
+                    )
+                except Exception:
+                    logger.warning("domain not picklable for async backend")
+
+    def _draw_seed(self):
+        # works for both np.random.Generator and legacy RandomState
+        if hasattr(self.rstate, "integers"):
+            return int(self.rstate.integers(2**31 - 1))
+        return int(self.rstate.randint(2**31 - 1))
+
+    # -- stopping rules ----------------------------------------------------
+    def _timed_out(self):
+        return (
+            self.timeout is not None
+            and timeit.default_timer() - self.start_time >= self.timeout
+        )
+
+    def _loss_reached(self):
+        if self.loss_threshold is None:
+            return False
+        try:
+            best = self.trials.best_trial["result"]["loss"]
+        except AllTrialsFailed:
+            return False
+        return best <= self.loss_threshold
+
+    def _early_stopped(self):
+        if self.early_stop_fn is None:
+            return False
+        if len(self.trials.trials) == 0:
+            return False
+        stop, kwargs = self.early_stop_fn(self.trials, *self.early_stop_args)
+        self.early_stop_args = kwargs
+        return bool(stop)
+
+    def should_stop(self):
+        return self._timed_out() or self._loss_reached() or self._early_stopped()
+
+    # -- evaluation --------------------------------------------------------
+    def serial_evaluate(self, N=-1):
+        for trial in self.trials._dynamic_trials:
+            if trial["state"] != JOB_STATE_NEW:
+                continue
+            trial["state"] = JOB_STATE_RUNNING
+            trial["book_time"] = coarse_utcnow()
+            trial["owner"] = "serial"
+            spec = spec_from_misc(trial["misc"])
+            ctrl = Ctrl(self.trials, current_trial=trial)
+            try:
+                result = self.domain.evaluate(spec, ctrl)
+            except Exception as e:
+                logger.error("job exception: %s", e)
+                trial["state"] = JOB_STATE_ERROR
+                trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["refresh_time"] = coarse_utcnow()
+                if not self.catch_eval_exceptions:
+                    self.trials.refresh()
+                    raise
+            else:
+                trial["state"] = JOB_STATE_DONE
+                trial["result"] = base.SONify(result)
+                trial["refresh_time"] = coarse_utcnow()
+            N -= 1
+            if N == 0:
+                break
+        self.trials.refresh()
+
+    def block_until_done(self):
+        unfinished_states = [JOB_STATE_NEW, JOB_STATE_RUNNING]
+
+        def get_queue_len():
+            return self.trials.count_by_state_unsynced(unfinished_states)
+
+        qlen = get_queue_len()
+        while qlen > 0:
+            if self._timed_out():
+                logger.warning("timeout while waiting on %d jobs", qlen)
+                break
+            time.sleep(self.poll_interval_secs)
+            self.trials.refresh()
+            qlen = get_queue_len()
+
+    # -- checkpoint --------------------------------------------------------
+    def _save_trials(self):
+        if self.trials_save_file:
+            with open(self.trials_save_file, "wb") as f:
+                pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+
+    # -- main loop ---------------------------------------------------------
+    def run(self, N, block_until_done=True):
+        """Enqueue and evaluate up to N new trials."""
+        trials = self.trials
+        algo = self.algo
+        n_queued = 0
+
+        def get_queue_len():
+            return trials.count_by_state_unsynced(JOB_STATE_NEW)
+
+        def get_n_done():
+            return trials.count_by_state_unsynced(JOB_STATE_DONE)
+
+        stopped = False
+        initial_n_done = get_n_done()
+        with self._progress_ctx(initial=0, total=N) as progress:
+            while n_queued < N:
+                qlen = get_queue_len()
+                while (
+                    qlen < self.max_queue_len and n_queued < N and not stopped
+                ):
+                    n_to_enqueue = min(self.max_queue_len - qlen, N - n_queued)
+                    if self.should_stop():
+                        stopped = True
+                        break
+                    new_ids = trials.new_trial_ids(n_to_enqueue)
+                    self.trials.refresh()
+                    new_trials = algo(new_ids, self.domain, trials, self._draw_seed())
+                    if new_trials is StopExperiment:
+                        stopped = True
+                        break
+                    if new_trials is None or len(new_trials) == 0:
+                        stopped = True
+                        break
+                    assert len(new_ids) >= len(new_trials)
+                    trials.insert_trial_docs(new_trials)
+                    trials.refresh()
+                    n_queued += len(new_trials)
+                    qlen = get_queue_len()
+
+                if self.asynchronous:
+                    if block_until_done:
+                        self.block_until_done()
+                    else:
+                        time.sleep(self.poll_interval_secs)
+                    trials.refresh()
+                else:
+                    self.serial_evaluate()
+
+                n_done = get_n_done()
+                n_new_done = n_done - initial_n_done
+                if n_new_done > 0:
+                    try:
+                        best_loss = trials.best_trial["result"]["loss"]
+                    except AllTrialsFailed:
+                        best_loss = None
+                    progress.update(
+                        n_done - (initial_n_done + progress_done(progress)),
+                        best_loss=best_loss,
+                    )
+                    set_progress_done(progress, n_done - initial_n_done)
+
+                self._save_trials()
+                if stopped:
+                    break
+
+    def _progress_ctx(self, initial, total):
+        if callable(self.show_progressbar) and not isinstance(
+            self.show_progressbar, bool
+        ):
+            return self.show_progressbar(initial=initial, total=total)
+        if self.show_progressbar:
+            return progress_mod.tqdm_progress_callback(initial=initial, total=total)
+        return progress_mod.no_progress_callback(initial=initial, total=total)
+
+    def exhaust(self):
+        n_done = len(self.trials)
+        self.run(self.max_evals - n_done, block_until_done=self.asynchronous)
+        self.trials.refresh()
+        return self
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self.run(1, block_until_done=self.asynchronous)
+        if len(self.trials) >= self.max_evals:
+            raise StopIteration()
+        return self.trials
+
+
+def progress_done(progress):
+    return getattr(progress, "_n_done", 0)
+
+
+def set_progress_done(progress, n):
+    progress._n_done = n
+
+
+def fmin(
+    fn,
+    space,
+    algo=None,
+    max_evals=None,
+    timeout=None,
+    loss_threshold=None,
+    trials=None,
+    rstate=None,
+    allow_trials_fmin=True,
+    pass_expr_memo_ctrl=None,
+    catch_eval_exceptions=False,
+    verbose=False,
+    return_argmin=True,
+    points_to_evaluate=None,
+    max_queue_len=1,
+    show_progressbar=True,
+    early_stop_fn=None,
+    trials_save_file="",
+):
+    """Minimize ``fn`` over ``space`` using ``algo``.
+
+    Drop-in parity with the reference ``hyperopt.fmin`` (SURVEY.md SS2 L4);
+    pass ``algo=hyperopt_tpu.tpe.suggest`` for the host parity path or
+    ``algo=hyperopt_tpu.tpe_jax.suggest`` for the jitted TPU path.
+    """
+    if algo is None:
+        from . import tpe
+
+        algo = tpe.suggest
+        logger.warning("fmin: algo not specified, defaulting to tpe.suggest")
+
+    if max_evals is None:
+        max_evals = float("inf")
+
+    if rstate is None:
+        env_rseed = os.environ.get("HYPEROPT_FMIN_SEED", "")
+        if env_rseed:
+            rstate = np.random.default_rng(int(env_rseed))
+        else:
+            rstate = np.random.default_rng()
+    elif isinstance(rstate, (int, np.integer)):
+        rstate = np.random.default_rng(int(rstate))
+
+    validate_timeout(timeout)
+    validate_loss_threshold(loss_threshold)
+
+    if trials_save_file and os.path.exists(trials_save_file):
+        with open(trials_save_file, "rb") as f:
+            trials = pickle.load(f)
+
+    if trials is None:
+        if points_to_evaluate is None:
+            trials = Trials()
+        else:
+            assert isinstance(points_to_evaluate, list)
+            trials = generate_trials_to_calculate(points_to_evaluate)
+    elif points_to_evaluate is not None and len(trials) == 0:
+        assert isinstance(points_to_evaluate, list)
+        seeded = generate_trials_to_calculate(points_to_evaluate)
+        trials._ids.update(t["tid"] for t in seeded._dynamic_trials)
+        trials._insert_trial_docs(seeded._dynamic_trials)
+        trials.refresh()
+
+    # Backends (e.g. SparkTrials) may implement their own fmin dispatch.
+    if allow_trials_fmin and hasattr(trials, "fmin") and not isinstance(
+        trials, Trials
+    ):
+        return trials.fmin(
+            fn,
+            space,
+            algo=algo,
+            max_evals=max_evals,
+            timeout=timeout,
+            loss_threshold=loss_threshold,
+            max_queue_len=max_queue_len,
+            rstate=rstate,
+            pass_expr_memo_ctrl=pass_expr_memo_ctrl,
+            verbose=verbose,
+            catch_eval_exceptions=catch_eval_exceptions,
+            return_argmin=return_argmin,
+            show_progressbar=show_progressbar,
+            early_stop_fn=early_stop_fn,
+            trials_save_file=trials_save_file,
+        )
+
+    domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
+
+    rval = FMinIter(
+        algo,
+        domain,
+        trials,
+        max_evals=max_evals,
+        timeout=timeout,
+        loss_threshold=loss_threshold,
+        rstate=rstate,
+        verbose=verbose,
+        max_queue_len=max_queue_len,
+        show_progressbar=show_progressbar,
+        early_stop_fn=early_stop_fn,
+        trials_save_file=trials_save_file,
+    )
+    rval.catch_eval_exceptions = catch_eval_exceptions
+    rval.exhaust()
+
+    if return_argmin:
+        if len(trials.trials) == 0:
+            raise InvalidAnnotatedParameter(
+                "There are no evaluation tasks, cannot return argmin of task losses."
+            )
+        return trials.argmin
+    if len(trials) > 0:
+        try:
+            return trials.best_trial["result"]["loss"]
+        except AllTrialsFailed:
+            return None
+    return None
+
+
+def validate_timeout(timeout):
+    if timeout is not None and (
+        not isinstance(timeout, (int, float)) or timeout <= 0
+    ):
+        raise Exception(
+            f"The timeout argument should be None or a positive value. Given value: {timeout}"
+        )
+
+
+def validate_loss_threshold(loss_threshold):
+    if loss_threshold is not None and not isinstance(loss_threshold, (int, float)):
+        raise Exception(
+            f"The loss_threshold argument should be None or a numeric value. Given value: {loss_threshold}"
+        )

@@ -1,0 +1,24 @@
+"""Benchmark objectives and model families.
+
+``synthetic`` -- the domain battery (quadratic1, branin, hartmann6,
+gauss_wave2, many_dists, ...) mirroring the reference's
+``tests/test_domains.py`` fixtures (SURVEY.md SS4).
+``surrogate`` -- HPOBench-style XGBoost surrogate (8-dim mixed space).
+``nasbench`` -- NAS-Bench-201-style choice-heavy architecture search.
+``resnet`` -- flax ResNet-20 with a vmapped population train step (the
+TPU flagship objective, BASELINE.json config #4).
+"""
+
+from . import synthetic
+
+__all__ = ["synthetic"]
+
+
+def __getattr__(name):
+    if name in ("surrogate", "nasbench", "resnet"):
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

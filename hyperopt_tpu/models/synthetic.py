@@ -1,0 +1,304 @@
+"""Synthetic benchmark domains (the domain battery).
+
+Mirrors the reference's shared test fixtures (``tests/test_domains.py``:
+quadratic1, q1_lognormal, q1_choice, n_arms, branin, gauss_wave2,
+many_dists -- SURVEY.md SS4): every suggest algorithm is validated by
+running fmin end-to-end on this battery against best-loss thresholds,
+not by mocking.
+
+Also provides the parametric ``mixed_space`` used by throughput benchmarks
+(BASELINE.json: 20-dim mixed continuous/categorical space).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import hp
+
+__all__ = ["SyntheticDomain", "DOMAINS", "battery", "mixed_space", "branin_fn",
+           "hartmann6_fn"]
+
+
+class SyntheticDomain:
+    """One benchmark objective: fn over a space, plus test thresholds.
+
+    ``fn`` takes the materialized config (scalar or dict, matching what the
+    space evaluates to).  ``loss_target(n)`` gives the loss a competent
+    optimizer should reach within n evaluations (used as loose test
+    thresholds, reference-style: SURVEY.md SS4 'domain battery' row).
+    """
+
+    def __init__(self, name, fn, space, global_min, targets):
+        self.name = name
+        self.fn = fn
+        self.space = space
+        self.global_min = global_min
+        self.targets = targets  # {n_evals: loss threshold}
+
+    def make_space(self):
+        return self.space()
+
+    def __repr__(self):
+        return f"SyntheticDomain({self.name})"
+
+
+# -- simple 1-D -------------------------------------------------------------
+
+
+def _quadratic1_fn(x):
+    return (x - 3.0) ** 2
+
+
+def _q1_lognormal_fn(x):
+    return max(0.0, min((x - 3.0) ** 2 / 2.0, 10.0))
+
+
+def _q1_choice_fn(cfg):
+    if cfg["case"] == 1:
+        return (cfg["x"] - 1.0) ** 2
+    return 0.5 * (cfg["x"] + 2.5) ** 2 + 0.25
+
+
+def _n_arms_fn(arm):
+    return [0.0, 0.25, 0.5, 0.75, 1.0][arm]
+
+
+# -- classic BBO ------------------------------------------------------------
+
+
+def branin_fn(cfg):
+    """Branin-Hoo; global min 0.397887 at (-pi, 12.275), (pi, 2.275),
+    (9.42478, 2.475)."""
+    x1, x2 = cfg["x1"], cfg["x2"]
+    a = 1.0
+    b = 5.1 / (4 * math.pi**2)
+    c = 5.0 / math.pi
+    r = 6.0
+    s = 10.0
+    t = 1.0 / (8 * math.pi)
+    return (
+        a * (x2 - b * x1**2 + c * x1 - r) ** 2 + s * (1 - t) * math.cos(x1) + s
+    )
+
+
+_H6_ALPHA = np.array([1.0, 1.2, 3.0, 3.2])
+_H6_A = np.array(
+    [
+        [10, 3, 17, 3.5, 1.7, 8],
+        [0.05, 10, 17, 0.1, 8, 14],
+        [3, 3.5, 1.7, 10, 17, 8],
+        [17, 8, 0.05, 10, 0.1, 14],
+    ]
+)
+_H6_P = 1e-4 * np.array(
+    [
+        [1312, 1696, 5569, 124, 8283, 5886],
+        [2329, 4135, 8307, 3736, 1004, 9991],
+        [2348, 1451, 3522, 2883, 3047, 6650],
+        [4047, 8828, 8732, 5743, 1091, 381],
+    ]
+)
+
+
+def hartmann6_fn(cfg):
+    """Hartmann-6; global min -3.32237."""
+    x = np.array([cfg[f"x{i}"] for i in range(6)])
+    inner = np.sum(_H6_A * (x - _H6_P) ** 2, axis=1)
+    return float(-np.sum(_H6_ALPHA * np.exp(-inner)))
+
+
+def _rosenbrock2_fn(cfg):
+    x, y = cfg["x"], cfg["y"]
+    return (1 - x) ** 2 + 100.0 * (y - x**2) ** 2
+
+
+# -- conditional / gnarly ---------------------------------------------------
+
+
+def _gauss_wave2_fn(cfg):
+    """Conditional objective: branch 1 can beat branch 0 only if its
+    amplitude is tuned -- exercises choice + nested continuous."""
+    x = cfg["x"]
+    base = math.exp(-((x / 10.0) ** 2))
+    if cfg["kind"] == "raw":
+        return -base
+    return -base * cfg["amp"]
+
+
+def _many_dists_fn(cfg):
+    """Smoke objective over every distribution family."""
+    t = 0.0
+    t += (cfg["a_u"] - 1.0) ** 2 / 25.0
+    t += (cfg["b_qu"] - 2.0) ** 2 / 25.0
+    t += (math.log(max(cfg["c_lu"], 1e-12)) + 1.0) ** 2 / 9.0
+    t += (cfg["d_n"] / 2.0) ** 2
+    t += (cfg["e_qn"] / 4.0) ** 2
+    t += (math.log(max(cfg["f_ln"], 1e-12)) / 2.0) ** 2
+    t += abs(cfg["g_ri"] - 3) / 10.0
+    branch = cfg["branch"]
+    if branch["which"] == 0:
+        t += (branch["inner_u"] - 0.5) ** 2
+    elif branch["which"] == 1:
+        t += 0.1 + (math.log(max(branch["inner_lu"], 1e-12)) - 0.0) ** 2 / 9.0
+    else:
+        t += 0.05 + abs(branch["inner_c"] - 1) * 0.2
+    return t
+
+
+def _space_quadratic1():
+    return hp.uniform("x", -5, 5)
+
+
+def _space_q1_lognormal():
+    return hp.lognormal("x", 0.0, 1.0)
+
+
+def _space_q1_choice():
+    return hp.choice(
+        "p",
+        [
+            {"case": 1, "x": hp.uniform("x1", -5, 5)},
+            {"case": 2, "x": hp.uniform("x2", -5, 5)},
+        ],
+    )
+
+
+def _space_n_arms():
+    return hp.choice("arm", [0, 1, 2, 3, 4])
+
+
+def _space_branin():
+    return {"x1": hp.uniform("x1", -5, 10), "x2": hp.uniform("x2", 0, 15)}
+
+
+def _space_hartmann6():
+    return {f"x{i}": hp.uniform(f"x{i}", 0, 1) for i in range(6)}
+
+
+def _space_rosenbrock2():
+    return {"x": hp.uniform("x", -2, 2), "y": hp.uniform("y", -1, 3)}
+
+
+def _space_gauss_wave2():
+    return hp.choice(
+        "curve",
+        [
+            {"kind": "raw", "x": hp.uniform("x_raw", -20, 20)},
+            {
+                "kind": "amp",
+                "x": hp.uniform("x_amp", -20, 20),
+                "amp": hp.uniform("amp", 0.5, 1.5),
+            },
+        ],
+    )
+
+
+def _space_many_dists():
+    return {
+        "a_u": hp.uniform("a_u", -5, 5),
+        "b_qu": hp.quniform("b_qu", -5, 5, 0.5),
+        "c_lu": hp.loguniform("c_lu", -4, 2),
+        "d_n": hp.normal("d_n", 0, 2),
+        "e_qn": hp.qnormal("e_qn", 0, 4, 1),
+        "f_ln": hp.lognormal("f_ln", 0, 1),
+        "g_ri": hp.randint("g_ri", 10),
+        "branch": hp.choice(
+            "branch",
+            [
+                {"which": 0, "inner_u": hp.uniform("inner_u", 0, 1)},
+                {"which": 1, "inner_lu": hp.loguniform("inner_lu", -3, 3)},
+                {"which": 2, "inner_c": hp.pchoice(
+                    "inner_c", [(0.2, 0), (0.5, 1), (0.3, 2)]
+                )},
+            ],
+        ),
+    }
+
+
+DOMAINS = {
+    d.name: d
+    for d in [
+        SyntheticDomain(
+            "quadratic1", _quadratic1_fn, _space_quadratic1, 0.0,
+            {80: 0.3},
+        ),
+        SyntheticDomain(
+            "q1_lognormal", _q1_lognormal_fn, _space_q1_lognormal, 0.0,
+            {80: 0.5},
+        ),
+        SyntheticDomain(
+            "q1_choice", _q1_choice_fn, _space_q1_choice, 0.0,
+            {80: 0.35},
+        ),
+        SyntheticDomain(
+            "n_arms", _n_arms_fn, _space_n_arms, 0.0,
+            {30: 0.0},
+        ),
+        SyntheticDomain(
+            "branin", branin_fn, _space_branin, 0.397887,
+            {100: 1.2},
+        ),
+        SyntheticDomain(
+            "hartmann6", hartmann6_fn, _space_hartmann6, -3.32237,
+            {150: -1.2},
+        ),
+        SyntheticDomain(
+            "rosenbrock2", _rosenbrock2_fn, _space_rosenbrock2, 0.0,
+            {120: 6.0},
+        ),
+        SyntheticDomain(
+            "gauss_wave2", _gauss_wave2_fn, _space_gauss_wave2, -1.5,
+            {100: -1.0},
+        ),
+        SyntheticDomain(
+            "many_dists", _many_dists_fn, _space_many_dists, 0.0,
+            {100: 1.5},
+        ),
+    ]
+}
+
+
+def battery(names=None):
+    """The canonical domain list (CasePerDomain-style reuse, SURVEY.md SS4)."""
+    if names is None:
+        return list(DOMAINS.values())
+    return [DOMAINS[n] for n in names]
+
+
+# -- throughput benchmark space --------------------------------------------
+
+
+def mixed_space(n_uniform=8, n_loguniform=4, n_quniform=2, n_randint=3, n_choice=3):
+    """A D-dim mixed continuous/categorical flat space (defaults: 20-dim,
+    the BASELINE.json throughput config)."""
+    space = {}
+    for i in range(n_uniform):
+        space[f"u{i}"] = hp.uniform(f"u{i}", -5, 5)
+    for i in range(n_loguniform):
+        space[f"lu{i}"] = hp.loguniform(f"lu{i}", -5, 2)
+    for i in range(n_quniform):
+        space[f"qu{i}"] = hp.quniform(f"qu{i}", 0, 20, 1)
+    for i in range(n_randint):
+        space[f"ri{i}"] = hp.randint(f"ri{i}", 8)
+    for i in range(n_choice):
+        space[f"ch{i}"] = hp.choice(f"ch{i}", list(range(5)))
+    return space
+
+
+def mixed_space_fn(cfg):
+    """Cheap separable loss over ``mixed_space`` (throughput benchmarking:
+    objective cost ~0 so suggest dominates)."""
+    t = 0.0
+    for k, v in cfg.items():
+        if k.startswith("u"):
+            t += (v - 1.0) ** 2 / 50.0
+        elif k.startswith("lu"):
+            t += (math.log(max(v, 1e-12))) ** 2 / 50.0
+        elif k.startswith("qu"):
+            t += abs(v - 10.0) / 100.0
+        elif k.startswith("ri") or k.startswith("ch"):
+            t += 0.02 * (v % 3)
+    return t

@@ -1,0 +1,64 @@
+"""Random-search suggest algorithm (host/numpy parity path).
+
+Capability parity with the reference's ``hyperopt/rand.py`` (SURVEY.md SS2):
+draw each new trial's config from the prior by evaluating the space with a
+seeded RNG.  The jitted TPU equivalent is :mod:`hyperopt_tpu.rand_jax`.
+"""
+
+from __future__ import annotations
+
+from .pyll.stochastic import ensure_rng
+from .vectorize import VectorizeHelper
+
+__all__ = ["suggest", "suggest_batch"]
+
+
+def _domain_helper(domain):
+    helper = getattr(domain, "_vectorize_helper", None)
+    if helper is None:
+        helper = VectorizeHelper(domain.expr)
+        domain._vectorize_helper = helper
+    return helper
+
+
+def docs_from_idxs_vals(new_ids, domain, trials, idxs, vals):
+    """Build NEW trial documents from a sparse batch encoding."""
+    labels = sorted(idxs)
+    rval_specs = []
+    rval_results = []
+    rval_miscs = []
+    for tid in new_ids:
+        misc = {
+            "tid": tid,
+            "cmd": domain.cmd,
+            "workdir": domain.workdir,
+            "idxs": {label: [] for label in labels},
+            "vals": {label: [] for label in labels},
+        }
+        rval_specs.append(None)
+        rval_results.append(domain.new_result())
+        rval_miscs.append(misc)
+    by_tid = {m["tid"]: m for m in rval_miscs}
+    for label in labels:
+        for tid, val in zip(idxs[label], vals[label]):
+            by_tid[tid]["idxs"][label] = [tid]
+            by_tid[tid]["vals"][label] = [val]
+    return trials.new_trial_docs(new_ids, rval_specs, rval_results, rval_miscs)
+
+
+def suggest_batch(new_ids, domain, trials, seed):
+    """Sparse (idxs, vals) for a batch of new trial ids."""
+    rng = ensure_rng(seed)
+    helper = _domain_helper(domain)
+    return helper.sample_batch(new_ids, rng)
+
+
+def suggest(new_ids, domain, trials, seed):
+    """The algo plugin-boundary entry point (SURVEY.md SS2 L3)."""
+    idxs, vals = suggest_batch(new_ids, domain, trials, seed)
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
+
+
+# Validation flag checked by fmin: random search explores the full prior,
+# so fmin's duplicate-coverage warning does not apply.
+suggest.is_exhaustive = False

@@ -1,0 +1,550 @@
+"""Tree-structured Parzen Estimator -- host/numpy parity path.
+
+Capability parity with the reference's ``hyperopt/tpe.py`` (SURVEY.md SS2,
+SS3.2): adaptive-Parzen 1-D GMM fitting (neighbor-difference sigmas, sigma
+clipping, prior component, linear forgetting), truncated/quantized GMM
+sampling + lpdfs (``GMM1``/``LGMM1`` families), categorical posteriors via
+weighted counts, good/bad split at ``n_below = min(ceil(gamma*sqrt(n)), LF)``
+and factorized per-hyperparameter EI argmax over ``n_EI_candidates`` draws.
+
+This numpy implementation is the *oracle*: the production TPU path
+(:mod:`hyperopt_tpu.tpe_jax`) re-derives the same math as shape-static
+vmapped JAX kernels (inverse-CDF truncation instead of rejection, masked
+padding instead of ragged obs) and is validated statistically against this
+module (SURVEY.md SS7 design stance #2).
+
+One deliberate design departure: sampling uses inverse-CDF truncation here
+too (never rejection loops), so oracle and kernel share identical
+truncation semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+from scipy.special import ndtri  # inverse normal CDF
+
+from .base import JOB_STATE_DONE, STATUS_OK
+from .pyll.base import rec_eval, scope
+from .pyll.stochastic import ensure_rng
+from .rand import docs_from_idxs_vals, _domain_helper
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "suggest",
+    "suggest_batch",
+    "adaptive_parzen_normal",
+    "adaptive_parzen_normal_orig",
+    "linear_forgetting_weights",
+    "normal_cdf",
+    "GMM1",
+    "GMM1_lpdf",
+    "LGMM1",
+    "LGMM1_lpdf",
+    "ap_split_trials",
+    "ap_filter_trials",
+    "broadcast_best",
+    "adaptive_parzen_samplers",
+]
+
+# -- defaults (reference tpe.py module constants, SURVEY.md SS2) -----------
+_default_prior_weight = 1.0
+_default_n_EI_candidates = 24
+_default_gamma = 0.25
+_default_n_startup_jobs = 20
+_default_linear_forgetting = 25
+
+EPS = 1e-12
+
+
+# ---------------------------------------------------------------------------
+# weights / parzen fitting
+# ---------------------------------------------------------------------------
+
+
+def linear_forgetting_weights(N, LF):
+    """Weights over N time-ordered observations: newest LF get weight 1,
+    older ones ramp linearly down toward 1/N (oldest first in the array)."""
+    if N == 0:
+        return np.asarray([], dtype=float)
+    if N < LF:
+        return np.ones(N)
+    ramp = np.linspace(1.0 / N, 1.0, num=N - LF)
+    flat = np.ones(LF)
+    return np.concatenate([ramp, flat])
+
+
+def adaptive_parzen_normal(mus, prior_weight, prior_mu, prior_sigma, LF=None):
+    """Fit a 1-D GMM over observed values ``mus`` (time order).
+
+    Components: one per observation plus a prior component at
+    ``(prior_mu, prior_sigma)`` inserted in sorted position.  Sigmas are
+    neighbor differences (max of left/right gap), clipped to
+    ``[prior_sigma / min(100, 1 + n), prior_sigma]``.  Weights carry linear
+    forgetting beyond ``LF`` observations; the prior gets ``prior_weight``.
+
+    Returns (weights, mus, sigmas) sorted by mu, weights normalized.
+    """
+    if LF is None:
+        LF = _default_linear_forgetting
+    mus = np.asarray(mus, dtype=float)
+    n = len(mus)
+
+    if n == 0:
+        srtd_mus = np.asarray([prior_mu], dtype=float)
+        sigma = np.asarray([prior_sigma], dtype=float)
+        prior_pos = 0
+        srtd_weights = np.asarray([1.0])
+    else:
+        order = np.argsort(mus)
+        prior_pos = int(np.searchsorted(mus[order], prior_mu))
+        srtd_mus = np.insert(mus[order], prior_pos, prior_mu)
+        m = len(srtd_mus)
+        sigma = np.zeros(m)
+        if m == 1:
+            sigma[:] = prior_sigma
+        elif m == 2:
+            gap = abs(srtd_mus[1] - srtd_mus[0])
+            sigma[:] = np.maximum(gap, EPS)
+        else:
+            left_gap = srtd_mus[1:-1] - srtd_mus[:-2]
+            right_gap = srtd_mus[2:] - srtd_mus[1:-1]
+            sigma[1:-1] = np.maximum(left_gap, right_gap)
+            sigma[0] = srtd_mus[1] - srtd_mus[0]
+            sigma[-1] = srtd_mus[-1] - srtd_mus[-2]
+        # clip, then pin the prior component's sigma
+        maxsigma = prior_sigma
+        minsigma = prior_sigma / min(100.0, 1.0 + n)
+        sigma = np.clip(sigma, minsigma, maxsigma)
+        sigma[prior_pos] = prior_sigma
+
+        if LF and LF < n:
+            unsrtd_weights = linear_forgetting_weights(n, LF)
+        else:
+            unsrtd_weights = np.ones(n)
+        srtd_weights = np.insert(unsrtd_weights[order], prior_pos, prior_weight)
+
+    srtd_weights = srtd_weights / srtd_weights.sum()
+    return srtd_weights, srtd_mus, sigma
+
+
+def adaptive_parzen_normal_orig(mus, prior_weight, prior_mu, prior_sigma):
+    """Variant without linear forgetting (parity with the reference's
+    ``adaptive_parzen_normal_orig``)."""
+    return adaptive_parzen_normal(mus, prior_weight, prior_mu, prior_sigma, LF=0)
+
+
+# ---------------------------------------------------------------------------
+# normal helpers
+# ---------------------------------------------------------------------------
+
+_SQRT2 = np.sqrt(2.0)
+
+
+def normal_cdf(x, mu, sigma):
+    from scipy.special import erf
+
+    z = (np.asarray(x, dtype=float) - mu) / (np.maximum(sigma, EPS) * _SQRT2)
+    return 0.5 * (1.0 + erf(z))
+
+
+def _normal_logpdf(x, mu, sigma):
+    sigma = np.maximum(sigma, EPS)
+    z = (x - mu) / sigma
+    return -0.5 * z * z - np.log(sigma) - 0.5 * np.log(2 * np.pi)
+
+
+def _logsumexp(a, axis=None):
+    amax = np.max(a, axis=axis, keepdims=True)
+    amax = np.where(np.isfinite(amax), amax, 0.0)
+    out = np.log(np.sum(np.exp(a - amax), axis=axis)) + np.squeeze(amax, axis=axis)
+    return out
+
+
+def _trunc_normal_sample(rng, mu, sigma, low, high, size):
+    """Truncated normal via inverse CDF -- rejection-free by design
+    (SURVEY.md SS7 hard-parts list)."""
+    mu = np.broadcast_to(mu, size).astype(float)
+    sigma = np.maximum(np.broadcast_to(sigma, size).astype(float), EPS)
+    if low is None and high is None:
+        return rng.normal(mu, sigma)
+    a = 0.0 if low is None else normal_cdf(low, mu, sigma)
+    b = 1.0 if high is None else normal_cdf(high, mu, sigma)
+    u = rng.uniform(size=size)
+    p = np.clip(a + u * (b - a), EPS, 1 - EPS)
+    x = mu + sigma * ndtri(p)
+    if low is not None:
+        x = np.maximum(x, low)
+    if high is not None:
+        x = np.minimum(x, high)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# GMM sample / lpdf ops (registered into the pyll scope for parity)
+# ---------------------------------------------------------------------------
+
+
+@scope.define
+def GMM1(weights, mus, sigmas, low=None, high=None, q=None, rng=None, size=()):
+    """Sample from a (truncated, optionally quantized) 1-D GMM."""
+    rng = ensure_rng(rng)
+    weights = np.asarray(weights, dtype=float)
+    mus = np.asarray(mus, dtype=float)
+    sigmas = np.asarray(sigmas, dtype=float)
+    size = (size,) if isinstance(size, (int, np.integer)) else tuple(size)
+    n = int(np.prod(size)) if size else 1
+
+    ks = rng.choice(len(weights), size=n, p=weights / weights.sum())
+    draws = _trunc_normal_sample(rng, mus[ks], sigmas[ks], low, high, (n,))
+    if q is not None:
+        draws = np.round(draws / q) * q
+        if low is not None:
+            draws = np.maximum(draws, np.round(low / q) * q)
+        if high is not None:
+            draws = np.minimum(draws, np.round(high / q) * q)
+    if not size:
+        return float(draws[0])
+    return draws.reshape(size)
+
+
+@scope.define
+def GMM1_lpdf(samples, weights, mus, sigmas, low=None, high=None, q=None):
+    """log-density of ``samples`` under a truncated/quantized 1-D GMM."""
+    samples = np.asarray(samples, dtype=float)
+    weights = np.asarray(weights, dtype=float)
+    mus = np.asarray(mus, dtype=float)
+    sigmas = np.maximum(np.asarray(sigmas, dtype=float), EPS)
+    x = samples.reshape(-1, 1)  # [S, 1] vs components [K]
+
+    # per-component truncation mass
+    a = normal_cdf(low, mus, sigmas) if low is not None else 0.0
+    b = normal_cdf(high, mus, sigmas) if high is not None else 1.0
+    log_mass = np.log(np.maximum(b - a, EPS))
+    logw = np.log(np.maximum(weights / weights.sum(), EPS))
+
+    if q is None:
+        ll = logw + _normal_logpdf(x, mus, sigmas) - log_mass
+    else:
+        ub = x + q / 2.0
+        lb = x - q / 2.0
+        if low is not None:
+            lb = np.maximum(lb, low)
+        if high is not None:
+            ub = np.minimum(ub, high)
+        bin_mass = normal_cdf(ub, mus, sigmas) - normal_cdf(lb, mus, sigmas)
+        ll = logw + np.log(np.maximum(bin_mass, EPS)) - log_mass
+    rval = _logsumexp(ll, axis=1)
+    return rval.reshape(samples.shape)
+
+
+@scope.define
+def LGMM1(weights, mus, sigmas, low=None, high=None, q=None, rng=None, size=()):
+    """Sample from a lognormal mixture: ``exp(GMM1-in-log-space)``.
+
+    ``low``/``high`` are bounds in *log* space (matching the reference's
+    use for ``loguniform`` priors, SURVEY.md SS2 TPE row (b)).
+    """
+    rng = ensure_rng(rng)
+    weights = np.asarray(weights, dtype=float)
+    mus = np.asarray(mus, dtype=float)
+    sigmas = np.asarray(sigmas, dtype=float)
+    size = (size,) if isinstance(size, (int, np.integer)) else tuple(size)
+    n = int(np.prod(size)) if size else 1
+
+    ks = rng.choice(len(weights), size=n, p=weights / weights.sum())
+    draws = np.exp(_trunc_normal_sample(rng, mus[ks], sigmas[ks], low, high, (n,)))
+    if q is not None:
+        draws = np.maximum(np.round(draws / q) * q, q)
+    if not size:
+        return float(draws[0])
+    return draws.reshape(size)
+
+
+@scope.define
+def LGMM1_lpdf(samples, weights, mus, sigmas, low=None, high=None, q=None):
+    """log-density under a (truncated in log space, optionally quantized)
+    lognormal mixture; ``samples`` are in natural space."""
+    samples = np.asarray(samples, dtype=float)
+    weights = np.asarray(weights, dtype=float)
+    mus = np.asarray(mus, dtype=float)
+    sigmas = np.maximum(np.asarray(sigmas, dtype=float), EPS)
+    x = samples.reshape(-1, 1)
+
+    a = normal_cdf(low, mus, sigmas) if low is not None else 0.0
+    b = normal_cdf(high, mus, sigmas) if high is not None else 1.0
+    log_mass = np.log(np.maximum(b - a, EPS))
+    logw = np.log(np.maximum(weights / weights.sum(), EPS))
+
+    if q is None:
+        logx = np.log(np.maximum(x, EPS))
+        ll = logw + _normal_logpdf(logx, mus, sigmas) - logx - log_mass
+    else:
+        ub = np.log(np.maximum(x + q / 2.0, EPS))
+        lb = np.log(np.maximum(x - q / 2.0, EPS))
+        if low is not None:
+            lb = np.maximum(lb, low)
+        if high is not None:
+            ub = np.minimum(ub, high)
+        bin_mass = normal_cdf(ub, mus, sigmas) - normal_cdf(lb, mus, sigmas)
+        ll = logw + np.log(np.maximum(bin_mass, EPS)) - log_mass
+    rval = _logsumexp(ll, axis=1)
+    return rval.reshape(samples.shape)
+
+
+def broadcast_best(samples, ll_below, ll_above):
+    """Factorized EI argmax: pick the candidate maximizing
+    ``log l(x) - log g(x)`` (independently per hyperparameter)."""
+    samples = np.asarray(samples)
+    score = np.asarray(ll_below) - np.asarray(ll_above)
+    return samples[int(np.argmax(score))]
+
+
+# ---------------------------------------------------------------------------
+# categorical posterior
+# ---------------------------------------------------------------------------
+
+
+def categorical_posterior(obs, prior_p, prior_weight, LF):
+    """Posterior pmf over categories from weighted counts + prior
+    pseudocounts (parity: reference ``ap_categorical_sampler``)."""
+    prior_p = np.asarray(prior_p, dtype=float)
+    n_options = len(prior_p)
+    obs = np.asarray(obs, dtype=int)
+    w = linear_forgetting_weights(len(obs), LF)
+    counts = np.bincount(obs, weights=w, minlength=n_options)
+    pseudocounts = counts + prior_weight * prior_p * n_options
+    return pseudocounts / pseudocounts.sum()
+
+
+# ---------------------------------------------------------------------------
+# per-distribution posterior draw (the factorized TPE inner step)
+# ---------------------------------------------------------------------------
+
+
+def _prior_gmm_params(info):
+    """Map a ParamInfo to (prior_mu, prior_sigma, low, high, logspace, q)."""
+    p = info.params
+    d = info.dist
+    if d in ("uniform", "quniform"):
+        low, high = float(p["low"]), float(p["high"])
+        return 0.5 * (low + high), high - low, low, high, False, p.get("q")
+    if d in ("loguniform", "qloguniform"):
+        low, high = float(p["low"]), float(p["high"])
+        return 0.5 * (low + high), high - low, low, high, True, p.get("q")
+    if d in ("normal", "qnormal"):
+        return float(p["mu"]), float(p["sigma"]), None, None, False, p.get("q")
+    if d in ("lognormal", "qlognormal"):
+        return float(p["mu"]), float(p["sigma"]), None, None, True, p.get("q")
+    raise NotImplementedError(d)
+
+
+def posterior_draw(info, obs_below, obs_above, rng, prior_weight, n_EI_candidates, LF):
+    """Draw the EI-argmax value for one hyperparameter."""
+    d = info.dist
+    p = info.params
+
+    if d in ("randint", "categorical", "randint_via_categorical"):
+        if d == "randint":
+            low = int(p["low"])
+            n_options = int(p["high"]) - low
+            prior_p = np.full(n_options, 1.0 / n_options)
+            ob = np.asarray(obs_below, dtype=int) - low
+            oa = np.asarray(obs_above, dtype=int) - low
+        else:
+            low = 0
+            prior_p = np.asarray(p["p"], dtype=float)
+            ob = np.asarray(obs_below, dtype=int)
+            oa = np.asarray(obs_above, dtype=int)
+        p_below = categorical_posterior(ob, prior_p, prior_weight, LF)
+        p_above = categorical_posterior(oa, prior_p, prior_weight, LF)
+        candidates = rng.choice(len(prior_p), size=n_EI_candidates, p=p_below)
+        llr = np.log(p_below[candidates]) - np.log(p_above[candidates])
+        return int(candidates[int(np.argmax(llr))]) + low
+
+    prior_mu, prior_sigma, low, high, logspace, q = _prior_gmm_params(info)
+    q = None if q is None else float(q)
+    obs_below = np.asarray(obs_below, dtype=float)
+    obs_above = np.asarray(obs_above, dtype=float)
+    if logspace:
+        fit_below = np.log(np.maximum(obs_below, EPS)) if len(obs_below) else obs_below
+        fit_above = np.log(np.maximum(obs_above, EPS)) if len(obs_above) else obs_above
+    else:
+        fit_below, fit_above = obs_below, obs_above
+
+    wb, mb, sb = adaptive_parzen_normal(fit_below, prior_weight, prior_mu, prior_sigma, LF)
+    wa, ma, sa = adaptive_parzen_normal(fit_above, prior_weight, prior_mu, prior_sigma, LF)
+
+    if logspace:
+        samples = LGMM1(wb, mb, sb, low=low, high=high, q=q, rng=rng,
+                        size=(n_EI_candidates,))
+        ll_below = LGMM1_lpdf(samples, wb, mb, sb, low=low, high=high, q=q)
+        ll_above = LGMM1_lpdf(samples, wa, ma, sa, low=low, high=high, q=q)
+    else:
+        samples = GMM1(wb, mb, sb, low=low, high=high, q=q, rng=rng,
+                       size=(n_EI_candidates,))
+        ll_below = GMM1_lpdf(samples, wb, mb, sb, low=low, high=high, q=q)
+        ll_above = GMM1_lpdf(samples, wa, ma, sa, low=low, high=high, q=q)
+    return float(broadcast_best(samples, ll_below, ll_above))
+
+
+# Registry {dist name -> posterior draw}: the plugin surface the reference
+# exposes as ``adaptive_parzen_samplers`` (SURVEY.md SS2 TPE row).
+adaptive_parzen_samplers = {
+    name: posterior_draw
+    for name in (
+        "uniform", "quniform", "loguniform", "qloguniform",
+        "normal", "qnormal", "lognormal", "qlognormal",
+        "randint", "categorical", "randint_via_categorical",
+    )
+}
+
+
+# ---------------------------------------------------------------------------
+# good/bad split
+# ---------------------------------------------------------------------------
+
+
+def ap_filter_trials(trials, gamma, LF):
+    """Completed ok-trials sorted by (loss, tid) -> (below_docs, above_docs).
+
+    ``n_below = min(ceil(gamma * sqrt(n)), LF)`` (SURVEY.md SS3.2).
+    """
+    ok = [
+        t
+        for t in trials.trials
+        if t["state"] == JOB_STATE_DONE
+        and t["result"].get("status") == STATUS_OK
+        and t["result"].get("loss") is not None
+        and np.isfinite(float(t["result"]["loss"]))
+    ]
+    ok.sort(key=lambda t: (float(t["result"]["loss"]), t["tid"]))
+    n_below = min(int(np.ceil(gamma * np.sqrt(len(ok)))), LF)
+    below = ok[:n_below]
+    above = ok[n_below:]
+    # time order within each side (parzen weights are time-indexed)
+    below.sort(key=lambda t: t["tid"])
+    above.sort(key=lambda t: t["tid"])
+    return below, above
+
+
+ap_split_trials = ap_filter_trials  # reference exposes both names
+
+
+def _obs_by_label(docs, labels):
+    obs = {label: [] for label in labels}
+    for t in docs:
+        vals = t["misc"]["vals"]
+        for label in labels:
+            v = vals.get(label, [])
+            if len(v) == 1:
+                obs[label].append(v[0])
+    return obs
+
+
+# ---------------------------------------------------------------------------
+# suggest
+# ---------------------------------------------------------------------------
+
+
+def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, LF):
+    """One new config: posterior EI-argmax per hyperparameter, activity
+    routed through the space graph (factorized TPE, SURVEY.md SS3.2)."""
+    helper = _domain_helper(domain)
+    hps = helper.hps
+    labels = sorted(hps)
+
+    below, above = ap_filter_trials(trials, gamma, LF)
+    obs_below = _obs_by_label(below, labels)
+    obs_above = _obs_by_label(above, labels)
+
+    draws = {}
+    for label in labels:
+        draws[label] = posterior_draw(
+            hps[label],
+            obs_below[label],
+            obs_above[label],
+            rng,
+            prior_weight,
+            n_EI_candidates,
+            LF,
+        )
+
+    # materialize activity: only labels on the chosen branches count
+    memo = {info.node: draws[label] for label, info in hps.items()}
+    active = {}
+
+    def observer(node, value):
+        if node.name == "hyperopt_param":
+            active[node.pos_args[0].obj] = value
+
+    rec_eval(domain.expr, memo=memo, observer=observer)
+    return active
+
+
+def suggest_batch(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+):
+    """Sparse (idxs, vals) for a batch of new ids via TPE."""
+    rng = ensure_rng(seed)
+    helper = _domain_helper(domain)
+    labels = sorted(helper.hps)
+    idxs = {label: [] for label in labels}
+    vals = {label: [] for label in labels}
+
+    n_ok = len(
+        [
+            t
+            for t in trials.trials
+            if t["state"] == JOB_STATE_DONE and t["result"].get("status") == STATUS_OK
+        ]
+    )
+    for tid in new_ids:
+        if n_ok < n_startup_jobs:
+            config = helper.sample_one(rng)
+        else:
+            config = _suggest_config(
+                domain, trials, rng, prior_weight, n_EI_candidates, gamma,
+                linear_forgetting,
+            )
+        for label, value in config.items():
+            idxs[label].append(tid)
+            vals[label].append(value)
+    return idxs, vals
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    verbose=True,
+):
+    """The algo plugin-boundary entry point: ``algo=tpe.suggest``."""
+    idxs, vals = suggest_batch(
+        new_ids,
+        domain,
+        trials,
+        seed,
+        prior_weight=prior_weight,
+        n_startup_jobs=n_startup_jobs,
+        n_EI_candidates=n_EI_candidates,
+        gamma=gamma,
+        linear_forgetting=linear_forgetting,
+    )
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

@@ -1,0 +1,105 @@
+"""Small shared helpers (parity: reference ``hyperopt/utils.py``, SURVEY.md SS2)."""
+
+from __future__ import annotations
+
+import datetime
+import os
+import tempfile
+
+import numpy as np
+
+__all__ = [
+    "coarse_utcnow",
+    "fast_isin",
+    "get_most_recent_inds",
+    "temp_dir",
+    "working_dir",
+    "path_split_all",
+    "get_closest_dir",
+]
+
+
+def coarse_utcnow():
+    """UTC now, truncated to milliseconds (stable across (de)serialization)."""
+    now = datetime.datetime.now(datetime.timezone.utc).replace(tzinfo=None)
+    return now.replace(microsecond=(now.microsecond // 1000) * 1000)
+
+
+def fast_isin(X, Y):
+    """Boolean mask: which elements of X are in (sorted or unsorted) Y."""
+    X = np.asarray(X)
+    Y = np.asarray(Y)
+    if Y.size == 0:
+        return np.zeros(len(X), dtype=bool)
+    return np.isin(X, Y)
+
+
+def get_most_recent_inds(obj):
+    """Indices of docs that are the latest version per ``_id``.
+
+    ``obj`` is a list of dicts with ``_id`` and ``version`` keys.
+    """
+    ids = np.array([o["_id"] for o in obj])
+    versions = np.array([o.get("version", 0) for o in obj])
+    order = np.lexsort((versions, ids))
+    ids_sorted = ids[order]
+    last_of_id = np.ones(len(ids), dtype=bool)
+    last_of_id[:-1] = ids_sorted[1:] != ids_sorted[:-1]
+    return np.sort(order[last_of_id])
+
+
+class temp_dir:
+    """Context manager: mkdir (tempfile if needed), yield path, keep dir."""
+
+    def __init__(self, suffix=""):
+        self.suffix = suffix
+
+    def __enter__(self):
+        self.path = tempfile.mkdtemp(suffix=self.suffix)
+        return self.path
+
+    def __exit__(self, *exc):
+        return False
+
+
+class working_dir:
+    """Context manager: chdir into ``path`` (creating it), restore on exit."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def __enter__(self):
+        os.makedirs(self.path, exist_ok=True)
+        self._prev = os.getcwd()
+        os.chdir(self.path)
+        return self.path
+
+    def __exit__(self, *exc):
+        os.chdir(self._prev)
+        return False
+
+
+def path_split_all(path):
+    """Split a path into all of its components."""
+    parts = []
+    while True:
+        path, tail = os.path.split(path)
+        if tail:
+            parts.append(tail)
+        else:
+            if path:
+                parts.append(path)
+            break
+    return list(reversed(parts))
+
+
+def get_closest_dir(workdir):
+    """Deepest existing ancestor of ``workdir`` plus the first missing part."""
+    closest_dir = ""
+    for part in path_split_all(workdir):
+        candidate = os.path.join(closest_dir, part) if closest_dir else part
+        if os.path.isdir(candidate):
+            closest_dir = candidate
+        else:
+            return closest_dir, part
+    return closest_dir, ""

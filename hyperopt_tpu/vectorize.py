@@ -1,0 +1,155 @@
+"""Batch sampling of search spaces -> sparse ``idxs/vals`` encoding.
+
+Capability parity with the reference's ``hyperopt/vectorize.py``
+(SURVEY.md SS2): ``VectorizeHelper`` turns one space into a sampler that
+draws values for a *batch* of trial ids, emitting ``{label: [tids]}`` /
+``{label: [values]}`` where a trial only appears under labels active on its
+``hp.choice`` branch (SURVEY.md SS3.3).
+
+Design departure from the reference (SURVEY.md SS7 stance #1): instead of
+rewriting the graph into a vectorized pyll program, the host path evaluates
+the space once per trial id with lazy ``switch`` (only active params are
+drawn) and an observer recording labeled draws.  The *fast* batch sampler
+is not here at all -- :mod:`hyperopt_tpu.ops.compile` lowers the space to a
+single jitted JAX program emitting dense ``[n]`` arrays + active-masks, and
+:func:`dense_to_idxs_vals` converts back to this sparse encoding at the API
+boundary.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .pyll.base import Literal, as_apply, clone, dfs, rec_eval
+from .pyll.stochastic import STOCHASTIC_NAMES, ensure_rng
+from .pyll_utils import expr_to_config
+
+__all__ = [
+    "VectorizeHelper",
+    "pretty_names",
+    "sample_config",
+    "dense_to_idxs_vals",
+    "idxs_vals_to_dense",
+]
+
+
+class VectorizeHelper:
+    """Samples a batch of trials from an hp-annotated space.
+
+    ``idxs_by_label()`` / ``vals_by_label()`` return the sparse encoding of
+    the most recent batch (names kept for reference-API familiarity).
+    """
+
+    def __init__(self, expr, s_new_ids=None):
+        self.expr = as_apply(expr)
+        self.s_new_ids = s_new_ids
+        self.hps = expr_to_config(self.expr)
+        self.labels = sorted(self.hps)
+
+        # Clone once; per-trial RNG is injected by swapping one Literal's
+        # payload (avoids re-cloning the graph every draw).
+        self._rng_literal = Literal(None)
+        self._sampling_expr = clone(self.expr)
+        for node in dfs(self._sampling_expr):
+            if node.name in STOCHASTIC_NAMES:
+                named = dict(node.named_args)
+                if "rng" not in named:
+                    node.named_args.append(("rng", self._rng_literal))
+                    node.named_args.sort()
+        self._last_idxs = None
+        self._last_vals = None
+
+    def sample_one(self, rng):
+        """Draw one trial's config; returns {label: raw value} for the
+        *active* labels only."""
+        rng = ensure_rng(rng)
+        self._rng_literal._obj = rng
+        vals = {}
+
+        def observer(node, value):
+            if node.name == "hyperopt_param":
+                label = node.pos_args[0].obj
+                vals[label] = value
+
+        rec_eval(self._sampling_expr, observer=observer)
+        return vals
+
+    def sample_batch(self, new_ids, rng):
+        """Draw one config per trial id -> sparse (idxs, vals) dicts."""
+        rng = ensure_rng(rng)
+        idxs = {label: [] for label in self.labels}
+        vals = {label: [] for label in self.labels}
+        for tid in new_ids:
+            config = self.sample_one(rng)
+            for label, value in config.items():
+                idxs[label].append(tid)
+                vals[label].append(value)
+        self._last_idxs, self._last_vals = idxs, vals
+        return idxs, vals
+
+    def idxs_by_label(self):
+        if self._last_idxs is None:
+            raise RuntimeError("no batch sampled yet")
+        return self._last_idxs
+
+    def vals_by_label(self):
+        if self._last_vals is None:
+            raise RuntimeError("no batch sampled yet")
+        return self._last_vals
+
+
+def sample_config(expr, rng):
+    """One-shot convenience: {label: value} for one draw of ``expr``."""
+    return VectorizeHelper(expr).sample_one(rng)
+
+
+def pretty_names(expr, prefix=None):
+    """{node: dotted-name} map for labeled params (diagnostic aid; parity
+    with reference ``vectorize.pretty_names``)."""
+    hps = expr_to_config(as_apply(expr))
+    rval = {}
+    for label, info in sorted(hps.items()):
+        name = label if prefix is None else f"{prefix}.{label}"
+        rval[info.node] = name
+    return rval
+
+
+# ---------------------------------------------------------------------------
+# dense <-> sparse bridges (used by the JAX samplers at the API boundary)
+# ---------------------------------------------------------------------------
+
+
+def dense_to_idxs_vals(new_ids, labels, values, active):
+    """Convert dense per-label arrays + active-mask to sparse idxs/vals.
+
+    Args:
+      new_ids: sequence of trial ids, length n.
+      labels: list of D label strings.
+      values: [D, n] array-like of drawn values (garbage where inactive).
+      active: [D, n] boolean mask.
+    """
+    idxs = {}
+    vals = {}
+    new_ids = list(new_ids)
+    for d, label in enumerate(labels):
+        mask = np.asarray(active[d])
+        row = np.asarray(values[d])
+        idxs[label] = [tid for tid, m in zip(new_ids, mask) if m]
+        vals[label] = [row[i].item() for i, m in enumerate(mask) if m]
+    return idxs, vals
+
+
+def idxs_vals_to_dense(tids, labels, idxs, vals, fill=0.0):
+    """Convert sparse idxs/vals to dense [D, n] values + active mask."""
+    tid_pos = {tid: i for i, tid in enumerate(tids)}
+    n = len(tids)
+    D = len(labels)
+    values = np.full((D, n), fill, dtype=np.float64)
+    active = np.zeros((D, n), dtype=bool)
+    for d, label in enumerate(labels):
+        for tid, v in zip(idxs.get(label, []), vals.get(label, [])):
+            if tid in tid_pos:
+                i = tid_pos[tid]
+                values[d, i] = v
+                active[d, i] = True
+    return values, active

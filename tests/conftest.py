@@ -1,0 +1,17 @@
+"""Test configuration: force JAX onto CPU with 8 virtual devices.
+
+SURVEY.md SS4: multi-device behavior is tested the way the reference tests
+multi-node -- by running the real thing small.  An 8-device host-platform
+mesh stands in for a TPU pod slice; sharding/collective tests in
+``test_sharding.py`` require it.  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        _xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

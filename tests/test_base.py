@@ -1,0 +1,224 @@
+"""Unit tests for Trials / Domain / miscs helpers (reference:
+tests/test_base.py + test_trials.py, SURVEY.md SS4)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import (
+    Ctrl,
+    Domain,
+    JOB_STATE_DONE,
+    JOB_STATE_NEW,
+    STATUS_OK,
+    STATUS_FAIL,
+    Trials,
+    hp,
+    trials_from_docs,
+)
+from hyperopt_tpu.base import (
+    SONify,
+    miscs_to_idxs_vals,
+    miscs_update_idxs_vals,
+    spec_from_misc,
+)
+from hyperopt_tpu.exceptions import (
+    AllTrialsFailed,
+    DuplicateLabel,
+    InvalidLoss,
+    InvalidResultStatus,
+    InvalidTrial,
+)
+
+
+def make_doc(trials, tid, loss, state=JOB_STATE_DONE, status=STATUS_OK, label="x"):
+    misc = {"tid": tid, "cmd": None, "idxs": {label: [tid]}, "vals": {label: [0.5]}}
+    (doc,) = trials.new_trial_docs(
+        [tid], [None], [{"status": status, "loss": loss}], [misc]
+    )
+    doc["state"] = state
+    return doc
+
+
+def test_insert_and_query():
+    trials = Trials()
+    docs = [make_doc(trials, tid, loss) for tid, loss in zip(range(3), [3.0, 1.0, 2.0])]
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    assert len(trials) == 3
+    assert trials.losses() == [3.0, 1.0, 2.0]
+    assert trials.statuses() == [STATUS_OK] * 3
+    assert trials.best_trial["tid"] == 1
+    assert trials.argmin == {"x": 0.5}
+    assert trials.tids == [0, 1, 2]
+
+
+def test_new_trial_ids_monotonic():
+    trials = Trials()
+    a = trials.new_trial_ids(3)
+    b = trials.new_trial_ids(2)
+    assert a == [0, 1, 2]
+    assert b == [3, 4]
+
+
+def test_validation_rejects_garbage():
+    trials = Trials()
+    with pytest.raises(InvalidTrial):
+        trials.insert_trial_doc({"tid": 0})
+    with pytest.raises(InvalidTrial):
+        trials.insert_trial_doc("not-a-dict")
+
+
+def test_validation_tid_mismatch():
+    trials = Trials()
+    doc = make_doc(trials, 0, 1.0)
+    doc["misc"]["tid"] = 99
+    with pytest.raises(InvalidTrial):
+        trials.insert_trial_doc(doc)
+
+
+def test_all_trials_failed():
+    trials = Trials()
+    doc = make_doc(trials, 0, None, status=STATUS_FAIL)
+    doc["result"] = {"status": STATUS_FAIL}
+    trials.insert_trial_docs([doc])
+    trials.refresh()
+    with pytest.raises(AllTrialsFailed):
+        trials.best_trial
+
+
+def test_exp_key_filtering():
+    trials = Trials(exp_key="A")
+    doc = make_doc(trials, 0, 1.0)
+    doc["exp_key"] = "A"
+    other = make_doc(trials, 1, 2.0)
+    other["exp_key"] = "B"
+    trials._insert_trial_docs([doc, other])
+    trials.refresh()
+    assert len(trials) == 1
+    view = trials.view(exp_key="B")
+    assert len(view) == 1
+    view_all = trials.view(exp_key=None)
+    assert len(view_all) == 2
+
+
+def test_count_by_state():
+    trials = Trials()
+    d0 = make_doc(trials, 0, 1.0, state=JOB_STATE_NEW)
+    d1 = make_doc(trials, 1, 2.0, state=JOB_STATE_DONE)
+    trials.insert_trial_docs([d0, d1])
+    trials.refresh()
+    assert trials.count_by_state_synced(JOB_STATE_NEW) == 1
+    assert trials.count_by_state_unsynced([JOB_STATE_NEW, JOB_STATE_DONE]) == 2
+
+
+def test_trials_from_docs_roundtrip():
+    trials = Trials()
+    docs = [make_doc(trials, tid, float(tid)) for tid in range(3)]
+    trials2 = trials_from_docs(docs)
+    assert len(trials2) == 3
+    assert trials2.argmin == {"x": 0.5}
+
+
+def test_miscs_to_idxs_vals_roundtrip():
+    miscs = [
+        {"tid": 0, "cmd": None, "idxs": {"x": [0], "y": []}, "vals": {"x": [1.5], "y": []}},
+        {"tid": 1, "cmd": None, "idxs": {"x": [1], "y": [1]}, "vals": {"x": [2.5], "y": [7]}},
+    ]
+    idxs, vals = miscs_to_idxs_vals(miscs)
+    assert idxs == {"x": [0, 1], "y": [1]}
+    assert vals == {"x": [1.5, 2.5], "y": [7]}
+    # scatter back
+    blank = [
+        {"tid": 0, "cmd": None, "idxs": {}, "vals": {}},
+        {"tid": 1, "cmd": None, "idxs": {}, "vals": {}},
+    ]
+    miscs_update_idxs_vals(blank, idxs, vals)
+    assert blank[0]["vals"] == {"x": [1.5], "y": []}
+    assert blank[1]["vals"] == {"x": [2.5], "y": [7]}
+
+
+def test_spec_from_misc():
+    misc = {"tid": 0, "cmd": None, "idxs": {"x": [0], "y": []}, "vals": {"x": [4.0], "y": []}}
+    assert spec_from_misc(misc) == {"x": 4.0}
+
+
+def test_sonify():
+    out = SONify(
+        {"a": np.int64(3), "b": np.float32(1.5), "c": np.arange(3), "d": [np.bool_(True)]}
+    )
+    assert out == {"a": 3, "b": 1.5, "c": [0, 1, 2], "d": [True]}
+    assert type(out["a"]) is int
+    assert type(out["b"]) is float
+
+
+def test_domain_evaluate_float_and_dict():
+    domain = Domain(lambda x: x**2, hp.uniform("x", -1, 1))
+    trials = Trials()
+    ctrl = Ctrl(trials)
+    res = domain.evaluate({"x": 3.0}, ctrl)
+    assert res == {"status": STATUS_OK, "loss": 9.0}
+
+    domain2 = Domain(
+        lambda x: {"loss": x + 1, "status": STATUS_OK, "extra": "kept"},
+        hp.uniform("x", -1, 1),
+    )
+    res2 = domain2.evaluate({"x": 1.0}, ctrl)
+    assert res2["loss"] == 2.0 and res2["extra"] == "kept"
+
+
+def test_domain_evaluate_nan_is_fail():
+    domain = Domain(lambda x: float("nan"), hp.uniform("x", -1, 1))
+    res = domain.evaluate({"x": 0.0}, Ctrl(Trials()))
+    assert res["status"] == STATUS_FAIL
+
+
+def test_domain_invalid_status():
+    domain = Domain(lambda x: {"status": "bogus"}, hp.uniform("x", -1, 1))
+    with pytest.raises(InvalidResultStatus):
+        domain.evaluate({"x": 0.0}, Ctrl(Trials()))
+
+
+def test_domain_missing_loss():
+    domain = Domain(lambda x: {"status": STATUS_OK}, hp.uniform("x", -1, 1))
+    with pytest.raises(InvalidLoss):
+        domain.evaluate({"x": 0.0}, Ctrl(Trials()))
+
+
+def test_domain_duplicate_label():
+    space = [hp.uniform("same", 0, 1), hp.normal("same", 0, 1)]
+    with pytest.raises(DuplicateLabel):
+        Domain(lambda cfg: 0.0, space)
+
+
+def test_domain_conditional_evaluate():
+    space = hp.choice(
+        "c",
+        [
+            {"kind": "a", "val": hp.uniform("ua", 0, 1)},
+            {"kind": "b", "val": hp.uniform("ub", 5, 6)},
+        ],
+    )
+    domain = Domain(lambda cfg: cfg["val"], space)
+    res = domain.evaluate({"c": 1, "ub": 5.5}, Ctrl(Trials()))
+    assert res["loss"] == 5.5
+
+
+def test_trial_attachments():
+    trials = Trials()
+    doc = make_doc(trials, 0, 1.0)
+    trials.insert_trial_docs([doc])
+    trials.refresh()
+    att = trials.trial_attachments(trials.trials[0])
+    att["blob"] = b"\x00\x01"
+    assert att["blob"] == b"\x00\x01"
+    assert "blob" in att
+
+
+def test_average_best_error():
+    trials = Trials()
+    docs = []
+    for tid, loss in enumerate([1.0, 0.5, 2.0]):
+        docs.append(make_doc(trials, tid, loss))
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    assert trials.average_best_error() == pytest.approx(0.5)

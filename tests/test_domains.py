@@ -1,0 +1,64 @@
+"""The domain battery: every algo runs fmin end-to-end on canonical
+synthetic objectives and must hit loose best-loss thresholds (reference
+pattern: tests/test_domains.py CasePerDomain, SURVEY.md SS4)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, anneal, fmin, rand, tpe
+from hyperopt_tpu.models.synthetic import DOMAINS, battery
+
+
+def run_domain(domain, algo, n_evals, seed=0):
+    trials = Trials()
+    fmin(
+        domain.fn,
+        domain.make_space(),
+        algo=algo,
+        max_evals=n_evals,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+        catch_eval_exceptions=False,
+    )
+    return trials.best_trial["result"]["loss"]
+
+
+# battery subset for per-algo threshold tests (full battery in smoke test)
+THRESHOLD_DOMAINS = ["quadratic1", "q1_choice", "n_arms", "branin", "gauss_wave2"]
+
+
+@pytest.mark.parametrize("name", THRESHOLD_DOMAINS)
+def test_tpe_hits_thresholds(name):
+    domain = DOMAINS[name]
+    n_evals, threshold = next(iter(domain.targets.items()))
+    best = min(run_domain(domain, tpe.suggest, n_evals, seed=s) for s in (0, 1))
+    assert best <= threshold, f"tpe on {name}: {best} > {threshold}"
+
+
+@pytest.mark.parametrize("name", THRESHOLD_DOMAINS)
+def test_anneal_hits_thresholds(name):
+    domain = DOMAINS[name]
+    n_evals, threshold = next(iter(domain.targets.items()))
+    best = min(run_domain(domain, anneal.suggest, n_evals, seed=s) for s in (0, 1))
+    assert best <= threshold, f"anneal on {name}: {best} > {threshold}"
+
+
+@pytest.mark.parametrize("name", sorted(DOMAINS))
+def test_rand_smoke_all_domains(name):
+    """Random search must run end-to-end on every domain (no thresholds)."""
+    domain = DOMAINS[name]
+    best = run_domain(domain, rand.suggest, 20, seed=0)
+    assert np.isfinite(best)
+
+
+def test_tpe_smoke_many_dists():
+    """TPE must handle the gnarly nested mixed-distribution space."""
+    domain = DOMAINS["many_dists"]
+    best = run_domain(domain, tpe.suggest, 35, seed=0)
+    assert np.isfinite(best)
+
+
+def test_battery_accessor():
+    assert {d.name for d in battery()} == set(DOMAINS)
+    assert [d.name for d in battery(["branin"])] == ["branin"]

@@ -1,0 +1,262 @@
+"""Integration tests for the fmin driver (reference: tests/test_fmin.py,
+SURVEY.md SS4: points_to_evaluate, early_stop_fn, timeout/loss_threshold,
+save->resume, reproducibility, exception propagation)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import (
+    STATUS_OK,
+    Trials,
+    fmin,
+    fmin_pass_expr_memo_ctrl,
+    generate_trials_to_calculate,
+    hp,
+    no_progress_loss,
+    rand,
+    space_eval,
+    tpe,
+)
+from hyperopt_tpu.exceptions import AllTrialsFailed
+from hyperopt_tpu.fmin import FMinIter, StopExperiment
+from hyperopt_tpu.base import Domain
+
+
+def quad(x):
+    return (x - 3.0) ** 2
+
+
+SPACE = hp.uniform("x", -10, 10)
+
+
+def test_fmin_basic_rand():
+    best = fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=30,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert abs(best["x"] - 3.0) < 3.0
+
+
+def test_fmin_reproducible_with_fixed_rstate():
+    kw = dict(algo=rand.suggest, max_evals=20, show_progressbar=False)
+    b1 = fmin(quad, SPACE, rstate=np.random.default_rng(123), **kw)
+    b2 = fmin(quad, SPACE, rstate=np.random.default_rng(123), **kw)
+    assert b1 == b2
+
+
+def test_fmin_int_seed_accepted():
+    b1 = fmin(quad, SPACE, algo=rand.suggest, max_evals=10, rstate=5,
+              show_progressbar=False)
+    b2 = fmin(quad, SPACE, algo=rand.suggest, max_evals=10, rstate=5,
+              show_progressbar=False)
+    assert b1 == b2
+
+
+def test_fmin_points_to_evaluate():
+    trials = Trials()
+    best = fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=15,
+        points_to_evaluate=[{"x": 3.0}, {"x": -4.0}],
+        trials=trials, rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    # the seeded exact optimum must win
+    assert best == {"x": 3.0}
+    assert trials.trials[0]["misc"]["vals"]["x"] == [3.0]
+    assert trials.trials[1]["misc"]["vals"]["x"] == [-4.0]
+
+
+def test_generate_trials_to_calculate_structure():
+    trials = generate_trials_to_calculate([{"x": 1.0}, {"x": 2.0}])
+    assert len(trials._dynamic_trials) == 2
+    assert trials._dynamic_trials[0]["state"] == 0
+
+
+def test_fmin_early_stop():
+    trials = Trials()
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=10_000,
+        early_stop_fn=no_progress_loss(10), trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert len(trials) < 10_000
+
+
+def test_fmin_loss_threshold():
+    trials = Trials()
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=10_000,
+        loss_threshold=5.0, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert trials.best_trial["result"]["loss"] <= 5.0
+    assert len(trials) < 10_000
+
+
+def test_fmin_timeout():
+    import time
+
+    trials = Trials()
+
+    def slow(x):
+        time.sleep(0.05)
+        return x**2
+
+    fmin(
+        slow, SPACE, algo=rand.suggest, max_evals=10_000, timeout=0.5,
+        trials=trials, rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert 1 <= len(trials) < 100
+
+
+def test_fmin_trials_save_file_resume(tmp_path):
+    save_file = str(tmp_path / "trials.pkl")
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=10,
+        trials_save_file=save_file, rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    assert os.path.exists(save_file)
+    with open(save_file, "rb") as f:
+        saved = pickle.load(f)
+    assert len(saved) == 10
+    # resume: max_evals=25 continues from the saved 10
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=25,
+        trials_save_file=save_file, rstate=np.random.default_rng(1),
+        show_progressbar=False,
+    )
+    with open(save_file, "rb") as f:
+        resumed = pickle.load(f)
+    assert len(resumed) == 25
+
+
+def test_fmin_exception_propagates_by_default():
+    class Boom(RuntimeError):
+        pass
+
+    def exploding(x):
+        raise Boom("nope")
+
+    with pytest.raises(Boom):
+        fmin(
+            exploding, SPACE, algo=rand.suggest, max_evals=3,
+            rstate=np.random.default_rng(0), show_progressbar=False,
+        )
+
+
+def test_fmin_catch_eval_exceptions():
+    calls = {"n": 0}
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] % 2:
+            raise RuntimeError("flaky")
+        return x**2
+
+    trials = Trials()
+    fmin(
+        flaky, SPACE, algo=rand.suggest, max_evals=10,
+        catch_eval_exceptions=True, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    from hyperopt_tpu import JOB_STATE_DONE, JOB_STATE_ERROR
+
+    states = [t["state"] for t in trials.trials]
+    assert states.count(JOB_STATE_ERROR) > 0
+    assert states.count(JOB_STATE_DONE) > 0
+
+
+def test_fmin_all_failed_argmin_raises():
+    def failing(x):
+        return {"status": "fail"}
+
+    trials = Trials()
+    with pytest.raises(AllTrialsFailed):
+        fmin(
+            failing, SPACE, algo=rand.suggest, max_evals=3, trials=trials,
+            rstate=np.random.default_rng(0), show_progressbar=False,
+        )
+
+
+def test_fmin_return_argmin_false():
+    loss = fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=10, return_argmin=False,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert isinstance(loss, float)
+
+
+def test_space_eval_choice_resolution():
+    space = hp.choice("c", [("a", hp.uniform("u", 0, 1)), ("b",)])
+    out = space_eval(space, {"c": 0, "u": 0.25})
+    assert out == ["a", 0.25]
+    assert space_eval(space, {"c": 1}) == ["b"]
+
+
+def test_fmin_pass_expr_memo_ctrl():
+    seen = {}
+
+    @fmin_pass_expr_memo_ctrl
+    def raw_fn(expr, memo, ctrl):
+        seen["expr"] = expr
+        seen["memo"] = memo
+        return {"status": STATUS_OK, "loss": 1.0}
+
+    fmin(
+        raw_fn, SPACE, algo=rand.suggest, max_evals=2,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert "expr" in seen and "memo" in seen
+
+
+def test_algo_can_stop_experiment():
+    def stopping_algo(new_ids, domain, trials, seed):
+        if len(trials.trials) >= 5:
+            return StopExperiment
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    trials = Trials()
+    fmin(
+        quad, SPACE, algo=stopping_algo, max_evals=100, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert len(trials) == 5
+
+
+def test_fminiter_stepwise():
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    it = FMinIter(
+        rand.suggest, domain, trials, rstate=np.random.default_rng(0),
+        max_evals=7, show_progressbar=False,
+    )
+    it.run(3)
+    assert len(trials) == 3
+    it.exhaust()
+    assert len(trials) == 7
+
+
+def test_trials_fmin_method():
+    trials = Trials()
+    best = trials.fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=5,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert "x" in best and len(trials) == 5
+
+
+def test_max_queue_len_batching():
+    seen_batches = []
+
+    def batch_watcher(new_ids, domain, trials, seed):
+        seen_batches.append(len(new_ids))
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    fmin(
+        quad, SPACE, algo=batch_watcher, max_evals=12, max_queue_len=4,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    assert max(seen_batches) == 4

@@ -1,0 +1,193 @@
+"""Unit tests for the pyll expression layer (reference: tests/test_pyll.py,
+SURVEY.md SS4: rec_eval correctness, as_apply lifting, clone/toposort,
+switch laziness)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.pyll import (
+    Apply,
+    Literal,
+    as_apply,
+    clone,
+    clone_merge,
+    dfs,
+    rec_eval,
+    sample,
+    scope,
+    toposort,
+)
+from hyperopt_tpu.exceptions import PyllImportError
+
+
+def test_literal_eval():
+    assert rec_eval(as_apply(5)) == 5
+    assert rec_eval(as_apply("abc")) == "abc"
+    assert rec_eval(as_apply(None)) is None
+
+
+def test_as_apply_list_tuple_dict():
+    assert rec_eval(as_apply([1, 2, 3])) == [1, 2, 3]
+    assert rec_eval(as_apply((1, (2, 3)))) == [1, [2, 3]]
+    assert rec_eval(as_apply({"b": 2, "a": 1})) == {"a": 1, "b": 2}
+    nested = as_apply({"x": [1, {"y": 2}]})
+    assert rec_eval(nested) == {"x": [1, {"y": 2}]}
+
+
+def test_arithmetic_operators():
+    x = as_apply(3)
+    y = as_apply(4)
+    assert rec_eval(x + y) == 7
+    assert rec_eval(x * y) == 12
+    assert rec_eval(x - y) == -1
+    assert rec_eval(y / x) == pytest.approx(4 / 3)
+    assert rec_eval(-x) == -3
+    assert rec_eval(x**2) == 9
+    assert rec_eval(2 + x) == 5
+
+
+def test_getitem():
+    lst = as_apply([10, 20, 30])
+    assert rec_eval(lst[1]) == 20
+    with pytest.raises(IndexError):
+        lst[5]
+
+
+def test_scope_define_and_eval():
+    @scope.define
+    def _test_add3(a, b, c=0):
+        return a + b + c
+
+    node = scope._test_add3(1, 2, c=3)
+    assert rec_eval(node) == 6
+    scope.undefine("_test_add3")
+
+
+def test_scope_unknown_symbol():
+    with pytest.raises(AttributeError):
+        scope.no_such_symbol_xyz
+
+
+def test_undefined_impl_raises():
+    node = Apply("never_defined_xyz", [as_apply(1)], {})
+    with pytest.raises(PyllImportError):
+        rec_eval(node)
+
+
+def test_duplicate_define_raises():
+    @scope.define
+    def _dup_sym():
+        return 1
+
+    with pytest.raises(ValueError):
+        scope.define_impl("_dup_sym", lambda: 2)
+    scope.undefine("_dup_sym")
+
+
+def test_switch_lazy():
+    calls = []
+
+    @scope.define
+    def _effectful(tag):
+        calls.append(tag)
+        return tag
+
+    expr = scope.switch(as_apply(1), scope._effectful("a"), scope._effectful("b"))
+    assert rec_eval(expr) == "b"
+    assert calls == ["b"], "switch must not evaluate unselected branches"
+    scope.undefine("_effectful")
+
+
+def test_switch_out_of_range():
+    expr = scope.switch(as_apply(5), as_apply("a"), as_apply("b"))
+    with pytest.raises(IndexError):
+        rec_eval(expr)
+
+
+def test_memo_substitution():
+    x = as_apply(1)
+    expr = x + 10
+    assert rec_eval(expr) == 11
+    assert rec_eval(expr, memo={x: 5}) == 15
+
+
+def test_dfs_toposort_order():
+    a = as_apply(1)
+    b = as_apply(2)
+    c = a + b
+    d = c * a
+    order = dfs(d)
+    assert order.index(a) < order.index(c) < order.index(d)
+    assert toposort(d)[-1] is d
+
+
+def test_clone_independent():
+    a = as_apply(2)
+    expr = a + 3
+    expr2 = clone(expr)
+    assert expr2 is not expr
+    assert rec_eval(expr2) == 5
+
+
+def test_clone_with_memo_substitution():
+    a = as_apply(2)
+    expr = a + 3
+    expr2 = clone(expr, memo={a: as_apply(10)})
+    assert rec_eval(expr2) == 13
+    assert rec_eval(expr) == 5
+
+
+def test_clone_merge():
+    a1 = scope.add(as_apply(1), as_apply(2))
+    a2 = scope.add(as_apply(1), as_apply(2))
+    both = scope.add(a1, a2)
+    merged = clone_merge(both, merge_literals=True)
+    adds = [n for n in dfs(merged) if n.name == "add"]
+    assert len(adds) == 2  # the two identical inner adds merged into one
+    assert rec_eval(merged) == 6
+
+
+def test_cycle_detection():
+    a = scope.add(as_apply(1), as_apply(2))
+    a.pos_args[0] = a  # create a cycle
+    with pytest.raises(RuntimeError):
+        rec_eval(a, max_program_len=100)
+
+
+def test_stochastic_sample_uniform():
+    rng = np.random.default_rng(0)
+    expr = scope.uniform(0, 1)
+    draws = [sample(expr, np.random.default_rng(i)) for i in range(100)]
+    assert all(0 <= d <= 1 for d in draws)
+    assert 0.3 < np.mean(draws) < 0.7
+    # determinism: same seed -> same draw
+    assert sample(expr, np.random.default_rng(42)) == sample(
+        expr, np.random.default_rng(42)
+    )
+    del rng
+
+
+def test_stochastic_sample_composite():
+    expr = {"a": scope.uniform(0, 1), "b": scope.randint(5)}
+    val = sample(as_apply(expr), np.random.default_rng(3))
+    assert 0 <= val["a"] <= 1
+    assert val["b"] in range(5)
+
+
+def test_lambda():
+    from hyperopt_tpu.pyll import Lambda
+
+    x = as_apply(0)
+    fn = Lambda("inc", [("x", x)], x + 1)
+    assert rec_eval(fn(41)) == 42
+
+
+def test_o_len():
+    assert len(as_apply((1, 2, 3))) == 3
+    assert len(as_apply({"a": 1})) == 1
+
+
+def test_pprint_no_crash():
+    expr = scope.add(as_apply(1), scope.uniform(0, 1))
+    s = str(expr)
+    assert "add" in s and "uniform" in s

@@ -1,0 +1,250 @@
+"""Unit tests for the TPE math (reference: tests/test_tpe.py, SURVEY.md SS4:
+adaptive-parzen invariants, lpdfs validated against numerical integration
+and empirical histograms, quantized mass sums to 1)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import tpe
+from hyperopt_tpu.tpe import (
+    GMM1,
+    GMM1_lpdf,
+    LGMM1,
+    LGMM1_lpdf,
+    adaptive_parzen_normal,
+    categorical_posterior,
+    linear_forgetting_weights,
+)
+
+
+# -- linear forgetting ------------------------------------------------------
+
+
+def test_lfw_short_history_all_ones():
+    np.testing.assert_array_equal(linear_forgetting_weights(10, 25), np.ones(10))
+
+
+def test_lfw_long_history_ramps():
+    w = linear_forgetting_weights(40, 25)
+    assert len(w) == 40
+    np.testing.assert_array_equal(w[-25:], np.ones(25))  # newest LF flat
+    assert np.all(np.diff(w[:15]) >= 0)  # oldest ramp increasing
+    assert w[0] == pytest.approx(1.0 / 40)
+
+
+# -- adaptive parzen --------------------------------------------------------
+
+
+def test_parzen_empty_obs_is_prior():
+    w, mu, sigma = adaptive_parzen_normal([], 1.0, 0.0, 2.0)
+    np.testing.assert_array_equal(w, [1.0])
+    np.testing.assert_array_equal(mu, [0.0])
+    np.testing.assert_array_equal(sigma, [2.0])
+
+
+def test_parzen_component_count_and_normalization():
+    obs = [0.1, -0.5, 1.2, 0.3]
+    w, mu, sigma = adaptive_parzen_normal(obs, 1.0, 0.0, 5.0)
+    assert len(w) == len(mu) == len(sigma) == len(obs) + 1
+    assert w.sum() == pytest.approx(1.0)
+    assert np.all(np.diff(mu) >= 0), "mus sorted"
+    assert set(np.round(mu, 6)) == set(np.round(obs + [0.0], 6))
+
+
+def test_parzen_sigma_clipping():
+    prior_sigma = 4.0
+    n = 10
+    obs = np.linspace(-1, 1, n)
+    w, mu, sigma = adaptive_parzen_normal(obs, 1.0, 0.0, prior_sigma)
+    minsigma = prior_sigma / min(100.0, 1.0 + n)
+    assert np.all(sigma <= prior_sigma + 1e-12)
+    assert np.all(sigma >= minsigma - 1e-12)
+
+
+def test_parzen_prior_sigma_pinned():
+    obs = [3.0, 3.00001, 3.00002]
+    prior_mu, prior_sigma = 0.0, 10.0
+    w, mu, sigma = adaptive_parzen_normal(obs, 1.0, prior_mu, prior_sigma)
+    prior_pos = int(np.argmin(np.abs(mu - prior_mu)))
+    assert sigma[prior_pos] == prior_sigma
+
+
+def test_parzen_concentrates_with_data():
+    """More (tight) observations -> posterior mass concentrates near them."""
+    rng = np.random.default_rng(0)
+    obs = rng.normal(2.0, 0.1, size=30)
+    w, mu, sigma = adaptive_parzen_normal(obs, 1.0, 0.0, 10.0)
+    draws = GMM1(w, mu, sigma, rng=np.random.default_rng(1), size=(4000,))
+    frac_near = np.mean(np.abs(draws - 2.0) < 1.0)
+    assert frac_near > 0.8
+
+
+# -- GMM sample / lpdf ------------------------------------------------------
+
+
+def _numeric_integral(lpdf_fn, lo, hi, n=20001):
+    xs = np.linspace(lo, hi, n)
+    ys = np.exp(lpdf_fn(xs))
+    return np.trapezoid(ys, xs)
+
+
+def test_gmm1_lpdf_integrates_to_one():
+    w = np.array([0.3, 0.7])
+    mu = np.array([-1.0, 2.0])
+    sigma = np.array([0.5, 1.5])
+    total = _numeric_integral(lambda x: GMM1_lpdf(x, w, mu, sigma), -15, 15)
+    assert total == pytest.approx(1.0, abs=1e-3)
+
+
+def test_gmm1_lpdf_truncated_integrates_to_one():
+    w = np.array([0.5, 0.5])
+    mu = np.array([0.0, 3.0])
+    sigma = np.array([1.0, 1.0])
+    total = _numeric_integral(
+        lambda x: GMM1_lpdf(x, w, mu, sigma, low=-1.0, high=4.0), -1.0, 4.0
+    )
+    assert total == pytest.approx(1.0, abs=1e-3)
+
+
+def test_gmm1_samples_within_bounds_and_match_histogram():
+    w = np.array([0.4, 0.6])
+    mu = np.array([0.0, 5.0])
+    sigma = np.array([1.0, 0.7])
+    rng = np.random.default_rng(0)
+    draws = GMM1(w, mu, sigma, low=-2.0, high=7.0, rng=rng, size=(20000,))
+    assert draws.min() >= -2.0 and draws.max() <= 7.0
+    # empirical histogram vs analytic density (survey SS4: validated against
+    # empirical histograms of GMM1 draws)
+    hist, edges = np.histogram(draws, bins=40, range=(-2, 7), density=True)
+    centers = 0.5 * (edges[1:] + edges[:-1])
+    dens = np.exp(GMM1_lpdf(centers, w, mu, sigma, low=-2.0, high=7.0))
+    assert np.max(np.abs(hist - dens)) < 0.05
+
+
+def test_gmm1_quantized_mass_sums_to_one():
+    w = np.array([0.5, 0.5])
+    mu = np.array([1.0, 8.0])
+    sigma = np.array([2.0, 1.0])
+    q = 1.0
+    support = np.arange(0.0, 11.0, q)
+    mass = np.exp(GMM1_lpdf(support, w, mu, sigma, low=0.0, high=10.0, q=q))
+    assert mass.sum() == pytest.approx(1.0, abs=1e-6)
+
+
+def test_gmm1_quantized_samples_on_grid():
+    w = np.array([1.0])
+    mu = np.array([5.0])
+    sigma = np.array([3.0])
+    draws = GMM1(w, mu, sigma, low=0.0, high=10.0, q=0.5,
+                 rng=np.random.default_rng(1), size=(500,))
+    np.testing.assert_allclose(draws, np.round(draws / 0.5) * 0.5)
+
+
+def test_lgmm1_positive_and_lpdf_integrates():
+    w = np.array([0.6, 0.4])
+    mu = np.array([0.0, 1.0])  # log-space
+    sigma = np.array([0.5, 0.3])
+    rng = np.random.default_rng(2)
+    draws = LGMM1(w, mu, sigma, rng=rng, size=(5000,))
+    assert np.all(draws > 0)
+    total = _numeric_integral(lambda x: LGMM1_lpdf(x, w, mu, sigma), 1e-4, 40.0)
+    assert total == pytest.approx(1.0, abs=2e-3)
+
+
+def test_lgmm1_truncated_bounds():
+    w = np.array([1.0])
+    mu = np.array([0.0])
+    sigma = np.array([1.0])
+    low, high = -1.0, 1.0  # log-space bounds
+    draws = LGMM1(w, mu, sigma, low=low, high=high,
+                  rng=np.random.default_rng(3), size=(2000,))
+    assert draws.min() >= np.exp(low) - 1e-9
+    assert draws.max() <= np.exp(high) + 1e-9
+
+
+# -- categorical posterior --------------------------------------------------
+
+
+def test_categorical_posterior_prior_only():
+    p = categorical_posterior([], np.array([0.25, 0.25, 0.5]), 1.0, 25)
+    np.testing.assert_allclose(p, [0.25, 0.25, 0.5])
+
+
+def test_categorical_posterior_counts_dominate():
+    obs = [2] * 50
+    p = categorical_posterior(obs, np.ones(3) / 3, 1.0, 100)
+    assert p[2] > 0.9
+    assert p.sum() == pytest.approx(1.0)
+
+
+def test_categorical_posterior_never_zero():
+    p = categorical_posterior([0] * 100, np.ones(4) / 4, 1.0, 200)
+    assert np.all(p > 0)
+
+
+# -- suggest-level behavior -------------------------------------------------
+
+
+def test_tpe_beats_random_on_quadratic():
+    """Regression threshold (survey SS4): TPE > random on quadratic1."""
+    import numpy as np
+    from hyperopt_tpu import Trials, fmin, hp, rand
+
+    def run(algo, seed):
+        trials = Trials()
+        fmin(
+            lambda x: (x - 3.0) ** 2,
+            hp.uniform("x", -10, 10),
+            algo=algo,
+            max_evals=75,
+            trials=trials,
+            rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+        )
+        return trials.best_trial["result"]["loss"]
+
+    tpe_losses = [run(tpe.suggest, s) for s in range(3)]
+    rand_losses = [run(rand.suggest, s) for s in range(3)]
+    assert np.median(tpe_losses) <= np.median(rand_losses) + 1e-9
+    assert np.median(tpe_losses) < 0.05
+
+
+def test_tpe_startup_uses_prior():
+    """Before n_startup_jobs, tpe must behave like random (seeded)."""
+    from hyperopt_tpu import Domain, Trials, hp
+
+    domain = Domain(lambda x: x, hp.uniform("x", 0, 1))
+    trials = Trials()
+    docs = tpe.suggest(trials.new_trial_ids(1), domain, trials, seed=42)
+    assert len(docs) == 1
+    v = docs[0]["misc"]["vals"]["x"][0]
+    assert 0 <= v <= 1
+
+
+def test_tpe_handles_failed_and_nan_trials():
+    """ERROR/NaN trials must be masked out of the posterior (SURVEY.md SS5)."""
+    import numpy as np
+    from hyperopt_tpu import STATUS_FAIL, STATUS_OK, Trials, fmin, hp
+
+    calls = {"n": 0}
+
+    def sometimes_fails(x):
+        calls["n"] += 1
+        if calls["n"] % 4 == 0:
+            return {"status": STATUS_FAIL}
+        if calls["n"] % 7 == 0:
+            return float("nan")
+        return {"status": STATUS_OK, "loss": (x - 1) ** 2}
+
+    trials = Trials()
+    best = fmin(
+        sometimes_fails,
+        hp.uniform("x", -5, 5),
+        algo=tpe.suggest,
+        max_evals=40,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    assert "x" in best
+    assert trials.best_trial["result"]["loss"] >= 0

@@ -1,0 +1,104 @@
+"""Tests for batch sampling / sparse idxs-vals encoding (reference:
+tests/test_vectorize.py behavior, SURVEY.md SS3.3)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.pyll_utils import EQ, expr_to_config
+from hyperopt_tpu.pyll.base import as_apply
+from hyperopt_tpu.vectorize import (
+    VectorizeHelper,
+    dense_to_idxs_vals,
+    idxs_vals_to_dense,
+    pretty_names,
+    sample_config,
+)
+
+
+def cond_space():
+    return hp.choice(
+        "root",
+        [
+            {"branch": "flat", "x": hp.uniform("x_flat", 0, 1)},
+            {
+                "branch": "deep",
+                "y": hp.loguniform("y_deep", -3, 0),
+                "sub": hp.choice("sub", [hp.normal("n0", 0, 1), hp.randint("r1", 4)]),
+            },
+        ],
+    )
+
+
+def test_expr_to_config_labels_and_conditions():
+    hps = expr_to_config(as_apply(cond_space()))
+    assert set(hps) == {"root", "x_flat", "y_deep", "sub", "n0", "r1"}
+    assert hps["root"].unconditional
+    assert hps["x_flat"].conditions == {(EQ("root", 0),)}
+    assert hps["y_deep"].conditions == {(EQ("root", 1),)}
+    assert hps["n0"].conditions == {(EQ("root", 1), EQ("sub", 0))}
+    assert hps["root"].dist == "randint"
+    assert hps["x_flat"].params == {"low": 0, "high": 1}
+
+
+def test_expr_to_config_shared_param_merges_conditions():
+    shared = hp.uniform("shared", 0, 1)
+    space = hp.choice("c", [{"a": shared}, {"b": shared, "z": hp.normal("z", 0, 1)}])
+    hps = expr_to_config(as_apply(space))
+    assert hps["shared"].conditions == {(EQ("c", 0),), (EQ("c", 1),)}
+
+
+def test_sample_batch_sparsity():
+    helper = VectorizeHelper(cond_space())
+    rng = np.random.default_rng(0)
+    new_ids = list(range(50))
+    idxs, vals = helper.sample_batch(new_ids, rng)
+    # root is always active
+    assert idxs["root"] == new_ids
+    # each trial appears in exactly one of x_flat / y_deep
+    flat = set(idxs["x_flat"])
+    deep = set(idxs["y_deep"])
+    assert flat | deep == set(new_ids)
+    assert flat & deep == set()
+    # deep trials all have a sub choice; n0/r1 partition them
+    assert set(idxs["sub"]) == deep
+    assert set(idxs["n0"]) | set(idxs["r1"]) == deep
+    # drawn values respect bounds
+    assert all(0 <= v <= 1 for v in vals["x_flat"])
+    assert all(np.exp(-3) <= v <= 1.0 + 1e-12 for v in vals["y_deep"])
+    assert all(v in range(4) for v in vals["r1"])
+    # idxs_by_label view matches
+    assert helper.idxs_by_label() == idxs
+
+
+def test_sample_determinism():
+    s1 = sample_config(cond_space(), np.random.default_rng(7))
+    s2 = sample_config(cond_space(), np.random.default_rng(7))
+    assert s1 == s2
+
+
+def test_pretty_names():
+    names = pretty_names(cond_space(), prefix="p")
+    assert "p.root" in names.values()
+
+
+def test_dense_sparse_roundtrip():
+    labels = ["a", "b"]
+    tids = [10, 11, 12]
+    values = np.array([[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]])
+    active = np.array([[True, True, False], [False, True, True]])
+    idxs, vals = dense_to_idxs_vals(tids, labels, values, active)
+    assert idxs == {"a": [10, 11], "b": [11, 12]}
+    assert vals == {"a": [1.0, 2.0], "b": [5.0, 6.0]}
+    values2, active2 = idxs_vals_to_dense(tids, labels, idxs, vals)
+    np.testing.assert_array_equal(active2, active)
+    assert values2[0, 0] == 1.0 and values2[1, 2] == 6.0
+
+
+def test_quantized_draws_are_quantized():
+    space = {"q": hp.quniform("q", 0, 10, 0.5), "qi": hp.uniformint("qi", 0, 5)}
+    cfgs = [sample_config(space, np.random.default_rng(i)) for i in range(30)]
+    for c in cfgs:
+        assert c["q"] == pytest.approx(round(c["q"] / 0.5) * 0.5)
+        assert float(c["qi"]).is_integer()
+        assert 0 <= c["qi"] <= 5
