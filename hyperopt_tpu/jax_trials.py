@@ -1,0 +1,171 @@
+"""Dense observation history for the JAX algorithms.
+
+The north-star ``JaxTrials`` backend (BASELINE.json / SURVEY.md SS7 stance
+#3): observation history lives in preallocated dense buffers (values +
+active-masks per hyperparameter, losses + validity), grown by doubling so
+jitted suggest steps see a small set of static shapes (power-of-2 bucketed
+capacity -> bounded recompiles, SURVEY.md SS7 'shape polymorphism').
+
+``ObsBuffer`` is the packing engine: it incrementally mirrors any
+``Trials`` store (only completed, status-ok, finite-loss trials enter the
+posterior -- failed/NaN trials are masked out, SURVEY.md SS5).
+``JaxTrials`` is a drop-in ``Trials`` subclass that owns buffers keyed by
+compiled space, so repeated suggest calls do zero re-packing.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .base import JOB_STATE_DONE, STATUS_OK, Trials
+from .ops.compile import PackedSpace
+
+__all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY"]
+
+MIN_CAPACITY = 128
+
+
+class ObsBuffer:
+    """Dense, capacity-bucketed mirror of completed trials for one space.
+
+    Arrays (host numpy; handed to jit as-is and transferred once per call):
+      values: [D, cap] natural-space draws (garbage where inactive)
+      active: [D, cap] per-dim activity mask
+      losses: [cap]
+      valid:  [cap] slot occupancy
+    Slots are tid-ordered (time order for forgetting weights).
+    """
+
+    def __init__(self, space: PackedSpace, capacity=MIN_CAPACITY):
+        self.space = space
+        self.capacity = int(capacity)
+        D = space.n_dims
+        self.values = np.zeros((D, self.capacity), dtype=np.float32)
+        self.active = np.zeros((D, self.capacity), dtype=bool)
+        self.losses = np.zeros(self.capacity, dtype=np.float32)
+        self.valid = np.zeros(self.capacity, dtype=bool)
+        self.count = 0
+        self._n_scanned = 0  # trials-list prefix already ingested
+
+    def _grow(self):
+        new_cap = self.capacity * 2
+        for name in ("values", "active"):
+            old = getattr(self, name)
+            new = np.zeros((old.shape[0], new_cap), dtype=old.dtype)
+            new[:, : self.capacity] = old
+            setattr(self, name, new)
+        for name in ("losses", "valid"):
+            old = getattr(self, name)
+            new = np.zeros(new_cap, dtype=old.dtype)
+            new[: self.capacity] = old
+            setattr(self, name, new)
+        self.capacity = new_cap
+
+    def add(self, vals_dict, loss):
+        """Append one completed trial: {label: value} + loss."""
+        if self.count == self.capacity:
+            self._grow()
+        i = self.count
+        label_pos = self._label_pos
+        for label, v in vals_dict.items():
+            d = label_pos.get(label)
+            if d is None:
+                continue
+            self.values[d, i] = v
+            self.active[d, i] = True
+        self.losses[i] = loss
+        self.valid[i] = True
+        self.count += 1
+
+    @property
+    def _label_pos(self):
+        pos = getattr(self, "_label_pos_cache", None)
+        if pos is None:
+            pos = {label: d for d, label in enumerate(self.space.labels)}
+            self._label_pos_cache = pos
+        return pos
+
+    def sync(self, trials: Trials):
+        """Ingest trials completed since the last sync (append-only scan).
+
+        Returns the number of newly ingested observations.  Robust to the
+        trials list being extended in place (the fmin pattern); a shrunk
+        list (delete_all) triggers a full rebuild.
+        """
+        docs = trials.trials
+        if len(docs) < self._n_scanned:
+            self.__init__(self.space, MIN_CAPACITY)
+        added = 0
+        for t in docs[self._n_scanned:]:
+            if (
+                t["state"] == JOB_STATE_DONE
+                and t["result"].get("status") == STATUS_OK
+                and t["result"].get("loss") is not None
+                and np.isfinite(float(t["result"]["loss"]))
+            ):
+                vals = {
+                    k: v[0]
+                    for k, v in t["misc"]["vals"].items()
+                    if len(v) == 1
+                }
+                self.add(vals, float(t["result"]["loss"]))
+                added += 1
+        self._n_scanned = len(docs)
+        return added
+
+    def arrays(self):
+        """The four dense arrays at current (bucketed) capacity."""
+        return self.values, self.active, self.losses, self.valid
+
+
+class JaxTrials(Trials):
+    """``Trials`` whose completed history is mirrored into dense device-ready
+    buffers -- the on-device experiment store of the TPU path.
+
+    Use exactly like ``Trials``; the JAX algorithms
+    (:mod:`hyperopt_tpu.tpe_jax`, :mod:`hyperopt_tpu.rand_jax`) detect it
+    and reuse its buffers instead of maintaining their own.
+    """
+
+    def __init__(self, exp_key=None, refresh=True):
+        self._buffers = {}  # id(PackedSpace) -> ObsBuffer
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    def obs_buffer(self, space: PackedSpace) -> ObsBuffer:
+        buf = self._buffers.get(id(space))
+        if buf is None:
+            buf = ObsBuffer(space)
+            self._buffers[id(space)] = buf
+        buf.sync(self)
+        return buf
+
+    def __getstate__(self):
+        # buffers are derived state; rebuilt on demand after unpickling
+        state = self.__dict__.copy()
+        state["_buffers"] = {}
+        return state
+
+
+def obs_buffer_for(domain, trials) -> ObsBuffer:
+    """The shared entry point used by the JAX algos: prefer the JaxTrials
+    resident buffer, else a buffer cached on the domain."""
+    space = packed_space_for(domain)
+    if isinstance(trials, JaxTrials):
+        return trials.obs_buffer(space)
+    buf = getattr(domain, "_obs_buffer", None)
+    if buf is None or buf.space is not space:
+        buf = ObsBuffer(space)
+        domain._obs_buffer = buf
+    buf.sync(trials)
+    return buf
+
+
+def packed_space_for(domain) -> PackedSpace:
+    """Compile (once) and cache the domain's space."""
+    ps = getattr(domain, "_packed_space", None)
+    if ps is None:
+        from .ops.compile import compile_space
+
+        ps = compile_space(domain.expr)
+        domain._packed_space = ps
+    return ps

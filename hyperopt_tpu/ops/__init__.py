@@ -1,0 +1,15 @@
+"""JAX/TPU compute kernels.
+
+``kernels`` -- shape-static vmapped TPE math: adaptive-Parzen GMM fitting
+over masked observation buffers, rejection-free truncated-normal sampling
+(inverse CDF), mixture log-densities, categorical posteriors, EI scoring.
+``compile`` -- the space compiler: lowers an ``hp.*`` pyll graph to a
+``PackedSpace`` + one jitted stochastic sampler emitting dense values and
+active-masks (replacing the reference's interpreted ``rec_eval`` sampling;
+SURVEY.md SS7 design stance #1-#2).
+"""
+
+from . import compile, kernels
+from .compile import PackedSpace, compile_space
+
+__all__ = ["compile", "kernels", "PackedSpace", "compile_space"]

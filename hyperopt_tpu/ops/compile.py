@@ -1,0 +1,280 @@
+"""The space compiler: ``hp.*`` pyll graph -> one jitted stochastic program.
+
+Replaces the reference's interpreted per-trial ``pyll.rec_eval`` sampling
+(SURVEY.md SS3.3) with a TPU-first design (SS7 stance #1): the space is
+*compiled once* into a ``PackedSpace`` -- flat per-dimension parameter
+arrays -- and sampling a batch of n trials is a single XLA program emitting
+dense ``[D, n]`` values plus an active-mask.  Ragged idxs/vals encoding is
+reconstructed only at the API boundary (``vectorize.dense_to_idxs_vals``).
+
+Conditional (``hp.choice``) structure compiles to padded condition tables:
+``active[d] = OR_a AND_c (values[cond_dim[d,a,c]] == cond_val[d,a,c])`` --
+pure elementwise work, no control flow, so nested choice spaces
+(NAS-Bench-style) jit cleanly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..exceptions import CompileError
+from ..pyll.base import as_apply
+from ..pyll_utils import expr_to_config
+
+__all__ = ["PackedSpace", "compile_space"]
+
+_CONT_DISTS = {
+    "uniform": (False, False),  # (logspace, quantized)
+    "quniform": (False, True),
+    "loguniform": (True, False),
+    "qloguniform": (True, True),
+    "normal": (False, False),
+    "qnormal": (False, True),
+    "lognormal": (True, False),
+    "qlognormal": (True, True),
+}
+_CAT_DISTS = {"randint", "categorical", "randint_via_categorical"}
+
+
+class PackedSpace:
+    """Flat array encoding of a search space (host numpy; device-ready).
+
+    Continuous dims are parameterized in *latent* space (log-space dists
+    fit/sample on log values): ``low/high`` latent bounds (+-inf if
+    unbounded), ``prior_mu/prior_sigma`` the TPE prior component, ``q``
+    natural-space quantization (0 = none).  Categorical dims carry a
+    zero-padded prior pmf and an integer offset (for ``hp.randint(low,
+    high)``).  Condition tables encode hp.choice activation (see module
+    docstring).
+    """
+
+    def __init__(self, labels, hps):
+        self.labels = labels
+        self.hps = hps
+        D = len(labels)
+        self.n_dims = D
+        idx = {label: d for d, label in enumerate(labels)}
+
+        kind = np.zeros(D, dtype=np.int32)
+        cont, cat = [], []
+        for d, label in enumerate(labels):
+            dist = hps[label].dist
+            if dist in _CONT_DISTS:
+                cont.append(d)
+            elif dist in _CAT_DISTS:
+                cat.append(d)
+            else:
+                raise CompileError(f"cannot compile distribution {dist!r}")
+        kind[cat] = 1
+        self.kind = kind
+        self.cont_idx = np.asarray(cont, dtype=np.int32)
+        self.cat_idx = np.asarray(cat, dtype=np.int32)
+
+        # -- continuous dim params (latent space) -------------------------
+        Dc = len(cont)
+        self.low = np.full(Dc, -np.inf, dtype=np.float32)
+        self.high = np.full(Dc, np.inf, dtype=np.float32)
+        self.prior_mu = np.zeros(Dc, dtype=np.float32)
+        self.prior_sigma = np.ones(Dc, dtype=np.float32)
+        self.logspace = np.zeros(Dc, dtype=bool)
+        self.q = np.zeros(Dc, dtype=np.float32)
+        for i, d in enumerate(cont):
+            info = hps[labels[d]]
+            p = info.params
+            logspace, quantized = _CONT_DISTS[info.dist]
+            self.logspace[i] = logspace
+            if quantized:
+                qv = p.get("q")
+                if not isinstance(qv, (int, float)):
+                    raise CompileError(
+                        f"{info.label}: q must be a literal number, got {qv!r}"
+                    )
+                self.q[i] = float(qv)
+            if info.dist in ("uniform", "quniform", "loguniform", "qloguniform"):
+                lo, hi = p["low"], p["high"]
+                if not isinstance(lo, (int, float)) or not isinstance(hi, (int, float)):
+                    raise CompileError(
+                        f"{info.label}: bounds must be literal numbers"
+                    )
+                self.low[i], self.high[i] = float(lo), float(hi)
+                self.prior_mu[i] = 0.5 * (float(lo) + float(hi))
+                self.prior_sigma[i] = float(hi) - float(lo)
+            else:
+                mu, sg = p["mu"], p["sigma"]
+                if not isinstance(mu, (int, float)) or not isinstance(sg, (int, float)):
+                    raise CompileError(
+                        f"{info.label}: mu/sigma must be literal numbers"
+                    )
+                self.prior_mu[i], self.prior_sigma[i] = float(mu), float(sg)
+
+        # -- categorical dim params ---------------------------------------
+        Dk = len(cat)
+        n_opts = []
+        int_low = []
+        priors = []
+        for d in cat:
+            info = hps[labels[d]]
+            p = info.params
+            if info.dist == "randint":
+                lo = int(p["low"])
+                hi = int(p["high"])
+                n_opts.append(hi - lo)
+                int_low.append(lo)
+                priors.append(np.full(hi - lo, 1.0 / (hi - lo)))
+            else:
+                pm = np.asarray(p["p"], dtype=np.float64)
+                n_opts.append(len(pm))
+                int_low.append(0)
+                priors.append(pm / pm.sum())
+        self.k_max = max(n_opts, default=1)
+        self.n_options = np.asarray(n_opts, dtype=np.int32)
+        self.int_low = np.asarray(int_low, dtype=np.int32)
+        self.prior_p = np.zeros((Dk, self.k_max), dtype=np.float32)
+        for i, pm in enumerate(priors):
+            self.prior_p[i, : len(pm)] = pm
+
+        # -- condition tables ---------------------------------------------
+        a_max = max((len(hps[l].conditions) for l in labels), default=1) or 1
+        c_max = max(
+            (len(conj) for l in labels for conj in hps[l].conditions), default=1
+        ) or 1
+        self.a_max, self.c_max = a_max, c_max
+        self.alt_mask = np.zeros((D, a_max), dtype=bool)
+        self.term_mask = np.zeros((D, a_max, c_max), dtype=bool)
+        self.cond_dim = np.zeros((D, a_max, c_max), dtype=np.int32)
+        self.cond_val = np.zeros((D, a_max, c_max), dtype=np.float32)
+        for d, label in enumerate(labels):
+            conds = sorted(hps[label].conditions) or [()]
+            for a, conj in enumerate(conds):
+                self.alt_mask[d, a] = True
+                for c, term in enumerate(conj):
+                    if term.name not in idx:
+                        raise CompileError(
+                            f"condition on unknown label {term.name!r}"
+                        )
+                    self.term_mask[d, a, c] = True
+                    self.cond_dim[d, a, c] = idx[term.name]
+                    self.cond_val[d, a, c] = float(term.val)
+
+        self.unconditional = bool(
+            all(hps[l].unconditional for l in labels)
+        )
+
+    # -- device-side programs ---------------------------------------------
+    @functools.cached_property
+    def _consts(self):
+        """Device-resident constants (built lazily, after conftest env).
+
+        Materialized OUTSIDE any jit trace (callers touch this property
+        eagerly before tracing) -- a cached_property filled during a trace
+        would cache tracers and leak them into later programs.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        with jax.ensure_compile_time_eval():
+            return {
+                k: jnp.asarray(getattr(self, k))
+                for k in (
+                    "low", "high", "prior_mu", "prior_sigma", "logspace", "q",
+                    "prior_p", "int_low", "n_options",
+                    "alt_mask", "term_mask", "cond_dim", "cond_val",
+                    "cont_idx", "cat_idx",
+                )
+            }
+
+    def active_fn(self, values):
+        """[D, n] dense values -> [D, n] active mask (pure jnp; jittable)."""
+        import jax.numpy as jnp
+
+        c = self._consts
+        if self.unconditional:
+            return jnp.ones(values.shape, dtype=bool)
+        gathered = values[c["cond_dim"]]  # [D, A, C, n]
+        eq = jnp.abs(gathered - c["cond_val"][..., None]) < 0.5
+        term_ok = eq | ~c["term_mask"][..., None]
+        conj = jnp.all(term_ok, axis=2) & c["alt_mask"][..., None]
+        return jnp.any(conj, axis=1)
+
+    def sample_prior_fn(self, key, n):
+        """Jit-traceable: draw n prior configs -> (values [D,n], active [D,n]).
+
+        Continuous dims: bounded dims draw uniform in latent space, normal
+        dims draw mu + sigma*z; log-space dims exponentiate; quantized dims
+        round in natural space.  Categorical dims: Gumbel/categorical over
+        the padded prior pmf.
+        """
+        import jax
+        import jax.numpy as jnp
+
+        c = self._consts
+        D = self.n_dims
+        Dc = len(self.cont_idx)
+        Dk = len(self.cat_idx)
+        ku, kz, kc = jax.random.split(key, 3)
+        values = jnp.zeros((D, n), dtype=jnp.float32)
+
+        if Dc:
+            low, high = c["low"][:, None], c["high"][:, None]
+            bounded = jnp.isfinite(low)
+            u = jax.random.uniform(ku, (Dc, n), dtype=jnp.float32)
+            z = jax.random.normal(kz, (Dc, n), dtype=jnp.float32)
+            lat = jnp.where(
+                bounded,
+                low + u * (high - low),
+                c["prior_mu"][:, None] + c["prior_sigma"][:, None] * z,
+            )
+            nat = jnp.where(c["logspace"][:, None], jnp.exp(lat), lat)
+            q = c["q"][:, None]
+            qq = jnp.maximum(q, 1e-12)
+            nat_low = jnp.where(c["logspace"][:, None], jnp.exp(low), low)
+            nat_high = jnp.where(c["logspace"][:, None], jnp.exp(high), high)
+            rounded = jnp.round(nat / qq) * qq
+            rounded = jnp.clip(
+                rounded,
+                jnp.where(jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low),
+                jnp.where(jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high),
+            )
+            nat = jnp.where(q > 0, rounded, nat)
+            values = values.at[c["cont_idx"]].set(nat)
+
+        if Dk:
+            logits = jnp.where(
+                c["prior_p"] > 0, jnp.log(jnp.maximum(c["prior_p"], 1e-30)), -jnp.inf
+            )
+            draws = jax.random.categorical(
+                kc, logits[:, None, :], axis=-1, shape=(Dk, n)
+            )
+            values = values.at[c["cat_idx"]].set(
+                draws.astype(jnp.float32) + c["int_low"][:, None]
+            )
+
+        return values, self.active_fn(values)
+
+    @functools.cached_property
+    def sample_prior(self):
+        """Jitted ``(key, n) -> (values, active)`` with static n."""
+        import jax
+
+        _ = self._consts  # materialize constants outside the trace
+        return jax.jit(self.sample_prior_fn, static_argnums=(1,))
+
+    def __repr__(self):
+        return (
+            f"PackedSpace(D={self.n_dims}, cont={len(self.cont_idx)}, "
+            f"cat={len(self.cat_idx)}, k_max={self.k_max}, "
+            f"conditional={not self.unconditional})"
+        )
+
+
+def compile_space(space):
+    """Compile an hp-annotated space (pyll graph or pytree of graphs) into
+    a :class:`PackedSpace`."""
+    expr = as_apply(space)
+    hps = expr_to_config(expr)
+    labels = sorted(hps)
+    if not labels:
+        raise CompileError("space has no hyperparameters")
+    return PackedSpace(labels, hps)

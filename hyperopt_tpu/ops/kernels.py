@@ -1,0 +1,227 @@
+"""Shape-static JAX kernels for TPE.
+
+The math of :mod:`hyperopt_tpu.tpe` (reference ``hyperopt/tpe.py``,
+SURVEY.md SS3.2) re-derived for the TPU execution model:
+
+* observations live in fixed-capacity buffers with validity masks (ragged
+  idxs/vals -> dense + mask, SURVEY.md SS7 'hard parts');
+* truncated sampling is inverse-CDF (``ndtri``), never rejection loops;
+* per-hyperparameter fits/draws/scores are ``vmap``-ed over dimensions and
+  candidates; everything lowers to elementwise + small sorts/matmuls that
+  XLA fuses.
+
+All kernels are pure functions of arrays -- no Python branching on traced
+values -- so a single ``jit`` covers the whole suggest step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import ndtr, ndtri
+
+__all__ = [
+    "forgetting_weights",
+    "parzen_fit",
+    "trunc_gmm_sample",
+    "trunc_gmm_logpdf",
+    "categorical_fit",
+    "split_below_above",
+    "ei_argmax",
+]
+
+TINY = 1e-12
+F32_TINY = 1e-30
+
+
+def forgetting_weights(mask, lf):
+    """Linear-forgetting weights over a masked, slot-time-ordered buffer.
+
+    Newest ``lf`` valid observations weigh 1; older ones ramp linearly from
+    1/n.  Matches :func:`hyperopt_tpu.tpe.linear_forgetting_weights` on the
+    valid slots; zeros elsewhere.
+    """
+    mask_f = mask.astype(jnp.float32)
+    n = jnp.sum(mask_f)
+    rank = jnp.cumsum(mask_f) - 1.0  # time rank of each valid slot
+    n_ramp = jnp.maximum(n - lf, 0.0)
+    inv_n = 1.0 / jnp.maximum(n, 1.0)
+    ramp = inv_n + rank * (1.0 - inv_n) / jnp.maximum(n_ramp - 1.0, 1.0)
+    w = jnp.where(rank >= n_ramp, 1.0, ramp)
+    return w * mask_f
+
+
+def parzen_fit(obs, mask, prior_mu, prior_sigma, prior_weight, lf):
+    """Adaptive-Parzen GMM fit over a masked observation buffer.
+
+    Args:
+      obs: [N] observed values (latent space; garbage where ``mask`` false).
+      mask: [N] bool validity.
+      prior_mu, prior_sigma, prior_weight: scalars.
+      lf: linear-forgetting horizon (scalar).
+
+    Returns:
+      (weights, mus, sigmas): each [N + 1] -- one component per buffer slot
+      plus the prior component, sorted by mu; invalid slots carry weight 0.
+      Same math as :func:`hyperopt_tpu.tpe.adaptive_parzen_normal`:
+      neighbor-gap sigmas computed on the sorted array *with the prior
+      inserted*, clipped to [prior_sigma/min(100, 1+n), prior_sigma], prior
+      sigma pinned, forgetting weights + prior_weight, normalized.
+    """
+    n = jnp.sum(mask.astype(jnp.float32))
+    tw = forgetting_weights(mask, lf)
+
+    big = jnp.asarray(jnp.inf, dtype=obs.dtype)
+    vals = jnp.concatenate([jnp.where(mask, obs, big), prior_mu[None]])
+    wts = jnp.concatenate([tw, prior_weight[None]])
+    valid = jnp.concatenate([mask, jnp.ones((1,), dtype=bool)])
+    is_prior = jnp.concatenate(
+        [jnp.zeros_like(mask), jnp.ones((1,), dtype=bool)]
+    )
+
+    order = jnp.argsort(vals, stable=True)
+    sv = vals[order]
+    sw = wts[order]
+    sprior = is_prior[order]
+    svalid = valid[order]
+
+    m = sv.shape[0]
+    neg = -jnp.inf
+    left_gap = jnp.concatenate([jnp.full((1,), neg, sv.dtype), sv[1:] - sv[:-1]])
+    right_gap = jnp.concatenate([sv[1:] - sv[:-1], jnp.full((1,), neg, sv.dtype)])
+    left_avail = jnp.concatenate([jnp.zeros((1,), bool), svalid[:-1]])
+    right_avail = jnp.concatenate([svalid[1:], jnp.zeros((1,), bool)])
+    raw = jnp.maximum(
+        jnp.where(left_avail, left_gap, neg), jnp.where(right_avail, right_gap, neg)
+    )
+    raw = jnp.where(jnp.isfinite(raw), raw, prior_sigma)
+
+    minsigma = prior_sigma / jnp.minimum(100.0, 1.0 + n)
+    sigma = jnp.clip(raw, minsigma, prior_sigma)
+    sigma = jnp.where(sprior, prior_sigma, sigma)
+    sigma = jnp.where(svalid, sigma, 1.0)
+
+    sw = jnp.where(svalid, sw, 0.0)
+    sw = sw / jnp.maximum(jnp.sum(sw), F32_TINY)
+    sv = jnp.where(svalid, sv, 0.0)  # keep padded mus finite for downstream
+    return sw, sv, sigma
+
+
+def _safe_log(x):
+    return jnp.log(jnp.maximum(x, F32_TINY))
+
+
+def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_samples):
+    """Draw ``n_samples`` from a truncated (latent-space) GMM.
+
+    ``low``/``high`` are latent-space bounds (+-inf when unbounded);
+    ``logspace`` exponentiates draws into natural space; ``q > 0``
+    quantizes in natural space.  Inverse-CDF truncation -- no rejection.
+    """
+    k_comp, k_u = jax.random.split(key)
+    logits = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
+    comp = jax.random.categorical(k_comp, logits, shape=(n_samples,))
+    m = mus[comp]
+    s = jnp.maximum(sigmas[comp], TINY)
+
+    a = ndtr((low - m) / s)
+    b = ndtr((high - m) / s)
+    u = jax.random.uniform(k_u, (n_samples,), dtype=mus.dtype)
+    p = jnp.clip(a + u * (b - a), TINY, 1.0 - 1e-7)
+    x = m + s * ndtri(p)
+    x = jnp.clip(x, low, high)
+
+    nat = jnp.where(logspace, jnp.exp(x), x)
+    qq = jnp.maximum(q, TINY)
+    nat_low = jnp.where(logspace, jnp.exp(low), low)
+    nat_high = jnp.where(logspace, jnp.exp(high), high)
+    rounded = jnp.round(nat / qq) * qq
+    rounded = jnp.clip(
+        rounded,
+        jnp.where(jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low),
+        jnp.where(jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high),
+    )
+    return jnp.where(q > 0, rounded, nat)
+
+
+def trunc_gmm_logpdf(x, weights, mus, sigmas, low, high, logspace, q):
+    """log-density of natural-space samples ``x`` [S] under the truncated
+    (optionally quantized / log-space) GMM with components [K]."""
+    sigmas = jnp.maximum(sigmas, TINY)
+    logw = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
+
+    a = ndtr((low - mus) / sigmas)
+    b = ndtr((high - mus) / sigmas)
+    log_mass = _safe_log(b - a)  # [K]
+
+    lat = jnp.where(logspace, _safe_log(x), x)[:, None]  # [S,1]
+
+    # continuous density
+    z = (lat - mus) / sigmas
+    log_pdf = -0.5 * z * z - jnp.log(sigmas) - 0.5 * jnp.log(2.0 * jnp.pi)
+    jac = jnp.where(logspace, jnp.squeeze(lat, -1), 0.0)  # d(log x)/dx
+    ll_cont = (
+        jax.scipy.special.logsumexp(logw + log_pdf - log_mass, axis=1) - jac
+    )
+
+    # quantized bin mass
+    qq = jnp.maximum(q, TINY)
+    ub_nat = x + qq / 2.0
+    lb_nat = x - qq / 2.0
+    ub_lat = jnp.where(logspace, _safe_log(ub_nat), ub_nat)[:, None]
+    lb_lat = jnp.where(logspace, _safe_log(lb_nat), lb_nat)[:, None]
+    ub_lat = jnp.minimum(ub_lat, high)
+    lb_lat = jnp.maximum(lb_lat, low)
+    bin_mass = ndtr((ub_lat - mus) / sigmas) - ndtr((lb_lat - mus) / sigmas)
+    ll_q = jax.scipy.special.logsumexp(
+        logw + _safe_log(bin_mass) - log_mass, axis=1
+    )
+
+    return jnp.where(q > 0, ll_q, ll_cont)
+
+
+def categorical_fit(obs, mask, prior_p, prior_weight, lf):
+    """Categorical posterior from weighted counts + prior pseudocounts.
+
+    Args:
+      obs: [N] observed category indices (as floats; garbage where masked).
+      mask: [N] bool.
+      prior_p: [K] prior pmf (zero-padded beyond the true cardinality).
+
+    Returns [K] posterior pmf (zero on padded options).  Matches
+    :func:`hyperopt_tpu.tpe.categorical_posterior`.
+    """
+    tw = forgetting_weights(mask, lf)
+    k = prior_p.shape[0]
+    onehot = (obs[:, None] == jnp.arange(k, dtype=obs.dtype)[None, :]).astype(
+        tw.dtype
+    )
+    counts = jnp.sum(onehot * tw[:, None], axis=0)
+    n_options = jnp.sum(prior_p > 0).astype(counts.dtype)
+    pseudo = counts * (prior_p > 0) + prior_weight * prior_p * n_options
+    return pseudo / jnp.maximum(jnp.sum(pseudo), F32_TINY)
+
+
+def split_below_above(losses, valid, gamma, lf):
+    """Good/bad split over the masked loss buffer.
+
+    ``n_below = min(ceil(gamma * sqrt(n_ok)), lf)`` (SURVEY.md SS3.2);
+    ties broken by slot order (reference breaks by tid -- slots are
+    tid-ordered).  Returns (below_mask, above_mask, n_below).
+    """
+    valid = valid & jnp.isfinite(losses)
+    n_ok = jnp.sum(valid.astype(jnp.float32))
+    n_below = jnp.minimum(jnp.ceil(gamma * jnp.sqrt(n_ok)), lf)
+
+    keyed = jnp.where(valid, losses, jnp.inf)
+    order = jnp.argsort(keyed, stable=True)  # stable: slot order breaks ties
+    rank = jnp.argsort(order, stable=True)
+    below = valid & (rank < n_below)
+    above = valid & ~below
+    return below, above, n_below
+
+
+def ei_argmax(samples, ll_below, ll_above):
+    """Factorized EI: the candidate maximizing log l(x) - log g(x)."""
+    score = ll_below - ll_above
+    return samples[jnp.argmax(score)], jnp.max(score)

@@ -1,0 +1,34 @@
+"""Random search via the compiled space sampler (jitted prior draws).
+
+TPU equivalent of :mod:`hyperopt_tpu.rand`: one XLA program draws the whole
+batch (dense values + active masks) instead of interpreting the pyll graph
+per trial (SURVEY.md SS3.3 -> SS7 stance #1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .jax_trials import packed_space_for
+from .rand import docs_from_idxs_vals
+from .tpe_jax import _cast_vals
+from .vectorize import dense_to_idxs_vals
+
+__all__ = ["suggest", "suggest_batch"]
+
+
+def suggest_batch(new_ids, domain, trials, seed):
+    import jax
+
+    ps = packed_space_for(domain)
+    key = jax.random.key(int(seed) % (2**31 - 1))
+    values, active = ps.sample_prior(key, len(new_ids))
+    idxs, vals = dense_to_idxs_vals(
+        new_ids, ps.labels, np.asarray(values), np.asarray(active)
+    )
+    return _cast_vals(ps, idxs, vals)
+
+
+def suggest(new_ids, domain, trials, seed):
+    idxs, vals = suggest_batch(new_ids, domain, trials, seed)
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

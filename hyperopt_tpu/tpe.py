@@ -257,7 +257,7 @@ def LGMM1(weights, mus, sigmas, low=None, high=None, q=None, rng=None, size=()):
     ks = rng.choice(len(weights), size=n, p=weights / weights.sum())
     draws = np.exp(_trunc_normal_sample(rng, mus[ks], sigmas[ks], low, high, (n,)))
     if q is not None:
-        draws = np.maximum(np.round(draws / q) * q, q)
+        draws = np.round(draws / q) * q
     if not size:
         return float(draws[0])
     return draws.reshape(size)

@@ -8,10 +8,30 @@ mesh stands in for a TPU pod slice; sharding/collective tests in
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env may say 'axon'
 _xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _xla_flags:
     os.environ["XLA_FLAGS"] = (
         _xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# If a TPU-tunnel PJRT plugin (e.g. 'axon') was registered by a
+# sitecustomize at interpreter start, jax is already imported and its
+# config may have latched JAX_PLATFORMS=axon -- override the live config
+# and drop the plugin factory so tests run hermetically on the virtual
+# CPU mesh even when the tunnel is wedged.  Safe no-op otherwise.
+try:  # pragma: no cover - environment dependent
+    import sys
+
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass

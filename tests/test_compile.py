@@ -1,0 +1,123 @@
+"""Space-compiler tests: the jitted sampler must agree (statistically and
+structurally) with the host interpreter (SURVEY.md SS7 stance #1)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.exceptions import CompileError
+from hyperopt_tpu.ops.compile import compile_space
+from hyperopt_tpu.vectorize import VectorizeHelper, dense_to_idxs_vals
+
+
+def test_compile_flat_mixed_space():
+    space = {
+        "u": hp.uniform("u", -2, 3),
+        "lu": hp.loguniform("lu", -3, 1),
+        "qu": hp.quniform("qu", 0, 10, 0.5),
+        "n": hp.normal("n", 1.0, 2.0),
+        "ri": hp.randint("ri", 7),
+        "ch": hp.choice("ch", [0, 1, 2]),
+        "pc": hp.pchoice("pc", [(0.2, "a"), (0.8, "b")]),
+    }
+    ps = compile_space(space)
+    assert ps.n_dims == 7
+    assert ps.unconditional
+    v, a = ps.sample_prior(jax.random.key(0), 512)
+    v, a = np.asarray(v), np.asarray(a)
+    assert a.all()  # flat space: everything active
+    lbl = {l: i for i, l in enumerate(ps.labels)}
+    u = v[lbl["u"]]
+    assert u.min() >= -2 and u.max() <= 3
+    assert abs(u.mean() - 0.5) < 0.3
+    lu = v[lbl["lu"]]
+    assert lu.min() >= np.exp(-3) - 1e-6 and lu.max() <= np.exp(1) + 1e-5
+    qu = v[lbl["qu"]]
+    np.testing.assert_allclose(qu, np.round(qu / 0.5) * 0.5, atol=1e-5)
+    ri = v[lbl["ri"]]
+    assert set(np.unique(ri)).issubset(set(range(7)))
+    pc = v[lbl["pc"]]
+    frac_b = (pc == 1).mean()
+    assert 0.7 < frac_b < 0.9  # pchoice respects probabilities
+
+
+def test_compile_randint_low_high_offset():
+    ps = compile_space({"r": hp.randint("r", 5, 9)})
+    v, _ = ps.sample_prior(jax.random.key(1), 256)
+    vals = np.asarray(v)[0]
+    assert set(np.unique(vals)) <= {5.0, 6.0, 7.0, 8.0}
+    assert len(np.unique(vals)) == 4
+
+
+def test_compile_conditional_activity_matches_host_sampler():
+    space = hp.choice(
+        "root",
+        [
+            {"b": "flat", "x": hp.uniform("x_flat", 0, 1)},
+            {
+                "b": "deep",
+                "y": hp.loguniform("y_deep", -3, 0),
+                "sub": hp.choice("sub", [hp.normal("n0", 0, 1), hp.randint("r1", 4)]),
+            },
+        ],
+    )
+    ps = compile_space(space)
+    v, a = ps.sample_prior(jax.random.key(2), 2000)
+    v, a = np.asarray(v), np.asarray(a)
+    lbl = {l: i for i, l in enumerate(ps.labels)}
+    root = v[lbl["root"]]
+    # activity must follow the drawn choices exactly
+    np.testing.assert_array_equal(a[lbl["x_flat"]], root == 0)
+    np.testing.assert_array_equal(a[lbl["y_deep"]], root == 1)
+    np.testing.assert_array_equal(a[lbl["sub"]], root == 1)
+    sub = v[lbl["sub"]]
+    np.testing.assert_array_equal(a[lbl["n0"]], (root == 1) & (sub == 0))
+    np.testing.assert_array_equal(a[lbl["r1"]], (root == 1) & (sub == 1))
+    # branch rates ~ uniform prior
+    assert 0.45 < (root == 0).mean() < 0.55
+
+    # statistical parity with the host interpreter on a shared label
+    helper = VectorizeHelper(space)
+    host_draws = [helper.sample_one(np.random.default_rng(i)) for i in range(500)]
+    host_y = np.array([c["y_deep"] for c in host_draws if "y_deep" in c])
+    jax_y = v[lbl["y_deep"]][a[lbl["y_deep"]]]
+    # same support and similar medians (loguniform -3..0)
+    assert np.exp(-3) <= jax_y.min() and jax_y.max() <= 1.0 + 1e-6
+    assert abs(np.median(np.log(jax_y)) - np.median(np.log(host_y))) < 0.35
+
+
+def test_compile_shared_param_across_branches():
+    shared = hp.uniform("shared", 0, 1)
+    space = hp.choice("c", [{"a": shared}, {"b": shared, "z": hp.normal("z", 0, 1)}])
+    ps = compile_space(space)
+    v, a = ps.sample_prior(jax.random.key(3), 500)
+    a = np.asarray(a)
+    lbl = {l: i for i, l in enumerate(ps.labels)}
+    # shared is active on both branches -> always active
+    assert a[lbl["shared"]].all()
+    np.testing.assert_array_equal(
+        a[lbl["z"]], np.asarray(v)[lbl["c"]] == 1
+    )
+
+
+def test_compile_empty_space_raises():
+    with pytest.raises(CompileError):
+        compile_space({"const": 3})
+
+
+def test_dense_to_sparse_bridge_with_compiled_sampler():
+    space = hp.choice("c", [hp.uniform("x", 0, 1), hp.uniform("y", 5, 6)])
+    ps = compile_space(space)
+    v, a = ps.sample_prior(jax.random.key(4), 8)
+    idxs, vals = dense_to_idxs_vals(range(8), ps.labels, np.asarray(v), np.asarray(a))
+    assert idxs["c"] == list(range(8))
+    assert sorted(idxs["x"] + idxs["y"]) == list(range(8))
+
+
+def test_sample_prior_deterministic():
+    ps = compile_space({"u": hp.uniform("u", 0, 1)})
+    v1, _ = ps.sample_prior(jax.random.key(9), 16)
+    v2, _ = ps.sample_prior(jax.random.key(9), 16)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))

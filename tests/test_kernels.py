@@ -1,0 +1,237 @@
+"""JAX kernel unit tests: parity against the numpy oracle in
+hyperopt_tpu.tpe (SURVEY.md SS7 'parity tests vs numpy oracle')."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import tpe
+from hyperopt_tpu.ops import kernels as K
+
+
+def f32(x):
+    return jnp.asarray(x, dtype=jnp.float32)
+
+
+# -- forgetting weights -----------------------------------------------------
+
+
+@pytest.mark.parametrize("n,lf", [(10, 25), (40, 25), (25, 25), (26, 25)])
+def test_forgetting_weights_match_oracle(n, lf):
+    mask = np.zeros(64, dtype=bool)
+    mask[:n] = True
+    got = np.asarray(K.forgetting_weights(jnp.asarray(mask), float(lf)))
+    want = tpe.linear_forgetting_weights(n, lf)
+    np.testing.assert_allclose(got[:n], want, rtol=1e-5)
+    np.testing.assert_array_equal(got[n:], 0.0)
+
+
+def test_forgetting_weights_masked_slots_skipped():
+    # valid slots interleaved with invalid: ranks follow valid order
+    mask = np.array([True, False, True, True, False])
+    got = np.asarray(K.forgetting_weights(jnp.asarray(mask), 25.0))
+    assert got[1] == 0.0 and got[4] == 0.0
+    np.testing.assert_allclose(got[[0, 2, 3]], np.ones(3), rtol=1e-6)
+
+
+# -- parzen fit -------------------------------------------------------------
+
+
+def parzen_oracle(obs, prior_mu, prior_sigma, prior_weight=1.0, lf=25):
+    return tpe.adaptive_parzen_normal(obs, prior_weight, prior_mu, prior_sigma, lf)
+
+
+def run_parzen_kernel(obs, prior_mu, prior_sigma, prior_weight=1.0, lf=25, cap=32):
+    buf = np.zeros(cap, dtype=np.float32)
+    mask = np.zeros(cap, dtype=bool)
+    buf[: len(obs)] = obs
+    mask[: len(obs)] = True
+    w, m, s = K.parzen_fit(
+        f32(buf), jnp.asarray(mask), f32(prior_mu), f32(prior_sigma),
+        f32(prior_weight), f32(lf),
+    )
+    w, m, s = np.asarray(w), np.asarray(m), np.asarray(s)
+    keep = w > 0
+    return w[keep], m[keep], s[keep]
+
+
+@pytest.mark.parametrize(
+    "obs",
+    [
+        [],
+        [0.5],
+        [0.5, -1.0],
+        [0.1, 0.2, 0.3, 5.0, -3.0],
+        list(np.random.default_rng(0).uniform(-4, 4, size=30)),
+    ],
+)
+def test_parzen_fit_matches_oracle(obs):
+    prior_mu, prior_sigma = 0.0, 8.0
+    ww, wm, ws = parzen_oracle(obs, prior_mu, prior_sigma)
+    gw, gm, gs = run_parzen_kernel(obs, prior_mu, prior_sigma)
+    assert len(gw) == len(ww)
+    np.testing.assert_allclose(gm, wm, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gw, ww, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs, ws, rtol=1e-4, atol=1e-5)
+
+
+def test_parzen_fit_with_forgetting_matches_oracle():
+    rng = np.random.default_rng(1)
+    obs = list(rng.normal(0, 2, size=40))
+    ww, wm, ws = parzen_oracle(obs, 0.0, 5.0, lf=25)
+    gw, gm, gs = run_parzen_kernel(obs, 0.0, 5.0, lf=25, cap=64)
+    np.testing.assert_allclose(gm, wm, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(gw, ww, rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(gs, ws, rtol=1e-4, atol=1e-4)
+
+
+# -- truncated GMM sampling -------------------------------------------------
+
+
+def test_trunc_gmm_sample_bounds_and_distribution():
+    w = f32([0.4, 0.6, 0.0])
+    mu = f32([0.0, 5.0, 0.0])
+    sigma = f32([1.0, 0.7, 1.0])
+    key = jax.random.key(0)
+    draws = np.asarray(
+        K.trunc_gmm_sample(
+            key, w, mu, sigma, f32(-2.0), f32(7.0), jnp.asarray(False),
+            f32(0.0), 20000,
+        )
+    )
+    assert draws.min() >= -2.0 and draws.max() <= 7.0
+    # compare against numpy-oracle draws via KS-ish histogram distance
+    oracle = tpe.GMM1(
+        np.array([0.4, 0.6]), np.array([0.0, 5.0]), np.array([1.0, 0.7]),
+        low=-2.0, high=7.0, rng=np.random.default_rng(0), size=(20000,),
+    )
+    h1, edges = np.histogram(draws, bins=30, range=(-2, 7), density=True)
+    h2, _ = np.histogram(oracle, bins=edges, density=True)
+    assert np.abs(h1 - h2).max() < 0.06
+
+
+def test_trunc_gmm_sample_logspace_quantized():
+    w = f32([1.0])
+    mu = f32([0.0])
+    sigma = f32([1.0])
+    draws = np.asarray(
+        K.trunc_gmm_sample(
+            jax.random.key(1), w, mu, sigma, f32(-1.0), f32(1.0),
+            jnp.asarray(True), f32(0.5), 2000,
+        )
+    )
+    np.testing.assert_allclose(draws, np.round(draws / 0.5) * 0.5, atol=1e-5)
+    assert draws.min() >= 0.0  # rounded exp(-1)=0.368 -> 0.5 grid
+    assert draws.max() <= np.round(np.exp(1.0) / 0.5) * 0.5 + 1e-6
+
+
+# -- GMM lpdf ---------------------------------------------------------------
+
+
+def test_trunc_gmm_logpdf_matches_oracle_continuous():
+    w = np.array([0.3, 0.7])
+    mu = np.array([-1.0, 2.0])
+    sigma = np.array([0.5, 1.5])
+    x = np.linspace(-3, 4, 51)
+    got = np.asarray(
+        K.trunc_gmm_logpdf(
+            f32(x), f32(w), f32(mu), f32(sigma), f32(-jnp.inf), f32(jnp.inf),
+            jnp.asarray(False), f32(0.0),
+        )
+    )
+    want = tpe.GMM1_lpdf(x, w, mu, sigma)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+def test_trunc_gmm_logpdf_matches_oracle_truncated_quantized():
+    w = np.array([0.5, 0.5])
+    mu = np.array([1.0, 8.0])
+    sigma = np.array([2.0, 1.0])
+    x = np.arange(0.0, 11.0, 1.0)
+    got = np.asarray(
+        K.trunc_gmm_logpdf(
+            f32(x), f32(w), f32(mu), f32(sigma), f32(0.0), f32(10.0),
+            jnp.asarray(False), f32(1.0),
+        )
+    )
+    want = tpe.GMM1_lpdf(x, w, mu, sigma, low=0.0, high=10.0, q=1.0)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    assert np.exp(got).sum() == pytest.approx(1.0, abs=1e-4)
+
+
+def test_trunc_gmm_logpdf_matches_oracle_lognormal():
+    w = np.array([0.6, 0.4])
+    mu = np.array([0.0, 1.0])
+    sigma = np.array([0.5, 0.3])
+    x = np.linspace(0.1, 10.0, 40)
+    got = np.asarray(
+        K.trunc_gmm_logpdf(
+            f32(x), f32(w), f32(mu), f32(sigma), f32(-jnp.inf), f32(jnp.inf),
+            jnp.asarray(True), f32(0.0),
+        )
+    )
+    want = tpe.LGMM1_lpdf(x, w, mu, sigma)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+# -- categorical fit --------------------------------------------------------
+
+
+def test_categorical_fit_matches_oracle():
+    obs = [2, 2, 0, 1, 2, 2]
+    prior = np.array([0.25, 0.25, 0.5])
+    cap = 16
+    buf = np.zeros(cap, dtype=np.float32)
+    mask = np.zeros(cap, dtype=bool)
+    buf[: len(obs)] = obs
+    mask[: len(obs)] = True
+    got = np.asarray(
+        K.categorical_fit(f32(buf), jnp.asarray(mask), f32(prior), f32(1.0), f32(25.0))
+    )
+    want = tpe.categorical_posterior(obs, prior, 1.0, 25)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_categorical_fit_padded_options_zero():
+    prior = np.array([0.5, 0.5, 0.0, 0.0])  # 2 real options, 2 padded
+    got = np.asarray(
+        K.categorical_fit(
+            f32(np.zeros(8)), jnp.asarray(np.zeros(8, bool)), f32(prior),
+            f32(1.0), f32(25.0),
+        )
+    )
+    assert got[2] == 0.0 and got[3] == 0.0
+    np.testing.assert_allclose(got[:2], [0.5, 0.5], rtol=1e-6)
+
+
+# -- below/above split ------------------------------------------------------
+
+
+def test_split_below_above_counts_and_membership():
+    losses = np.array([5.0, 1.0, 3.0, 2.0, 4.0, np.nan, 9.0, 0.5], np.float32)
+    valid = np.array([True, True, True, True, True, True, True, False])
+    below, above, n_below = K.split_below_above(
+        jnp.asarray(losses), jnp.asarray(valid), 0.25, 25.0
+    )
+    below, above = np.asarray(below), np.asarray(above)
+    n_ok = 6  # nan and invalid excluded
+    want_n_below = min(int(np.ceil(0.25 * np.sqrt(n_ok))), 25)
+    assert below.sum() == want_n_below
+    assert not below[5] and not above[5]  # nan masked
+    assert not below[7] and not above[7]  # invalid masked
+    assert below[1]  # loss 1.0 is the best valid
+    assert below.sum() + above.sum() == n_ok
+
+
+def test_split_matches_numpy_filter():
+    rng = np.random.default_rng(0)
+    losses = rng.uniform(0, 1, 30).astype(np.float32)
+    valid = np.ones(30, dtype=bool)
+    below, above, _ = K.split_below_above(
+        jnp.asarray(losses), jnp.asarray(valid), 0.25, 25.0
+    )
+    n_below = int(np.asarray(below).sum())
+    want_below_idx = set(np.argsort(losses, kind="stable")[:n_below])
+    assert set(np.nonzero(np.asarray(below))[0]) == want_below_idx
