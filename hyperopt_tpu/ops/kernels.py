@@ -28,7 +28,60 @@ __all__ = [
     "categorical_fit",
     "split_below_above",
     "ei_argmax",
+    "ei_best_cont",
+    "ei_best_cat",
+    "fit_all_dims",
 ]
+
+
+def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weight):
+    """Shared front half of a TPE suggest step: good/bad split + vmapped
+    Parzen/categorical fits for every dimension.
+
+    Args mirror the ObsBuffer arrays; ``ps_consts`` is PackedSpace._consts.
+    Returns a dict with continuous fits (wb/mb/sb/wa/ma/sa: [Dc, cap+1])
+    and categorical posteriors (pb/pa: [Dk, k_max]); entries are None for
+    absent families.
+    """
+    below, above, _ = split_below_above(losses, valid, gamma, lf)
+    out = {"cont": None, "cat": None}
+
+    cont_idx = ps_consts["cont_idx"]
+    if cont_idx.shape[0]:
+        obs_c = values[cont_idx]
+        lat = jnp.where(
+            ps_consts["logspace"][:, None],
+            _safe_log(obs_c),
+            obs_c,
+        )
+        act_c = active[cont_idx]
+        dc = cont_idx.shape[0]
+        pw_v = jnp.full((dc,), prior_weight, dtype=jnp.float32)
+        lf_v = jnp.full((dc,), lf, dtype=jnp.float32)
+        fit = jax.vmap(parzen_fit)
+        wb, mb, sb = fit(
+            lat, act_c & below[None, :],
+            ps_consts["prior_mu"], ps_consts["prior_sigma"], pw_v, lf_v,
+        )
+        wa, ma, sa = fit(
+            lat, act_c & above[None, :],
+            ps_consts["prior_mu"], ps_consts["prior_sigma"], pw_v, lf_v,
+        )
+        out["cont"] = (wb, mb, sb, wa, ma, sa)
+
+    cat_idx = ps_consts["cat_idx"]
+    if cat_idx.shape[0]:
+        obs_k = values[cat_idx] - ps_consts["int_low"][:, None]
+        act_k = active[cat_idx]
+        dk = cat_idx.shape[0]
+        pw_v = jnp.full((dk,), prior_weight, dtype=jnp.float32)
+        lf_v = jnp.full((dk,), lf, dtype=jnp.float32)
+        cfit = jax.vmap(categorical_fit)
+        pb = cfit(obs_k, act_k & below[None, :], ps_consts["prior_p"], pw_v, lf_v)
+        pa = cfit(obs_k, act_k & above[None, :], ps_consts["prior_p"], pw_v, lf_v)
+        out["cat"] = (pb, pa)
+
+    return out
 
 TINY = 1e-12
 F32_TINY = 1e-30
@@ -225,3 +278,22 @@ def ei_argmax(samples, ll_below, ll_above):
     """Factorized EI: the candidate maximizing log l(x) - log g(x)."""
     score = ll_below - ll_above
     return samples[jnp.argmax(score)], jnp.max(score)
+
+
+def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand):
+    """One continuous dim: draw n_cand from the below-model, score the EI
+    log-likelihood ratio, return (best value, best score)."""
+    samples = trunc_gmm_sample(key, wb, mb, sb, low, high, logspace, q, n_cand)
+    ll_b = trunc_gmm_logpdf(samples, wb, mb, sb, low, high, logspace, q)
+    ll_a = trunc_gmm_logpdf(samples, wa, ma, sa, low, high, logspace, q)
+    return ei_argmax(samples, ll_b, ll_a)
+
+
+def ei_best_cat(key, p_below, p_above, n_cand):
+    """One categorical dim: draw candidate categories from the below
+    posterior, score log p_b - log p_a, return (best index, best score)."""
+    logits = jnp.where(p_below > 0, _safe_log(p_below), -jnp.inf)
+    cands = jax.random.categorical(key, logits, shape=(n_cand,))
+    llr = _safe_log(p_below[cands]) - _safe_log(p_above[cands])
+    best = jnp.argmax(llr)
+    return cands[best].astype(jnp.float32), llr[best]

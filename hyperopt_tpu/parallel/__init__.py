@@ -1,0 +1,26 @@
+"""Device-mesh parallelism for suggest steps.
+
+The TPU-native replacement for the reference's distributed story
+(SURVEY.md SS2 'parallelism-strategy checklist' and SS5): candidate
+batches shard across a ``jax.sharding.Mesh`` with ``shard_map``; the EI
+argmax reduces over ICI collectives (``pmax``-style all-gather + argmax);
+multi-host runs ride ``jax.distributed`` over DCN
+(:mod:`hyperopt_tpu.parallel.multihost`).  Trial-level task farming (the
+MongoDB role) lives in :mod:`hyperopt_tpu.distributed`.
+"""
+
+from . import multihost
+from .mesh import CAND_AXIS, TRIAL_AXIS, default_mesh, device_count, mesh_from_spec
+from .sharded import build_sharded_suggest_fn, sharded_suggest, suggest
+
+__all__ = [
+    "CAND_AXIS",
+    "TRIAL_AXIS",
+    "default_mesh",
+    "device_count",
+    "mesh_from_spec",
+    "build_sharded_suggest_fn",
+    "sharded_suggest",
+    "suggest",
+    "multihost",
+]

@@ -1,0 +1,48 @@
+"""Mesh construction helpers.
+
+One place decides how devices are arranged; everything else takes a Mesh.
+Axis conventions:
+  ``cand``  -- candidate-batch sharding (the throughput axis; rides ICI)
+  ``trial`` -- trial-batch sharding for population evaluation (data-ish)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["default_mesh", "device_count", "mesh_from_spec", "CAND_AXIS", "TRIAL_AXIS"]
+
+CAND_AXIS = "cand"
+TRIAL_AXIS = "trial"
+
+
+def device_count():
+    import jax
+
+    return jax.device_count()
+
+
+def default_mesh(axis_name=CAND_AXIS, devices=None):
+    """1-D mesh over all (or given) devices -- the workhorse for candidate
+    sharding; a v4-8 slice becomes ``Mesh([8], ('cand',))``."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def mesh_from_spec(shape, axis_names, devices=None):
+    """N-D mesh, e.g. ``mesh_from_spec((2, 4), ('trial', 'cand'))`` to split
+    a slice between trial-batch and candidate-batch parallelism."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = int(np.prod(shape))
+    if n > len(devices):
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    arr = np.asarray(devices[:n]).reshape(shape)
+    return Mesh(arr, tuple(axis_names))

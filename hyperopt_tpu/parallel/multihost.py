@@ -1,0 +1,93 @@
+"""Multi-host (DCN) coordination.
+
+TPU-native counterpart of the reference's multi-machine execution
+(SURVEY.md SS5 'distributed communication backend'): instead of a MongoDB
+queue between processes, all hosts join one ``jax.distributed`` runtime;
+the sharded suggest program spans every host's devices (collectives ride
+ICI within a slice and DCN across slices), and suggested configs are
+replicated to every host with a one-to-all broadcast so each host
+evaluates its share of trials.
+
+Single-process degenerates gracefully: ``initialize()`` is a no-op,
+``broadcast_configs`` is identity, ``process_index() == 0``.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "initialize",
+    "is_multihost",
+    "process_index",
+    "process_count",
+    "broadcast_configs",
+    "shard_ids_for_host",
+]
+
+
+def initialize(coordinator_address=None, num_processes=None, process_id=None):
+    """Join the jax.distributed runtime (no-op when single-process or
+    already initialized)."""
+    import jax
+
+    if num_processes is None or num_processes <= 1:
+        return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        return True
+    except RuntimeError as e:  # already initialized
+        logger.warning("jax.distributed.initialize: %s", e)
+        return False
+
+
+def is_multihost():
+    import jax
+
+    return jax.process_count() > 1
+
+
+def process_index():
+    import jax
+
+    return jax.process_index()
+
+
+def process_count():
+    import jax
+
+    return jax.process_count()
+
+
+def broadcast_configs(values, active):
+    """Replicate a suggested dense batch from process 0 to all hosts.
+
+    Ensures every host materializes identical trial docs without a
+    host-side queue (the Mongo role for config distribution).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return values, active
+    from jax.experimental import multihost_utils
+
+    values = multihost_utils.broadcast_one_to_all(values)
+    active = multihost_utils.broadcast_one_to_all(active)
+    return values, active
+
+
+def shard_ids_for_host(new_ids, index=None, count=None):
+    """Round-robin split of a trial-id batch across hosts: each host
+    evaluates ``new_ids[process_index::process_count]`` (trial-level
+    farming across slices for expensive objectives)."""
+    if index is None:
+        index = process_index()
+    if count is None:
+        count = process_count()
+    return list(new_ids)[index::count]

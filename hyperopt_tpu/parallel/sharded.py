@@ -1,0 +1,198 @@
+"""Mesh-sharded TPE suggest: candidate batches split across devices.
+
+The scale story of the TPU design (SURVEY.md SS5/SS7 stance #4 and the
+BASELINE.json north star): Parzen fits are tiny and replicated; the
+expensive part -- drawing and scoring ``n_EI_candidates`` per
+hyperparameter -- shards over the ``cand`` mesh axis with ``shard_map``.
+Each device draws an independent candidate slab (key folded by
+``lax.axis_index``), scores it locally, and emits its local argmax; the
+global EI winner is reduced over the gathered per-device bests (an
+argmax-allgather over ICI).  Total candidates per dim =
+``n_cand_per_device * mesh.size``.
+
+On a single device this degenerates to exactly :mod:`hyperopt_tpu.tpe_jax`
+semantics with one shard.  Multi-host: build the mesh over
+``jax.devices()`` after ``jax.distributed.initialize`` (see
+:mod:`hyperopt_tpu.parallel.multihost`) and the same program spans DCN.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..jax_trials import obs_buffer_for, packed_space_for
+from ..rand import docs_from_idxs_vals
+from ..vectorize import dense_to_idxs_vals
+from .mesh import CAND_AXIS, default_mesh
+
+__all__ = ["build_sharded_suggest_fn", "sharded_suggest", "suggest"]
+
+
+def _shard_map():
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map
+    from jax.experimental.shard_map import shard_map as sm  # pragma: no cover
+
+    return sm
+
+
+def build_sharded_suggest_fn(
+    ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS
+):
+    """Compile the mesh-sharded TPE step for a PackedSpace.
+
+    Returns jitted ``fn(key, values, active, losses, valid, batch)`` like
+    :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`, with the candidate sweep
+    sharded over ``axis`` of ``mesh``.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import kernels as K
+
+    c = ps._consts
+    D = ps.n_dims
+    Dc = len(ps.cont_idx)
+    Dk = len(ps.cat_idx)
+    n_dev = int(mesh.shape[axis])
+    gamma = float(gamma)
+    lf_f = float(lf)
+    pw = float(prior_weight)
+    smap = _shard_map()
+
+    # Per-shard program: every input replicated; each device draws its own
+    # candidate slab and returns its local winner per (trial, dim).
+    def _local_ei(key, wb, mb, sb, wa, ma, sa, pb, pa, batch):
+        di = jax.lax.axis_index(axis)
+        dev_key = jax.random.fold_in(key, di)
+        keys = jax.random.split(dev_key, max(batch * (Dc + Dk), 1))
+
+        out_vals = []
+        out_scores = []
+        if Dc:
+            cont_keys = keys[: batch * Dc].reshape(batch, Dc)
+            per_dim = jax.vmap(
+                lambda k, *a: K.ei_best_cont(k, *a, n_cand=n_cand_per_device),
+                in_axes=(0,) * 11,
+            )
+            per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
+            v, s = per_batch(
+                cont_keys, wb, mb, sb, wa, ma, sa,
+                c["low"], c["high"], c["logspace"], c["q"],
+            )  # [B, Dc] each
+            out_vals.append(v)
+            out_scores.append(s)
+        if Dk:
+            cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
+            per_cat = jax.vmap(
+                lambda k, b, a: K.ei_best_cat(k, b, a, n_cand=n_cand_per_device),
+                in_axes=(0, 0, 0),
+            )
+            per_batch_cat = jax.vmap(per_cat, in_axes=(0, None, None))
+            v, s = per_batch_cat(cat_keys, pb, pa)  # [B, Dk]
+            out_vals.append(v)
+            out_scores.append(s)
+        vals = jnp.concatenate(out_vals, axis=1)  # [B, Dc+Dk]
+        scores = jnp.concatenate(out_scores, axis=1)
+        return vals[None], scores[None]  # leading shard axis
+
+    def fn(key, values, active, losses, valid, batch):
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        zc = jnp.zeros((0,), jnp.float32)
+        wb, mb, sb, wa, ma, sa = fits["cont"] or (zc,) * 6
+        pb, pa = fits["cat"] or (zc, zc)
+
+        local = smap(
+            functools.partial(_local_ei, batch=batch),
+            mesh=mesh,
+            in_specs=(P(),) * 9,
+            out_specs=(P(axis), P(axis)),
+            check_vma=False,
+        )
+        vals_all, scores_all = local(key, wb, mb, sb, wa, ma, sa, pb, pa)
+        # [n_dev, B, Dc+Dk]: global EI winner per (trial, dim)
+        win = jnp.argmax(scores_all, axis=0)  # [B, Dc+Dk]
+        best = jnp.take_along_axis(vals_all, win[None], axis=0)[0]  # [B, Dc+Dk]
+
+        new_values = jnp.zeros((D, batch), dtype=jnp.float32)
+        if Dc:
+            new_values = new_values.at[c["cont_idx"]].set(best[:, :Dc].T)
+        if Dk:
+            new_values = new_values.at[c["cat_idx"]].set(
+                best[:, Dc:].T + c["int_low"][:, None]
+            )
+        return new_values, ps.active_fn(new_values)
+
+    return jax.jit(fn, static_argnames=("batch",))
+
+
+# ---------------------------------------------------------------------------
+# drop-in suggest using a default all-devices mesh
+# ---------------------------------------------------------------------------
+
+_default_n_EI_per_device = 64
+_default_gamma = 0.25
+_default_n_startup_jobs = 20
+_default_linear_forgetting = 25
+_default_prior_weight = 1.0
+
+
+def sharded_suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    mesh=None,
+    n_EI_per_device=_default_n_EI_per_device,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+):
+    """``algo=parallel.sharded_suggest``: TPE with the candidate sweep
+    sharded over every visible device."""
+    import jax
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    B = len(new_ids)
+    key = jax.random.key(int(seed) % (2**31 - 1))
+
+    if buf.count < n_startup_jobs:
+        values, active = ps.sample_prior(key, B)
+    else:
+        if mesh is None:
+            mesh = getattr(domain, "_tpe_mesh", None)
+            if mesh is None:
+                mesh = default_mesh()
+                domain._tpe_mesh = mesh
+        cache = getattr(domain, "_sharded_tpe_cache", None)
+        if cache is None:
+            cache = {}
+            domain._sharded_tpe_cache = cache
+        ck = (id(ps), id(mesh), n_EI_per_device, gamma, linear_forgetting,
+              prior_weight)
+        fn = cache.get(ck)
+        if fn is None:
+            fn = build_sharded_suggest_fn(
+                ps, mesh, int(n_EI_per_device), float(gamma),
+                float(linear_forgetting), float(prior_weight),
+            )
+            cache[ck] = fn
+        values, active = fn(key, *buf.arrays(), batch=B)
+
+    from ..tpe_jax import _cast_vals
+
+    idxs, vals = dense_to_idxs_vals(
+        new_ids, ps.labels, np.asarray(values), np.asarray(active)
+    )
+    idxs, vals = _cast_vals(ps, idxs, vals)
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
+
+
+suggest = sharded_suggest

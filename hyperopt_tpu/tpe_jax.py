@@ -60,75 +60,38 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
     lf_f = float(lf)
     pw = float(prior_weight)
 
-    def _per_cont_dim(key_d, wb, mb, sb, wa, ma, sa, low, high, logsp, q):
-        samples = K.trunc_gmm_sample(key_d, wb, mb, sb, low, high, logsp, q, n_cand)
-        ll_b = K.trunc_gmm_logpdf(samples, wb, mb, sb, low, high, logsp, q)
-        ll_a = K.trunc_gmm_logpdf(samples, wa, ma, sa, low, high, logsp, q)
-        val, _ = K.ei_argmax(samples, ll_b, ll_a)
-        return val
-
-    def _per_cat_dim(key_d, pb, pa):
-        logits = jnp.where(pb > 0, jnp.log(jnp.maximum(pb, 1e-30)), -jnp.inf)
-        cands = jax.random.categorical(key_d, logits, shape=(n_cand,))
-        llr = jnp.log(jnp.maximum(pb[cands], 1e-30)) - jnp.log(
-            jnp.maximum(pa[cands], 1e-30)
-        )
-        return cands[jnp.argmax(llr)]
-
     def fn(key, values, active, losses, valid, batch):
-        below, above, _ = K.split_below_above(losses, valid, gamma, lf_f)
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
         new_values = jnp.zeros((D, batch), dtype=jnp.float32)
 
         n_keys = batch * (Dc + Dk)
         keys = jax.random.split(key, max(n_keys, 1))
 
-        if Dc:
-            obs_c = values[c["cont_idx"]]  # [Dc, cap] natural space
-            lat = jnp.where(
-                c["logspace"][:, None],
-                jnp.log(jnp.maximum(obs_c, 1e-30)),
-                obs_c,
-            )
-            act_c = active[c["cont_idx"]]
-            below_c = act_c & below[None, :]
-            above_c = act_c & above[None, :]
-            pw_v = jnp.full((Dc,), pw, dtype=jnp.float32)
-            lf_v = jnp.full((Dc,), lf_f, dtype=jnp.float32)
-            fit = jax.vmap(K.parzen_fit)
-            wb, mb, sb = fit(lat, below_c, c["prior_mu"], c["prior_sigma"], pw_v, lf_v)
-            wa, ma, sa = fit(lat, above_c, c["prior_mu"], c["prior_sigma"], pw_v, lf_v)
-
+        if fits["cont"] is not None:
+            wb, mb, sb, wa, ma, sa = fits["cont"]
             cont_keys = keys[: batch * Dc].reshape(batch, Dc)
             per_dim = jax.vmap(
-                _per_cont_dim, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0)
+                lambda k, *a: K.ei_best_cont(k, *a, n_cand=n_cand)[0],
+                in_axes=(0,) * 11,
             )
-            per_batch = jax.vmap(
-                per_dim,
-                in_axes=(0,) + (None,) * 10,
-            )
+            per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
             cont_vals = per_batch(
                 cont_keys, wb, mb, sb, wa, ma, sa,
                 c["low"], c["high"], c["logspace"], c["q"],
             )  # [B, Dc]
             new_values = new_values.at[c["cont_idx"]].set(cont_vals.T)
 
-        if Dk:
-            obs_k = values[c["cat_idx"]] - c["int_low"][:, None]
-            act_k = active[c["cat_idx"]]
-            below_k = act_k & below[None, :]
-            above_k = act_k & above[None, :]
-            pw_v = jnp.full((Dk,), pw, dtype=jnp.float32)
-            lf_v = jnp.full((Dk,), lf_f, dtype=jnp.float32)
-            cfit = jax.vmap(K.categorical_fit)
-            pb = cfit(obs_k, below_k, c["prior_p"], pw_v, lf_v)
-            pa = cfit(obs_k, above_k, c["prior_p"], pw_v, lf_v)
-
+        if fits["cat"] is not None:
+            pb, pa = fits["cat"]
             cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            per_cat = jax.vmap(_per_cat_dim, in_axes=(0, 0, 0))
+            per_cat = jax.vmap(
+                lambda k, b, a: K.ei_best_cat(k, b, a, n_cand=n_cand)[0],
+                in_axes=(0, 0, 0),
+            )
             per_batch_cat = jax.vmap(per_cat, in_axes=(0, None, None))
             cat_vals = per_batch_cat(cat_keys, pb, pa)  # [B, Dk]
             new_values = new_values.at[c["cat_idx"]].set(
-                cat_vals.T.astype(jnp.float32) + c["int_low"][:, None]
+                cat_vals.T + c["int_low"][:, None]
             )
 
         return new_values, ps.active_fn(new_values)

@@ -1,0 +1,104 @@
+"""Sharded-suggest tests on the virtual 8-device CPU mesh (SURVEY.md SS4:
+run the real thing small -- xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.parallel import (
+    default_mesh,
+    device_count,
+    mesh_from_spec,
+    multihost,
+    sharded_suggest,
+)
+
+
+def test_virtual_mesh_has_8_devices():
+    assert device_count() == 8
+
+
+def test_default_mesh_shape():
+    mesh = default_mesh()
+    assert mesh.shape == {"cand": 8}
+
+
+def test_mesh_from_spec_2d():
+    mesh = mesh_from_spec((2, 4), ("trial", "cand"))
+    assert mesh.shape == {"trial": 2, "cand": 4}
+    with pytest.raises(ValueError):
+        mesh_from_spec((4, 4), ("trial", "cand"))
+
+
+def test_sharded_suggest_end_to_end():
+    trials = Trials()
+    best = fmin(
+        lambda x: (x - 3.0) ** 2,
+        hp.uniform("x", -10, 10),
+        algo=sharded_suggest,
+        max_evals=45,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    assert len(trials) == 45
+    assert trials.best_trial["result"]["loss"] < 2.5
+
+
+def test_sharded_suggest_mixed_conditional_space():
+    space = hp.choice(
+        "c",
+        [
+            {"kind": "a", "lr": hp.loguniform("lr_a", -5, 0)},
+            {"kind": "b", "x": hp.uniform("x_b", 0, 1), "n": hp.randint("n_b", 5)},
+        ],
+    )
+
+    def obj(cfg):
+        return cfg["lr"] if cfg["kind"] == "a" else cfg["x"]
+
+    trials = Trials()
+    fmin(
+        obj, space, algo=sharded_suggest, max_evals=40, trials=trials,
+        rstate=np.random.default_rng(1), show_progressbar=False,
+    )
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        if vals["c"][0] == 0:
+            assert vals["lr_a"] and not vals["x_b"]
+        else:
+            assert vals["x_b"] and vals["n_b"]
+    assert np.isfinite(trials.best_trial["result"]["loss"])
+
+
+def test_sharded_matches_unsharded_quality():
+    """Sharded and unsharded TPE should reach comparable losses (same
+    algorithm, more candidates)."""
+    from hyperopt_tpu import tpe_jax
+
+    def run(algo):
+        trials = Trials()
+        fmin(
+            lambda x: (x - 3.0) ** 2, hp.uniform("x", -10, 10), algo=algo,
+            max_evals=60, trials=trials, rstate=np.random.default_rng(2),
+            show_progressbar=False,
+        )
+        return trials.best_trial["result"]["loss"]
+
+    sharded_loss = run(sharded_suggest)
+    unsharded_loss = run(tpe_jax.suggest)
+    assert sharded_loss < 1.0
+    assert unsharded_loss < 1.0
+
+
+def test_multihost_single_process_degenerates():
+    assert not multihost.is_multihost()
+    assert multihost.process_index() == 0
+    assert multihost.process_count() == 1
+    v = np.ones((2, 3))
+    a = np.ones((2, 3), bool)
+    v2, a2 = multihost.broadcast_configs(v, a)
+    np.testing.assert_array_equal(np.asarray(v2), v)
+    assert multihost.shard_ids_for_host([1, 2, 3, 4], 0, 2) == [1, 3]
+    assert multihost.shard_ids_for_host([1, 2, 3, 4], 1, 2) == [2, 4]
+    assert multihost.initialize() is False
