@@ -1,0 +1,39 @@
+"""Distributed / asynchronous execution backends (layer L5).
+
+Capability parity with the reference's trial-level task farming
+(SURVEY.md SS2 rows 'Mongo backend' / 'Spark backend', SS3.4-3.5), built
+for the environments a TPU framework actually runs in:
+
+``threads``   -- ``ThreadTrials``: in-process thread-pool evaluation with a
+                 parallelism cap, timeout and cancellation (the SparkTrials
+                 control-flow without a Spark dependency).
+``filequeue`` -- ``FileTrials`` + ``hyperopt-tpu-worker``: a shared-
+                 filesystem job queue with atomic (rename-based) job
+                 reservation, reserve-timeout reaping, pickled-Domain
+                 shipping and ERROR-state capture -- the MongoDB work-queue
+                 role on the NFS/GCS-FUSE mounts TPU pods already have.
+``mongo``     -- ``MongoTrials``: the reference's MongoDB protocol (CAS
+                 reservation via find_one_and_modify, GridFS attachments);
+                 requires pymongo, import-gated.
+``spark``     -- ``SparkTrials``: dispatcher-thread + one-task Spark jobs;
+                 requires pyspark, import-gated.
+"""
+
+from .threads import ThreadTrials
+from .filequeue import FileTrials, FileJobQueue
+
+__all__ = ["ThreadTrials", "FileTrials", "FileJobQueue"]
+
+
+def __getattr__(name):
+    import importlib
+
+    if name in ("mongo", "MongoTrials"):
+        mod = importlib.import_module(".mongo", __name__)
+        globals()["mongo"] = mod
+        return mod if name == "mongo" else mod.MongoTrials
+    if name in ("spark", "SparkTrials"):
+        mod = importlib.import_module(".spark", __name__)
+        globals()["spark"] = mod
+        return mod if name == "spark" else mod.SparkTrials
+    raise AttributeError(name)

@@ -1,0 +1,260 @@
+"""FileTrials: a shared-filesystem job queue with atomic reservation.
+
+The MongoDB-backend role of the reference (SURVEY.md SS3.4) rebuilt on the
+substrate TPU pods actually share -- a common filesystem (NFS / GCS FUSE):
+
+* the queue is a directory; a trial is one JSON file;
+* reservation NEW -> RUNNING is an atomic ``os.rename`` into ``running/``
+  (exactly one worker wins; the loser gets ENOENT) -- the CAS;
+* the ``Domain`` ships to workers as a pickled attachment file;
+* dead workers are reaped by mtime: ``running/`` entries older than
+  ``reserve_timeout`` are renamed back into ``new/`` (the
+  ``--reserve-timeout`` story, SURVEY.md SS5 failure detection);
+* results land in ``done/`` via write-tmp-then-rename (atomic publish);
+  exceptions produce ERROR-state docs with the traceback attached.
+
+Run workers with ``python -m hyperopt_tpu.distributed.worker --dir DIR``
+(or the ``hyperopt-tpu-worker`` console script).
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import socket
+import time
+
+from ..base import JOB_STATE_DONE, JOB_STATE_ERROR, JOB_STATE_NEW, JOB_STATE_RUNNING, Trials
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["FileJobQueue", "FileTrials", "FileAttachments"]
+
+
+def _encode(obj):
+    if isinstance(obj, datetime.datetime):
+        return {"__dt__": obj.isoformat()}
+    raise TypeError(f"not JSON serializable: {type(obj)}")
+
+
+def _decode(d):
+    if "__dt__" in d:
+        return datetime.datetime.fromisoformat(d["__dt__"])
+    return d
+
+
+def _write_atomic(path, payload):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, default=_encode)
+        f.flush()
+        os.fsync(f.fileno())
+    os.rename(tmp, path)
+
+
+def _read_json(path):
+    with open(path) as f:
+        return json.load(f, object_hook=_decode)
+
+
+class FileAttachments:
+    """Dict-like binary attachment store backed by a directory."""
+
+    def __init__(self, root):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key):
+        safe = "".join(c if c.isalnum() or c in "._-" else "_" for c in str(key))
+        return os.path.join(self.root, safe)
+
+    def __contains__(self, key):
+        return os.path.exists(self._path(key))
+
+    def __getitem__(self, key):
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            raise KeyError(key)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        path = self._path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(value)
+        os.rename(tmp, path)
+
+    def __delitem__(self, key):
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            raise KeyError(key)
+
+    def keys(self):
+        return os.listdir(self.root)
+
+
+class FileJobQueue:
+    """The queue protocol: reserve / complete / reap over a directory."""
+
+    def __init__(self, root):
+        self.root = os.path.abspath(root)
+        for sub in ("new", "running", "done"):
+            os.makedirs(os.path.join(self.root, sub), exist_ok=True)
+        self.attachments = FileAttachments(os.path.join(self.root, "attachments"))
+
+    def _p(self, sub, name=""):
+        return os.path.join(self.root, sub, name)
+
+    # -- driver side -------------------------------------------------------
+    def publish(self, doc):
+        _write_atomic(self._p("new", f"{doc['tid']}.json"), doc)
+
+    def done_docs(self):
+        out = {}
+        for name in os.listdir(self._p("done")):
+            if not name.endswith(".json"):
+                continue
+            try:
+                doc = _read_json(self._p("done", name))
+            except (json.JSONDecodeError, OSError):
+                continue  # mid-write by a worker on a non-atomic FS
+            out[doc["tid"]] = doc
+        return out
+
+    def counts(self):
+        return {
+            sub: len([n for n in os.listdir(self._p(sub)) if n.endswith(".json")])
+            for sub in ("new", "running", "done")
+        }
+
+    # -- worker side -------------------------------------------------------
+    def reserve(self, owner, exp_key=None):
+        """Atomically claim one NEW job; None if queue empty/raced away."""
+        names = sorted(n for n in os.listdir(self._p("new")) if n.endswith(".json"))
+        for name in names:
+            src = self._p("new", name)
+            dst = self._p("running", name)
+            try:
+                doc = _read_json(src)
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
+            if exp_key is not None and doc.get("exp_key") != exp_key:
+                continue
+            try:
+                os.rename(src, dst)  # the CAS: exactly one winner
+            except FileNotFoundError:
+                continue  # another worker won this job
+            doc["state"] = JOB_STATE_RUNNING
+            doc["owner"] = owner
+            doc["book_time"] = coarse_utcnow()
+            _write_atomic(dst, doc)
+            return doc
+        return None
+
+    def complete(self, doc):
+        """Publish a finished (DONE or ERROR) doc and release the claim."""
+        doc["refresh_time"] = coarse_utcnow()
+        _write_atomic(self._p("done", f"{doc['tid']}.json"), doc)
+        try:
+            os.unlink(self._p("running", f"{doc['tid']}.json"))
+        except FileNotFoundError:
+            pass
+
+    def reap(self, reserve_timeout):
+        """Return RUNNING jobs older than reserve_timeout to NEW (crashed
+        or wedged workers lose their claim)."""
+        if reserve_timeout is None:
+            return 0
+        now = time.time()
+        reaped = 0
+        for name in os.listdir(self._p("running")):
+            if not name.endswith(".json"):
+                continue
+            path = self._p("running", name)
+            try:
+                age = now - os.path.getmtime(path)
+            except FileNotFoundError:
+                continue
+            if age < reserve_timeout:
+                continue
+            try:
+                doc = _read_json(path)
+            except (FileNotFoundError, json.JSONDecodeError):
+                continue
+            doc["state"] = JOB_STATE_NEW
+            doc["owner"] = None
+            doc["book_time"] = None
+            try:
+                os.rename(path, self._p("new", name))
+            except FileNotFoundError:
+                continue
+            _write_atomic(self._p("new", name), doc)
+            reaped += 1
+            logger.warning("reaped stale job %s (age %.0fs)", name, age)
+        return reaped
+
+
+class FileTrials(Trials):
+    """Async Trials over a :class:`FileJobQueue` directory.
+
+    Use with fmin exactly like MongoTrials in the reference::
+
+        trials = FileTrials("/shared/exp1", exp_key="exp1")
+        fmin(fn, space, algo=tpe_jax.suggest, max_evals=500, trials=trials)
+
+    while N workers run ``hyperopt-tpu-worker --dir /shared/exp1``.
+    """
+
+    asynchronous = True
+
+    def __init__(self, dirpath, exp_key=None, reserve_timeout=120.0, refresh=True):
+        self.queue = FileJobQueue(dirpath)
+        self.reserve_timeout = reserve_timeout
+        super().__init__(exp_key=exp_key, refresh=False)
+        self.attachments = self.queue.attachments
+        if refresh:
+            self.refresh()
+
+    def _insert_trial_docs(self, docs):
+        tids = super()._insert_trial_docs(docs)
+        for doc in docs:
+            self.queue.publish(doc)
+        return tids
+
+    def refresh(self):
+        done = self.queue.done_docs()
+        for trial in self._dynamic_trials:
+            upd = done.get(trial["tid"])
+            if upd is not None and trial["state"] not in (
+                JOB_STATE_DONE, JOB_STATE_ERROR,
+            ):
+                trial.update(upd)
+        self.queue.reap(self.reserve_timeout)
+        super().refresh()
+
+    def count_by_state_unsynced(self, arg):
+        self.refresh()
+        return super().count_by_state_unsynced(arg)
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["queue"] = self.queue.root
+        state["attachments"] = None
+        return state
+
+    def __setstate__(self, state):
+        root = state.pop("queue")
+        self.__dict__.update(state)
+        self.queue = FileJobQueue(root)
+        self.attachments = self.queue.attachments
+
+
+def worker_owner():
+    return f"{socket.gethostname()}:{os.getpid()}"

@@ -1,0 +1,271 @@
+"""MongoTrials: the reference's MongoDB work-queue protocol.
+
+Capability parity with ``hyperopt/mongoexp.py`` (SURVEY.md SS2/SS3.4):
+trials collection as the queue, atomic NEW->RUNNING reservation via a
+compare-and-swap ``find_one_and_update`` on ``owner``, pickled Domain in
+GridFS, DONE/ERROR result writeback, reserve-timeout reaping and exp_key
+namespacing.  Requires ``pymongo`` (not bundled in the TPU image) -- all
+imports are gated; :class:`hyperopt_tpu.distributed.FileTrials` provides
+the same role on a shared filesystem without extra dependencies and is the
+recommended backend on TPU pods.
+
+This module is exercised only where pymongo + a mongod are available; its
+protocol-level logic mirrors FileJobQueue (same states, same CAS shape),
+which carries the tested behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+
+from ..base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Ctrl,
+    SONify,
+    Trials,
+    spec_from_misc,
+)
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["MongoTrials", "MongoJobs", "MongoWorker", "as_mongo_str", "main_worker"]
+
+
+def _require_pymongo():
+    try:
+        import pymongo  # noqa: F401
+        import gridfs  # noqa: F401
+
+        return pymongo
+    except ImportError as e:
+        raise ImportError(
+            "MongoTrials requires pymongo, which is not installed in this "
+            "environment. Use hyperopt_tpu.distributed.FileTrials (shared-"
+            "filesystem queue) for distributed evaluation on TPU pods."
+        ) from e
+
+
+def as_mongo_str(host_port_db):
+    """'host:port/dbname' -> mongodb:// connection string."""
+    if host_port_db.startswith("mongodb://"):
+        return host_port_db
+    return f"mongodb://{host_port_db}"
+
+
+class MongoJobs:
+    """Thin collection wrapper: publish / reserve (CAS) / complete / reap."""
+
+    def __init__(self, db, jobs_collection="jobs"):
+        _require_pymongo()
+        self.db = db
+        self.coll = db[jobs_collection]
+        import gridfs
+
+        self.gfs = gridfs.GridFS(db, collection="fs")
+
+    @classmethod
+    def new_from_connection_str(cls, conn_str, dbname=None):
+        pymongo = _require_pymongo()
+        conn_str = as_mongo_str(conn_str)
+        if dbname is None:
+            dbname = conn_str.rsplit("/", 1)[-1]
+            conn_str = conn_str.rsplit("/", 1)[0]
+        client = pymongo.MongoClient(conn_str)
+        return cls(client[dbname])
+
+    def publish(self, doc):
+        doc = SONify(doc)
+        self.coll.insert_one(doc)
+        return doc
+
+    def reserve(self, owner, exp_key=None):
+        """The CAS: atomically flip one NEW job to RUNNING with our owner."""
+        query = {"state": JOB_STATE_NEW}
+        if exp_key is not None:
+            query["exp_key"] = exp_key
+        return self.coll.find_one_and_update(
+            query,
+            {
+                "$set": {
+                    "state": JOB_STATE_RUNNING,
+                    "owner": owner,
+                    "book_time": coarse_utcnow(),
+                }
+            },
+            sort=[("tid", 1)],
+            return_document=True,
+        )
+
+    def complete(self, doc, result=None, error=None):
+        update = {"refresh_time": coarse_utcnow()}
+        if error is not None:
+            update["state"] = JOB_STATE_ERROR
+            update["misc.error"] = error
+        else:
+            update["state"] = JOB_STATE_DONE
+            update["result"] = SONify(result)
+        self.coll.update_one({"_id": doc["_id"]}, {"$set": update})
+
+    def reap(self, reserve_timeout):
+        if reserve_timeout is None:
+            return 0
+        import datetime
+
+        cutoff = coarse_utcnow() - datetime.timedelta(seconds=reserve_timeout)
+        res = self.coll.update_many(
+            {"state": JOB_STATE_RUNNING, "book_time": {"$lt": cutoff}},
+            {"$set": {"state": JOB_STATE_NEW, "owner": None, "book_time": None}},
+        )
+        return res.modified_count
+
+    # attachments (GridFS) --------------------------------------------------
+    def set_attachment(self, key, blob):
+        old = self.gfs.find_one({"filename": key})
+        if old is not None:
+            self.gfs.delete(old._id)
+        self.gfs.put(blob, filename=key)
+
+    def get_attachment(self, key):
+        obj = self.gfs.find_one({"filename": key})
+        if obj is None:
+            raise KeyError(key)
+        return obj.read()
+
+    def has_attachment(self, key):
+        return self.gfs.find_one({"filename": key}) is not None
+
+
+class _GfsAttachments:
+    def __init__(self, jobs):
+        self.jobs = jobs
+
+    def __contains__(self, key):
+        return self.jobs.has_attachment(key)
+
+    def __getitem__(self, key):
+        return self.jobs.get_attachment(key)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self.jobs.set_attachment(key, value)
+
+
+class MongoTrials(Trials):
+    """Async Trials over a MongoDB jobs collection (reference-compatible
+    ``MongoTrials('mongo://host:port/db/jobs', exp_key=...)`` shape)."""
+
+    asynchronous = True
+
+    def __init__(self, arg, exp_key=None, refresh=True, reserve_timeout=None):
+        _require_pymongo()
+        if isinstance(arg, MongoJobs):
+            self.handle = arg
+        else:
+            conn = str(arg)
+            for prefix in ("mongo://", "mongodb://"):
+                if conn.startswith(prefix):
+                    conn = conn[len(prefix):]
+            conn = conn.rstrip("/")
+            if conn.endswith("/jobs"):
+                conn = conn[: -len("/jobs")]
+            self.handle = MongoJobs.new_from_connection_str(conn)
+        self.reserve_timeout = reserve_timeout
+        super().__init__(exp_key=exp_key, refresh=False)
+        self.attachments = _GfsAttachments(self.handle)
+        if refresh:
+            self.refresh()
+
+    def _insert_trial_docs(self, docs):
+        for doc in docs:
+            self.handle.publish(doc)
+        return [d["tid"] for d in docs]
+
+    def refresh(self):
+        query = {} if self._exp_key is None else {"exp_key": self._exp_key}
+        docs = list(self.handle.coll.find(query, sort=[("tid", 1)]))
+        for d in docs:
+            d.pop("_id", None)
+        self._dynamic_trials = docs
+        if self.reserve_timeout:
+            self.handle.reap(self.reserve_timeout)
+        super().refresh()
+
+    def new_trial_ids(self, n):
+        # ids must be unique across every driver using the collection
+        last = self.handle.coll.find_one(sort=[("tid", -1)])
+        base = (last["tid"] + 1) if last else 0
+        local_floor = max(self._ids, default=-1) + 1
+        start = max(base, local_floor)
+        rval = list(range(start, start + n))
+        self._ids.update(rval)
+        return rval
+
+    def delete_all(self):
+        query = {} if self._exp_key is None else {"exp_key": self._exp_key}
+        self.handle.coll.delete_many(query)
+        super().delete_all()
+
+
+class MongoWorker:
+    """Evaluate reserved jobs (the ``hyperopt-mongo-worker`` role)."""
+
+    def __init__(self, jobs, exp_key=None, workdir=None):
+        self.jobs = jobs
+        self.exp_key = exp_key
+        self.workdir = workdir
+        self._domain = None
+
+    def run_one(self, owner):
+        doc = self.jobs.reserve(owner, exp_key=self.exp_key)
+        if doc is None:
+            return False
+        if self._domain is None:
+            self._domain = pickle.loads(
+                self.jobs.get_attachment("FMinIter_Domain")
+            )
+        trials = Trials()
+        trials._dynamic_trials.append(doc)
+        ctrl = Ctrl(trials, current_trial=doc)
+        try:
+            result = self._domain.evaluate(spec_from_misc(doc["misc"]), ctrl)
+        except Exception as e:
+            logger.error("job %s failed: %s", doc.get("tid"), e)
+            self.jobs.complete(doc, error=(str(type(e)), str(e)))
+        else:
+            self.jobs.complete(doc, result=result)
+        return True
+
+
+def main_worker(argv=None):
+    """CLI: ``hyperopt-tpu-mongo-worker --mongo=host:port/db``."""
+    import argparse
+    import socket
+    import os
+    import time
+
+    parser = argparse.ArgumentParser(prog="hyperopt-tpu-mongo-worker")
+    parser.add_argument("--mongo", required=True)
+    parser.add_argument("--exp-key", default=None)
+    parser.add_argument("--max-jobs", type=int, default=None)
+    parser.add_argument("--poll-interval", type=float, default=1.0)
+    parser.add_argument("--reserve-timeout", type=float, default=120.0)
+    parser.add_argument("--workdir", default=None)
+    options = parser.parse_args(argv)
+
+    jobs = MongoJobs.new_from_connection_str(options.mongo)
+    worker = MongoWorker(jobs, exp_key=options.exp_key, workdir=options.workdir)
+    owner = f"{socket.gethostname()}:{os.getpid()}"
+    n = 0
+    while options.max_jobs is None or n < options.max_jobs:
+        jobs.reap(options.reserve_timeout)
+        if worker.run_one(owner):
+            n += 1
+        else:
+            time.sleep(options.poll_interval)
+    return 0

@@ -1,0 +1,177 @@
+"""SparkTrials: one Spark task per trial.
+
+Capability parity with the reference's ``hyperopt/spark.py`` (SURVEY.md
+SS3.5): an fmin dispatcher launches each trial as a 1-task Spark job in
+its own thread (<= ``parallelism`` in flight), cancels via job groups on
+timeout, and posts results back into the driver-side store under a lock.
+Requires ``pyspark`` (not bundled in the TPU image) -- import-gated; the
+same dispatch control-flow runs dependency-free in
+:class:`hyperopt_tpu.distributed.ThreadTrials`, which carries the tested
+behavior.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import timeit
+
+from ..base import (
+    Ctrl,
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Trials,
+    spec_from_misc,
+)
+from ..utils import coarse_utcnow
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["SparkTrials"]
+
+
+def _require_pyspark():
+    try:
+        import pyspark
+
+        return pyspark
+    except ImportError as e:
+        raise ImportError(
+            "SparkTrials requires pyspark, which is not installed in this "
+            "environment. ThreadTrials provides the same dispatch semantics "
+            "in-process; FileTrials + hyperopt-tpu-worker scales across "
+            "hosts on a shared filesystem."
+        ) from e
+
+
+def _spark_supports_job_cancelling(sc):
+    return hasattr(sc, "cancelJobGroup")
+
+
+class SparkTrials(Trials):
+    """Trials whose evaluation fans out as single-task Spark jobs."""
+
+    asynchronous = True
+
+    def __init__(self, parallelism=None, timeout=None, spark_session=None,
+                 exp_key=None, refresh=True):
+        pyspark = _require_pyspark()
+        if spark_session is None:
+            spark_session = pyspark.sql.SparkSession.builder.getOrCreate()
+        self._spark = spark_session
+        self._sc = spark_session.sparkContext
+        default_par = max(self._sc.defaultParallelism, 1)
+        self.parallelism = int(parallelism) if parallelism else default_par
+        self.timeout = timeout
+        self._lock = threading.RLock()
+        self._inflight = {}
+        self._fmin_cancelled = False
+        self._fmin_cancelled_reason = None
+        self._start_time = None
+        self._supports_cancel = _spark_supports_job_cancelling(self._sc)
+        super().__init__(exp_key=exp_key, refresh=refresh)
+
+    # -- bookkeeping under the lock (SS3.5: 'results posted back under a
+    # lock; refresh() on driver') ------------------------------------------
+    def refresh(self):
+        with self._lock:
+            super().refresh()
+
+    def insert_trial_docs(self, docs):
+        with self._lock:
+            return super().insert_trial_docs(docs)
+
+    def _timed_out(self):
+        return (
+            self.timeout is not None
+            and self._start_time is not None
+            and timeit.default_timer() - self._start_time >= self.timeout
+        )
+
+    def _job_group(self, trial):
+        return f"hyperopt_tpu-trial-{trial['tid']}"
+
+    def _run_trial_async(self, trial, domain):
+        """One dispatcher thread: run the trial as a 1-task Spark job."""
+        sc = self._sc
+        group = self._job_group(trial)
+        spec = spec_from_misc(trial["misc"])
+
+        def task(_):
+            ctrl = Ctrl(None, current_trial=None)
+            return domain.evaluate(spec, ctrl, attach_attachments=False)
+
+        try:
+            if self._supports_cancel:
+                sc.setJobGroup(group, f"trial {trial['tid']}", True)
+            [result] = sc.parallelize([0], 1).map(task).collect()
+        except Exception as e:
+            with self._lock:
+                if trial["state"] == JOB_STATE_RUNNING:
+                    trial["state"] = JOB_STATE_ERROR
+                    trial["misc"]["error"] = (str(type(e)), str(e))
+                    trial["refresh_time"] = coarse_utcnow()
+        else:
+            with self._lock:
+                trial["state"] = JOB_STATE_DONE
+                trial["result"] = result
+                trial["refresh_time"] = coarse_utcnow()
+        finally:
+            with self._lock:
+                self._inflight.pop(trial["tid"], None)
+
+    def _dispatch_new(self, domain):
+        with self._lock:
+            if self._timed_out():
+                if not self._fmin_cancelled:
+                    self._fmin_cancelled = True
+                    self._fmin_cancelled_reason = "fmin run timeout"
+                for tid, (th, trial) in list(self._inflight.items()):
+                    if self._supports_cancel:
+                        self._sc.cancelJobGroup(self._job_group(trial))
+                for t in self._dynamic_trials:
+                    if t["state"] == JOB_STATE_NEW:
+                        t["state"] = JOB_STATE_CANCEL
+                return
+            for t in self._dynamic_trials:
+                if len(self._inflight) >= self.parallelism:
+                    break
+                if t["state"] != JOB_STATE_NEW:
+                    continue
+                t["state"] = JOB_STATE_RUNNING
+                t["book_time"] = coarse_utcnow()
+                t["owner"] = "spark"
+                th = threading.Thread(
+                    target=self._run_trial_async, args=(t, domain), daemon=True
+                )
+                self._inflight[t["tid"]] = (th, t)
+                th.start()
+
+    def count_by_state_unsynced(self, arg):
+        domain = getattr(self, "_domain", None)
+        if domain is not None:
+            self._dispatch_new(domain)
+        with self._lock:
+            return super().count_by_state_unsynced(arg)
+
+    def fmin(self, fn, space, algo=None, max_evals=None, **kwargs):
+        from ..base import Domain
+        from ..fmin import fmin as _fmin
+
+        kwargs.pop("allow_trials_fmin", None)
+        timeout = kwargs.pop("timeout", None)
+        if timeout is not None:
+            self.timeout = timeout
+        self._start_time = timeit.default_timer()
+        self._fmin_cancelled = False
+        pass_expr_memo_ctrl = kwargs.pop("pass_expr_memo_ctrl", None)
+        self._domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
+        kwargs.setdefault("max_queue_len", self.parallelism)
+        return _fmin(
+            fn, space, algo=algo, max_evals=max_evals, trials=self,
+            allow_trials_fmin=False, timeout=self.timeout,
+            pass_expr_memo_ctrl=pass_expr_memo_ctrl, **kwargs,
+        )

@@ -1,0 +1,141 @@
+"""``hyperopt-tpu-worker``: evaluate queued trials from a FileJobQueue.
+
+The worker-process role of the reference's ``hyperopt-mongo-worker`` CLI
+(SURVEY.md SS3.4): reserve (atomic CAS) -> unpickle the shipped Domain ->
+evaluate -> publish DONE/ERROR, in a loop, with reserve-timeout reaping,
+an idle exit, optional workdir isolation and a max-jobs budget.
+
+Usage::
+
+    python -m hyperopt_tpu.distributed.worker --dir /shared/exp1 \
+        [--exp-key K] [--max-jobs N] [--poll-interval S] \
+        [--reserve-timeout S] [--last-job-timeout S] [--workdir D]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import pickle
+import sys
+import time
+import traceback
+
+from ..base import Ctrl, JOB_STATE_DONE, JOB_STATE_ERROR, SONify, spec_from_misc
+from ..utils import working_dir
+from .filequeue import FileJobQueue, FileTrials, worker_owner
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["main", "run_one", "WorkerExit"]
+
+
+class WorkerExit(Exception):
+    pass
+
+
+def _load_domain(queue, cache={}):
+    blob_key = "FMinIter_Domain"
+    if queue.root in cache:
+        return cache[queue.root]
+    if blob_key not in queue.attachments:
+        raise WorkerExit(
+            f"no pickled Domain at {queue.root}/attachments -- is fmin running "
+            "against this queue with an async FileTrials?"
+        )
+    domain = pickle.loads(queue.attachments[blob_key])
+    cache[queue.root] = domain
+    return domain
+
+
+def run_one(queue, owner, exp_key=None, workdir=None, trials=None):
+    """Reserve and evaluate a single job; False if the queue was empty."""
+    doc = queue.reserve(owner, exp_key=exp_key)
+    if doc is None:
+        return False
+    domain = _load_domain(queue)
+    if trials is None:
+        trials = FileTrials(queue.root, exp_key=exp_key, refresh=False)
+    ctrl = Ctrl(trials, current_trial=doc)
+    # Ctrl.checkpoint asserts membership of the live store
+    trials._dynamic_trials.append(doc)
+    spec = spec_from_misc(doc["misc"])
+    try:
+        if workdir:
+            with working_dir(os.path.join(workdir, str(doc["tid"]))):
+                result = domain.evaluate(spec, ctrl)
+        else:
+            result = domain.evaluate(spec, ctrl)
+    except Exception as e:
+        logger.error("job %s failed: %s", doc["tid"], e)
+        doc["state"] = JOB_STATE_ERROR
+        doc["misc"]["error"] = (str(type(e)), str(e))
+        doc["misc"]["traceback"] = traceback.format_exc()
+    else:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = SONify(result)
+    queue.complete(doc)
+    return True
+
+
+def main_worker_helper(options):
+    queue = FileJobQueue(options.dir)
+    owner = worker_owner()
+    n_done = 0
+    idle_since = time.time()
+    trials = FileTrials(
+        options.dir, exp_key=options.exp_key, refresh=False,
+        reserve_timeout=options.reserve_timeout,
+    )
+    logger.info("worker %s serving %s", owner, queue.root)
+    while options.max_jobs is None or n_done < options.max_jobs:
+        queue.reap(options.reserve_timeout)
+        try:
+            ran = run_one(
+                queue, owner, exp_key=options.exp_key,
+                workdir=options.workdir, trials=trials,
+            )
+        except WorkerExit as e:
+            logger.info("worker exit: %s", e)
+            if time.time() - idle_since > (options.last_job_timeout or 30.0):
+                return 1
+            time.sleep(options.poll_interval)
+            continue
+        if ran:
+            n_done += 1
+            idle_since = time.time()
+        else:
+            if (
+                options.last_job_timeout is not None
+                and time.time() - idle_since > options.last_job_timeout
+            ):
+                logger.info("idle for %.0fs, exiting", options.last_job_timeout)
+                break
+            time.sleep(options.poll_interval)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="hyperopt-tpu-worker")
+    parser.add_argument("--dir", required=True, help="FileJobQueue directory")
+    parser.add_argument("--exp-key", default=None)
+    parser.add_argument("--max-jobs", type=int, default=None)
+    parser.add_argument("--poll-interval", type=float, default=0.2)
+    parser.add_argument("--reserve-timeout", type=float, default=120.0)
+    parser.add_argument(
+        "--last-job-timeout", type=float, default=None,
+        help="exit after this many seconds without work",
+    )
+    parser.add_argument("--workdir", default=None)
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    options = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if options.verbose else logging.INFO,
+        stream=sys.stderr,
+    )
+    return main_worker_helper(options)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -405,10 +405,10 @@ def fmin(
         trials._insert_trial_docs(seeded._dynamic_trials)
         trials.refresh()
 
-    # Backends (e.g. SparkTrials) may implement their own fmin dispatch.
-    if allow_trials_fmin and hasattr(trials, "fmin") and not isinstance(
-        trials, Trials
-    ):
+    # Backends (ThreadTrials / FileTrials / SparkTrials...) implement their
+    # own fmin dispatch; plain Trials.fmin recurses here with
+    # allow_trials_fmin=False (reference seam, SURVEY.md SS3.5).
+    if allow_trials_fmin and type(trials).fmin is not Trials.fmin:
         return trials.fmin(
             fn,
             space,

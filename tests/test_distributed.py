@@ -1,0 +1,257 @@
+"""Distributed-backend tests: real subprocess workers over a temp file
+queue (the reference pattern: no network mocks, spin up the real thing --
+SURVEY.md SS4 'Distributed - Mongo' row), plus ThreadTrials."""
+
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import STATUS_OK, Trials, fmin, hp, rand, tpe
+from hyperopt_tpu.base import JOB_STATE_DONE, JOB_STATE_ERROR
+from hyperopt_tpu.distributed import FileJobQueue, FileTrials, ThreadTrials
+from hyperopt_tpu.distributed.filequeue import worker_owner
+from hyperopt_tpu.distributed.worker import run_one
+from hyperopt_tpu.models.synthetic import DOMAINS
+
+
+# ---------------------------------------------------------------------------
+# FileJobQueue unit level
+# ---------------------------------------------------------------------------
+
+
+def make_doc(tid, exp_key=None):
+    return {
+        "tid": tid,
+        "state": 0,
+        "spec": None,
+        "result": {"status": "new"},
+        "misc": {"tid": tid, "cmd": None, "idxs": {"x": [tid]}, "vals": {"x": [0.5]}},
+        "exp_key": exp_key,
+        "owner": None,
+        "version": 0,
+        "book_time": None,
+        "refresh_time": None,
+    }
+
+
+def test_queue_reserve_is_exclusive(tmp_path):
+    q = FileJobQueue(str(tmp_path / "q"))
+    q.publish(make_doc(0))
+    d1 = q.reserve("w1")
+    assert d1 is not None and d1["owner"] == "w1"
+    assert q.reserve("w2") is None  # nothing left
+    assert q.counts() == {"new": 0, "running": 1, "done": 0}
+
+
+def test_queue_exp_key_filter(tmp_path):
+    q = FileJobQueue(str(tmp_path / "q"))
+    q.publish(make_doc(0, exp_key="A"))
+    q.publish(make_doc(1, exp_key="B"))
+    d = q.reserve("w", exp_key="B")
+    assert d is not None and d["tid"] == 1
+
+
+def test_queue_complete_and_reap(tmp_path):
+    q = FileJobQueue(str(tmp_path / "q"))
+    q.publish(make_doc(0))
+    q.publish(make_doc(1))
+    d0 = q.reserve("w1")
+    d0["state"] = JOB_STATE_DONE
+    d0["result"] = {"status": STATUS_OK, "loss": 1.0}
+    q.complete(d0)
+    assert q.counts() == {"new": 1, "running": 0, "done": 1}
+    # a second reservation goes stale and is reaped back
+    q.reserve("w-dead")
+    assert q.counts()["running"] == 1
+    time.sleep(0.05)
+    assert q.reap(reserve_timeout=0.01) == 1
+    assert q.counts() == {"new": 1, "running": 0, "done": 1}
+
+
+def test_attachments_roundtrip(tmp_path):
+    q = FileJobQueue(str(tmp_path / "q"))
+    q.attachments["blob/with:odd chars"] = b"\x00\x01\x02"
+    assert q.attachments["blob/with:odd chars"] == b"\x00\x01\x02"
+    assert "blob/with:odd chars" in q.attachments
+    del q.attachments["blob/with:odd chars"]
+    assert "blob/with:odd chars" not in q.attachments
+    with pytest.raises(KeyError):
+        q.attachments["missing"]
+
+
+# ---------------------------------------------------------------------------
+# in-process worker (run_one)
+# ---------------------------------------------------------------------------
+
+
+def test_run_one_evaluates_job(tmp_path):
+    from hyperopt_tpu.base import Domain
+
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=None)
+    domain = Domain(DOMAINS["quadratic1"].fn, DOMAINS["quadratic1"].make_space())
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    docs = rand.suggest(trials.new_trial_ids(2), domain, trials, seed=0)
+    trials.insert_trial_docs(docs)
+    assert run_one(trials.queue, worker_owner())
+    assert run_one(trials.queue, worker_owner())
+    assert not run_one(trials.queue, worker_owner())  # queue drained
+    trials.refresh()
+    assert [t["state"] for t in trials.trials] == [JOB_STATE_DONE] * 2
+    assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+
+
+def _exploding(x):
+    raise RuntimeError("kaboom")
+
+
+def test_run_one_captures_errors(tmp_path):
+    from hyperopt_tpu.base import Domain
+
+    exploding = _exploding
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=None)
+    domain = Domain(exploding, hp.uniform("x", 0, 1))
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    docs = rand.suggest(trials.new_trial_ids(1), domain, trials, seed=0)
+    trials.insert_trial_docs(docs)
+    assert run_one(trials.queue, worker_owner())
+    trials.refresh()
+    t = trials.trials[0]
+    assert t["state"] == JOB_STATE_ERROR
+    assert "kaboom" in t["misc"]["error"][1]
+    assert "RuntimeError" in t["misc"]["traceback"]
+
+
+# ---------------------------------------------------------------------------
+# full async fmin with real subprocess workers
+# ---------------------------------------------------------------------------
+
+
+def _spawn_worker(dirpath, extra=()):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "hyperopt_tpu.distributed.worker",
+            "--dir", dirpath, "--last-job-timeout", "30",
+            "--poll-interval", "0.05", *extra,
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+
+
+@pytest.mark.slow
+def test_fmin_with_subprocess_workers(tmp_path):
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=60.0)
+    workers = [_spawn_worker(dirpath) for _ in range(2)]
+    try:
+        best = fmin(
+            DOMAINS["quadratic1"].fn,
+            DOMAINS["quadratic1"].make_space(),
+            algo=tpe.suggest,
+            max_evals=12,
+            trials=trials,
+            rstate=np.random.default_rng(0),
+            show_progressbar=False,
+            max_queue_len=4,
+        )
+    finally:
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            w.wait(timeout=10)
+    assert len(trials) == 12
+    assert all(t["state"] == JOB_STATE_DONE for t in trials.trials)
+    assert "x" in best
+    # results were computed by the worker processes, not this one
+    owners = {t["owner"] for t in trials.trials}
+    assert all(o and ":" in o for o in owners)
+    pids = {int(o.split(":")[1]) for o in owners}
+    assert os.getpid() not in pids
+
+
+@pytest.mark.slow
+def test_filetrials_resume_across_instances(tmp_path):
+    """The queue directory IS the experiment state (DB-as-state parity)."""
+    from hyperopt_tpu.base import Domain
+
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=None)
+    domain = Domain(DOMAINS["quadratic1"].fn, DOMAINS["quadratic1"].make_space())
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    docs = rand.suggest(trials.new_trial_ids(3), domain, trials, seed=1)
+    trials.insert_trial_docs(docs)
+    while run_one(trials.queue, worker_owner()):
+        pass
+    blob = pickle.dumps(trials)
+    revived = pickle.loads(blob)
+    revived.refresh()
+    assert len(revived) == 3
+    assert all(t["state"] == JOB_STATE_DONE for t in revived.trials)
+
+
+# ---------------------------------------------------------------------------
+# ThreadTrials
+# ---------------------------------------------------------------------------
+
+
+def test_thread_trials_parallel_evaluation():
+    calls = []
+
+    def slow_quad(x):
+        calls.append(time.time())
+        time.sleep(0.15)
+        return (x - 3.0) ** 2
+
+    trials = ThreadTrials(parallelism=4)
+    t0 = time.time()
+    best = fmin(
+        slow_quad, hp.uniform("x", -10, 10), algo=rand.suggest,
+        max_evals=8, trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    wall = time.time() - t0
+    assert len(trials) == 8
+    assert all(t["state"] == JOB_STATE_DONE for t in trials.trials)
+    assert wall < 8 * 0.15  # beat the serial time => threads overlapped
+    assert "x" in best
+
+
+def test_thread_trials_error_capture():
+    def flaky(x):
+        if x > 0:
+            raise ValueError("positive!")
+        return x
+
+    trials = ThreadTrials(parallelism=2)
+    fmin(
+        flaky, hp.uniform("x", -1, 1), algo=rand.suggest, max_evals=10,
+        trials=trials, rstate=np.random.default_rng(3),
+        show_progressbar=False, return_argmin=False,
+    )
+    states = {t["state"] for t in trials.trials}
+    assert JOB_STATE_DONE in states and JOB_STATE_ERROR in states
+
+
+def test_thread_trials_timeout_cancels_queue():
+    def slow(x):
+        time.sleep(0.1)
+        return x
+
+    trials = ThreadTrials(parallelism=1, timeout=0.5)
+    fmin(
+        slow, hp.uniform("x", 0, 1), algo=rand.suggest, max_evals=1000,
+        trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert len(trials) < 1000
+    assert trials._fmin_cancelled or len(trials) < 20
