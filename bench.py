@@ -1,0 +1,132 @@
+"""Benchmark: TPE candidate-suggestion throughput on the 20-dim mixed space.
+
+The BASELINE.json headline (north star >= 10k suggestions/s on TPU):
+time the jitted batched TPE suggest step (B trials per device program,
+n_EI_candidates per dim per trial) against the in-repo numpy reference
+TPE (the reference's execution model: interpreted, per-trial, 24
+candidates) on the same 500-observation history.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_history(n_obs, space, seed=0):
+    """A Trials store with n_obs completed synthetic trials."""
+    from hyperopt_tpu import Domain, Trials, rand
+    from hyperopt_tpu.base import JOB_STATE_DONE
+    from hyperopt_tpu.models.synthetic import mixed_space_fn
+
+    domain = Domain(mixed_space_fn, space)
+    trials = Trials()
+    rng = np.random.default_rng(seed)
+    ids = trials.new_trial_ids(n_obs)
+    docs = rand.suggest(ids, domain, trials, seed=seed)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(rng.uniform(0, 10))}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return domain, trials
+
+
+def bench_numpy_tpe(domain, trials, n_calls=15):
+    """Reference path: per-trial interpreted numpy TPE suggest."""
+    from hyperopt_tpu import tpe
+
+    # warmup (builds the vectorize helper cache)
+    tpe.suggest([10_000], domain, trials, seed=0)
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        tpe.suggest([10_001 + i], domain, trials, seed=i)
+    dt = time.perf_counter() - t0
+    return n_calls / dt
+
+
+def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
+    """TPU path: one compiled program suggests the whole batch."""
+    import jax
+
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import obs_buffer_for, packed_space_for
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
+    arrays = tuple(map(jax.device_put, buf.arrays()))
+    key = jax.random.key(0)
+
+    out = fn(key, *arrays, batch=batch)  # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        out = fn(jax.random.fold_in(key, i), *arrays, batch=batch)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return batch * n_calls / dt, out
+
+
+def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
+    """Single-suggest (B=1) round-trip latency path."""
+    import jax
+
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import obs_buffer_for, packed_space_for
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
+    arrays = tuple(map(jax.device_put, buf.arrays()))
+    key = jax.random.key(1)
+    jax.block_until_ready(fn(key, *arrays, batch=1))
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        out = fn(jax.random.fold_in(key, i), *arrays, batch=1)
+    jax.block_until_ready(out)
+    return n_calls / (time.perf_counter() - t0)
+
+
+def main():
+    from hyperopt_tpu.models.synthetic import mixed_space
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    n_cand = int(os.environ.get("BENCH_N_CAND", "128"))
+    n_obs = int(os.environ.get("BENCH_N_OBS", "500"))
+
+    space = mixed_space()  # 20-dim mixed continuous/categorical
+    domain, trials = build_history(n_obs, space)
+
+    numpy_rate = bench_numpy_tpe(domain, trials)
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    jax_rate, _ = bench_jax_tpe(domain, trials, batch=batch, n_cand=n_cand)
+    latency_rate = bench_jax_latency(domain, trials, n_cand=n_cand)
+
+    print(
+        json.dumps(
+            {
+                "metric": "tpe_suggestions_per_sec_20dim_mixed",
+                "value": round(jax_rate, 1),
+                "unit": "suggestions/s",
+                "vs_baseline": round(jax_rate / numpy_rate, 2),
+                "baseline_numpy_tpe_per_sec": round(numpy_rate, 1),
+                "single_suggest_per_sec": round(latency_rate, 1),
+                "batch": batch,
+                "n_EI_candidates": n_cand,
+                "n_obs": n_obs,
+                "platform": platform,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

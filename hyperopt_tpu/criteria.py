@@ -1,0 +1,90 @@
+"""Closed-form acquisition criteria over Gaussian predictions.
+
+Capability parity with the reference's ``hyperopt/criteria.py``
+(SURVEY.md SS2): analytic EI / logEI / UCB utility functions.  Not wired
+into TPE (same as the reference); useful for GP-flavored extensions.
+Implemented with scipy on host and mirrored as jnp-compatible math (the
+functions accept numpy or jax arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["EI_empirical", "EI_gaussian", "logEI_gaussian", "UCB"]
+
+
+def _np_mod(x):
+    try:
+        import jax.numpy as jnp
+
+        if isinstance(x, jnp.ndarray):
+            return jnp
+    except Exception:
+        pass
+    return np
+
+
+def _norm_pdf(x, xp):
+    return xp.exp(-0.5 * x * x) / xp.sqrt(2 * xp.pi)
+
+
+def _norm_cdf(x, xp):
+    if xp is np:
+        from scipy.special import erf
+    else:
+        from jax.scipy.special import erf
+    return 0.5 * (1.0 + erf(x / xp.sqrt(2.0)))
+
+
+def EI_empirical(samples, thresh):
+    """Expected improvement over ``thresh`` from empirical samples."""
+    xp = _np_mod(samples)
+    samples = xp.asarray(samples)
+    return xp.maximum(samples - thresh, 0.0).mean()
+
+
+def EI_gaussian(mean, var, thresh):
+    """Expected improvement over ``thresh`` of N(mean, var)."""
+    xp = _np_mod(mean)
+    mean = xp.asarray(mean, dtype=float)
+    var = xp.asarray(var, dtype=float)
+    sigma = xp.sqrt(var)
+    score = (mean - thresh) / sigma
+    return sigma * (score * _norm_cdf(score, xp) + _norm_pdf(score, xp))
+
+
+def logEI_gaussian(mean, var, thresh):
+    """log(EI_gaussian), numerically stable deep into the tail.
+
+    For score << 0 uses the asymptotic expansion
+    ``EI ~ pdf(s) * sigma / s^2`` so the log stays finite where the naive
+    formula underflows.
+    """
+    xp = _np_mod(mean)
+    mean = xp.asarray(mean, dtype=float)
+    var = xp.asarray(var, dtype=float)
+    sigma = xp.sqrt(var)
+    score = (mean - thresh) / sigma
+
+    naive_inner = score * _norm_cdf(score, xp) + _norm_pdf(score, xp)
+    naive = xp.log(xp.maximum(naive_inner, 1e-300)) + xp.log(sigma)
+    # tail: log(pdf(s)/s^2 * (1 - 2/s^2)) + log(sigma)
+    s2 = xp.maximum(score * score, 1e-12)
+    tail = (
+        -0.5 * s2
+        - 0.5 * xp.log(2 * xp.pi)
+        - xp.log(s2)
+        + xp.log1p(xp.maximum(-2.0 / s2, -0.999))
+        + xp.log(sigma)
+    )
+    use_tail = score < -6.0
+    return xp.where(use_tail, tail, naive)
+
+
+def UCB(mean, var, zscore):
+    """Upper confidence bound: mean + zscore * std."""
+    xp = _np_mod(mean)
+    return xp.asarray(mean, dtype=float) + xp.sqrt(
+        xp.asarray(var, dtype=float)
+    ) * zscore

@@ -1,0 +1,203 @@
+"""ResNet-20 / CIFAR-10 with a vmapped population train step.
+
+BASELINE.json config #4: the lr+weight-decay sweep evaluates a whole
+*population* of ResNet-20s at once -- hyperparameters become a batched
+leading axis via ``vmap`` (population training), the population shards
+over the ``trial`` mesh axis and each member's data batch over ``cand``
+(reusing the suggest mesh).  This is the TPU-native replacement for
+farming one model per worker process: the MXU sees one big fused program
+instead of P small ones.
+
+Synthetic CIFAR-shaped data keeps the objective hermetic (zero-egress
+image); swap ``synthetic_cifar_batch`` for a real loader in production.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = [
+    "ResNet",
+    "resnet20",
+    "synthetic_cifar_batch",
+    "make_population_train_step",
+    "population_objective",
+    "hpo_space",
+]
+
+
+def _flax():
+    import flax.linen as nn
+
+    return nn
+
+
+def resnet20(num_classes=10, width=16):
+    """Standard CIFAR ResNet-20: 3 stages x 3 basic blocks, 16/32/64 ch."""
+    return ResNet(stage_sizes=(3, 3, 3), num_classes=num_classes, width=width)
+
+
+def ResNet(stage_sizes=(3, 3, 3), num_classes=10, width=16):
+    nn = _flax()
+    import jax.numpy as jnp
+
+    class BasicBlock(nn.Module):
+        filters: int
+        strides: int = 1
+
+        @nn.compact
+        def __call__(self, x, train=True):
+            residual = x
+            y = nn.Conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                        padding="SAME", use_bias=False)(x)
+            y = nn.GroupNorm(num_groups=8)(y)
+            y = nn.relu(y)
+            y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+            y = nn.GroupNorm(num_groups=8)(y)
+            if residual.shape != y.shape:
+                residual = nn.Conv(self.filters, (1, 1),
+                                   strides=(self.strides,) * 2,
+                                   use_bias=False)(residual)
+                residual = nn.GroupNorm(num_groups=8)(residual)
+            return nn.relu(y + residual)
+
+    class _ResNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            y = nn.Conv(width, (3, 3), padding="SAME", use_bias=False)(x)
+            y = nn.GroupNorm(num_groups=8)(y)
+            y = nn.relu(y)
+            for stage, n_blocks in enumerate(stage_sizes):
+                filters = width * (2**stage)
+                for block in range(n_blocks):
+                    strides = 2 if stage > 0 and block == 0 else 1
+                    y = BasicBlock(filters, strides)(y, train=train)
+            y = jnp.mean(y, axis=(1, 2))
+            return nn.Dense(num_classes)(y)
+
+    # GroupNorm (not BatchNorm): batch-stat-free so population vmap and
+    # mesh sharding need no cross-replica stat sync.
+    return _ResNet()
+
+
+def synthetic_cifar_batch(key, batch_size=128, image_size=32, num_classes=10):
+    """Deterministic CIFAR-shaped synthetic batch (class-conditional means
+    so the task is learnable, not pure noise)."""
+    import jax
+    import jax.numpy as jnp
+
+    k_lbl, k_img = jax.random.split(key)
+    labels = jax.random.randint(k_lbl, (batch_size,), 0, num_classes)
+    means = jnp.linspace(-1.0, 1.0, num_classes)[labels]
+    images = means[:, None, None, None] * 0.5 + 0.5 * jax.random.normal(
+        k_img, (batch_size, image_size, image_size, 3)
+    )
+    return images, labels
+
+
+def make_population_train_step(model, mesh=None, trial_axis="trial",
+                               data_axis="cand"):
+    """Build ``train_step(pop_params, pop_opt, lr, wd, images, labels)``.
+
+    vmaps a single-model SGD(+momentum, +weight-decay) step over the
+    population leading axis; with ``mesh`` given, population shards over
+    ``trial_axis`` and the data batch over ``data_axis`` via sharding
+    constraints (GSPMD inserts the collectives -- SURVEY.md SS5 TPU
+    equivalent of trial-level farming).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, images, labels):
+        logits = model.apply({"params": params}, images)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels
+        ).mean()
+        return loss, logits
+
+    def one_member_step(params, momentum, lr, wd, images, labels):
+        (loss, _), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, images, labels
+        )
+        new_momentum = jax.tree.map(
+            lambda m, g: 0.9 * m + g, momentum, grads
+        )
+        new_params = jax.tree.map(
+            lambda p, m: p - lr * (m + wd * p), params, new_momentum
+        )
+        return new_params, new_momentum, loss
+
+    pop_step = jax.vmap(one_member_step, in_axes=(0, 0, 0, 0, None, None))
+
+    if mesh is None:
+        return jax.jit(pop_step)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    pop_spec = P(trial_axis)
+    data_spec = P(data_axis)
+
+    def sharded_step(pop_params, pop_momentum, lr, wd, images, labels):
+        constrain = functools.partial(jax.lax.with_sharding_constraint)
+        pop_params = jax.tree.map(
+            lambda x: constrain(x, NamedSharding(mesh, pop_spec)), pop_params
+        )
+        images = constrain(images, NamedSharding(mesh, data_spec))
+        labels = constrain(labels, NamedSharding(mesh, data_spec))
+        return pop_step(pop_params, pop_momentum, lr, wd, images, labels)
+
+    return jax.jit(sharded_step)
+
+
+def init_population(model, pop_size, key, image_size=32):
+    """Per-member init (different seeds) stacked on a leading axis."""
+    import jax
+    import jax.numpy as jnp
+
+    def init_one(k):
+        dummy = jnp.zeros((1, image_size, image_size, 3))
+        return model.init(k, dummy)["params"]
+
+    keys = jax.random.split(key, pop_size)
+    return jax.vmap(init_one)(keys)
+
+
+def hpo_space():
+    """The lr+wd sweep space (config #4)."""
+    from .. import hp
+
+    return {
+        "lr": hp.loguniform("lr", np.log(1e-4), np.log(1.0)),
+        "wd": hp.loguniform("wd", np.log(1e-6), np.log(1e-2)),
+    }
+
+
+def population_objective(pop_size=4, n_steps=3, batch_size=32, image_size=8,
+                         width=8, seed=0, mesh=None):
+    """Factory: an fmin-compatible objective that trains a (tiny by
+    default) ResNet population member with the suggested lr/wd and returns
+    final train loss.  Uses Ctrl-free sync evaluation; for population
+    batching pass configs through ``suggest_batch`` + ThreadTrials."""
+    import jax
+    import jax.numpy as jnp
+
+    model = ResNet(stage_sizes=(1, 1, 1), width=width) if width <= 8 else resnet20()
+    step = make_population_train_step(model, mesh=mesh)
+    key = jax.random.key(seed)
+    init_key, data_key = jax.random.split(key)
+    images, labels = synthetic_cifar_batch(data_key, batch_size, image_size)
+
+    def objective(cfg):
+        params = init_population(model, 1, init_key, image_size)
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        lr = jnp.asarray([cfg["lr"]], jnp.float32)
+        wd = jnp.asarray([cfg["wd"]], jnp.float32)
+        loss = None
+        for _ in range(n_steps):
+            params, momentum, loss = step(params, momentum, lr, wd, images, labels)
+        return float(loss[0])
+
+    return objective

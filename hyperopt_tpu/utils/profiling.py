@@ -1,0 +1,107 @@
+"""Tracing / profiling hooks.
+
+The reference has only stdlib logging (SURVEY.md SS5 'tracing: none');
+the TPU equivalent promised there: ``jax.profiler`` trace capture (XLA
+timeline -> Perfetto/TensorBoard) plus cheap per-suggest-step wall-clock
+metrics that work on any backend.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StepTimer", "instrument_algo", "device_trace"]
+
+
+class StepTimer:
+    """Accumulates wall-clock stats per named step.
+
+    >>> timer = StepTimer()
+    >>> with timer.measure("suggest"):
+    ...     pass
+    >>> timer.summary()["suggest"]["count"]
+    1
+    """
+
+    def __init__(self):
+        self._records = defaultdict(list)
+
+    @contextlib.contextmanager
+    def measure(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self._records[name].append(time.perf_counter() - t0)
+
+    def record(self, name, seconds):
+        self._records[name].append(float(seconds))
+
+    def summary(self):
+        out = {}
+        for name, xs in self._records.items():
+            n = len(xs)
+            total = sum(xs)
+            out[name] = {
+                "count": n,
+                "total_s": total,
+                "mean_s": total / n,
+                "min_s": min(xs),
+                "max_s": max(xs),
+            }
+        return out
+
+    def log_summary(self, level=logging.INFO):
+        for name, s in sorted(self.summary().items()):
+            logger.log(
+                level,
+                "%s: n=%d mean=%.4fs total=%.2fs",
+                name, s["count"], s["mean_s"], s["total_s"],
+            )
+
+
+def instrument_algo(algo, timer, name=None):
+    """Wrap a suggest function so every call is timed.
+
+    >>> timed = instrument_algo(tpe_jax.suggest, timer)
+    >>> fmin(fn, space, algo=timed, ...)
+    """
+    label = name or getattr(algo, "__name__", "suggest")
+
+    def timed(new_ids, domain, trials, seed, *args, **kwargs):
+        with timer.measure(label):
+            return algo(new_ids, domain, trials, seed, *args, **kwargs)
+
+    timed.__name__ = f"timed_{label}"
+    return timed
+
+
+@contextlib.contextmanager
+def device_trace(logdir, create_perfetto_link=False):
+    """Capture an XLA device trace (view in TensorBoard / Perfetto).
+
+    No-op fallback when the profiler is unavailable on the backend.
+    """
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(
+            logdir, create_perfetto_link=create_perfetto_link
+        )
+        started = True
+    except Exception as e:  # pragma: no cover - backend dependent
+        logger.warning("device_trace unavailable: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # pragma: no cover
+                logger.warning("stop_trace failed: %s", e)
