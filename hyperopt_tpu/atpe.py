@@ -1,0 +1,189 @@
+"""Adaptive TPE: self-tuning TPE hyperparameters + parameter locking.
+
+Capability parity with the reference's ``hyperopt/atpe.py`` (SURVEY.md
+SS2): the reference ships pretrained LightGBM/scikit-learn meta-models
+(JSON/txt blobs) that pick TPE's own hyperparameters and lock converged
+parameters per space.  Pretrained blobs cannot ship here (zero-egress
+image, no lightgbm), so this implementation derives the same *decisions*
+from online statistics instead of offline meta-models:
+
+* **TPE hyperparameter adaptation** -- gamma / n_EI_candidates /
+  prior_weight scale with space width, categorical fraction, history
+  length and recent improvement rate;
+* **parameter locking** -- hyperparameters whose values have converged
+  across the elite set (low spread relative to prior width) are frozen to
+  their elite modal value for a fraction of suggestions, concentrating
+  search on the unconverged subspace.
+
+If ``lightgbm`` IS importable, ``ATPEOptimizer(meta_model=...)`` accepts a
+user-trained model with the same decision interface (import-gated, like
+the reference's optional dependency).
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from . import tpe
+from .base import JOB_STATE_DONE, STATUS_OK
+from .jax_trials import packed_space_for
+from .pyll.stochastic import ensure_rng
+from .rand import _domain_helper, docs_from_idxs_vals
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["suggest", "ATPEOptimizer"]
+
+
+def _ok_trials(trials):
+    return [
+        t
+        for t in trials.trials
+        if t["state"] == JOB_STATE_DONE
+        and t["result"].get("status") == STATUS_OK
+        and t["result"].get("loss") is not None
+        and np.isfinite(float(t["result"]["loss"]))
+    ]
+
+
+class ATPEOptimizer:
+    """Derives per-step TPE settings and a lock set from the history."""
+
+    def __init__(self, lock_fraction=0.5, elite_count=8, meta_model=None):
+        self.lock_fraction = lock_fraction
+        self.elite_count = elite_count
+        self.meta_model = meta_model  # optional lightgbm-style scorer
+
+    # -- TPE hyperparameter adaptation ------------------------------------
+    def tpe_settings(self, domain, trials):
+        ps = packed_space_for(domain)
+        n_dims = ps.n_dims
+        frac_cat = len(ps.cat_idx) / max(n_dims, 1)
+        ok = _ok_trials(trials)
+        n = len(ok)
+
+        # wider spaces need a bigger elite fraction; categorical-heavy
+        # spaces need more candidates to cover the grid
+        gamma = float(np.clip(0.20 + 0.01 * n_dims, 0.15, 0.35))
+        n_ei = int(np.clip(24 * (1 + 2 * frac_cat) * (1 + n_dims / 20), 24, 256))
+        prior_weight = 1.0
+
+        # improvement trend: stalled experiments get a stronger prior
+        # (more exploration), improving ones sharpen (smaller gamma)
+        if n >= 20:
+            losses = [float(t["result"]["loss"]) for t in ok]
+            best_first = np.minimum.accumulate(losses)
+            recent_gain = best_first[-10] - best_first[-1]
+            scale = abs(best_first[-1]) + 1e-12
+            if recent_gain <= 1e-6 * scale:
+                prior_weight = 1.5
+            else:
+                gamma = max(0.15, gamma - 0.05)
+
+        if self.meta_model is not None:
+            try:  # optional learned override (reference-style meta-model)
+                gamma, n_ei, prior_weight = self.meta_model(
+                    n_dims, frac_cat, n, gamma, n_ei, prior_weight
+                )
+            except Exception as e:  # pragma: no cover
+                logger.warning("meta_model failed, using heuristics: %s", e)
+
+        return {
+            "gamma": gamma,
+            "n_EI_candidates": n_ei,
+            "prior_weight": prior_weight,
+        }
+
+    # -- parameter locking --------------------------------------------------
+    def locked_values(self, domain, trials, rng):
+        """{label: value} of converged hyperparameters to freeze this step."""
+        ok = _ok_trials(trials)
+        if len(ok) < 20 or rng.uniform() > self.lock_fraction:
+            return {}
+        ok.sort(key=lambda t: float(t["result"]["loss"]))
+        elite = ok[: self.elite_count]
+
+        helper = _domain_helper(domain)
+        locked = {}
+        for label, info in helper.hps.items():
+            vals = [
+                t["misc"]["vals"][label][0]
+                for t in elite
+                if len(t["misc"]["vals"].get(label, [])) == 1
+            ]
+            if len(vals) < max(3, len(elite) // 2):
+                continue
+            if info.dist in ("randint", "categorical", "randint_via_categorical"):
+                uniq, counts = np.unique(np.asarray(vals, dtype=int),
+                                         return_counts=True)
+                if counts.max() / counts.sum() >= 0.8:
+                    locked[label] = int(uniq[np.argmax(counts)])
+            else:
+                arr = np.asarray(vals, dtype=float)
+                p = info.params
+                if info.dist in ("loguniform", "qloguniform", "lognormal",
+                                 "qlognormal"):
+                    arr = np.log(np.maximum(arr, 1e-300))
+                if "low" in p and isinstance(p.get("low"), (int, float)):
+                    width = float(p["high"]) - float(p["low"])
+                else:
+                    width = 2.0 * float(p.get("sigma", 1.0))
+                if width > 0 and arr.std() < 0.05 * width:
+                    locked[label] = float(np.median(arr))
+                    if info.dist.startswith("q") and isinstance(
+                        p.get("q"), (int, float)
+                    ):
+                        q = float(p["q"])
+                        locked[label] = float(np.round(locked[label] / q) * q)
+                    if info.dist in ("loguniform", "qloguniform", "lognormal",
+                                     "qlognormal"):
+                        locked[label] = float(np.exp(locked[label]))
+        if locked:
+            logger.debug("atpe locking %s", sorted(locked))
+        return locked
+
+    # -- one suggestion -----------------------------------------------------
+    def suggest_config(self, domain, trials, rng, n_startup_jobs=20):
+        helper = _domain_helper(domain)
+        ok = _ok_trials(trials)
+        if len(ok) < n_startup_jobs:
+            return helper.sample_one(rng)
+
+        settings = self.tpe_settings(domain, trials)
+        locked = self.locked_values(domain, trials, rng)
+
+        draws = tpe._posterior_draws(
+            domain, trials, rng,
+            prior_weight=settings["prior_weight"],
+            n_EI_candidates=settings["n_EI_candidates"],
+            gamma=settings["gamma"],
+            LF=tpe._default_linear_forgetting,
+        )
+        # freeze converged labels BEFORE routing so a locked choice also
+        # re-routes its subtree consistently
+        draws.update(locked)
+        return tpe._route_draws(domain, draws)
+
+
+def suggest(new_ids, domain, trials, seed, n_startup_jobs=20,
+            lock_fraction=0.5, elite_count=8):
+    """The algo plugin-boundary entry point: ``algo=atpe.suggest``."""
+    rng = ensure_rng(seed)
+    opt = getattr(domain, "_atpe_optimizer", None)
+    if opt is None or opt.lock_fraction != lock_fraction:
+        opt = ATPEOptimizer(lock_fraction=lock_fraction, elite_count=elite_count)
+        domain._atpe_optimizer = opt
+    helper = _domain_helper(domain)
+    labels = sorted(helper.hps)
+    idxs = {label: [] for label in labels}
+    vals = {label: [] for label in labels}
+    for tid in new_ids:
+        config = opt.suggest_config(
+            domain, trials, rng, n_startup_jobs=n_startup_jobs
+        )
+        for label, value in config.items():
+            idxs[label].append(tid)
+            vals[label].append(value)
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

@@ -449,9 +449,9 @@ def _obs_by_label(docs, labels):
 # ---------------------------------------------------------------------------
 
 
-def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, LF):
-    """One new config: posterior EI-argmax per hyperparameter, activity
-    routed through the space graph (factorized TPE, SURVEY.md SS3.2)."""
+def _posterior_draws(domain, trials, rng, prior_weight, n_EI_candidates, gamma, LF):
+    """Unrouted per-label posterior EI-argmax draws (every label, whether
+    or not it ends up active)."""
     helper = _domain_helper(domain)
     hps = helper.hps
     labels = sorted(hps)
@@ -460,9 +460,8 @@ def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, L
     obs_below = _obs_by_label(below, labels)
     obs_above = _obs_by_label(above, labels)
 
-    draws = {}
-    for label in labels:
-        draws[label] = posterior_draw(
+    return {
+        label: posterior_draw(
             hps[label],
             obs_below[label],
             obs_above[label],
@@ -471,9 +470,15 @@ def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, L
             n_EI_candidates,
             LF,
         )
+        for label in labels
+    }
 
-    # materialize activity: only labels on the chosen branches count
-    memo = {info.node: draws[label] for label, info in hps.items()}
+
+def _route_draws(domain, draws):
+    """Route draws through the space graph: only labels on the chosen
+    branches survive into the trial's active config."""
+    helper = _domain_helper(domain)
+    memo = {info.node: draws[label] for label, info in helper.hps.items()}
     active = {}
 
     def observer(node, value):
@@ -482,6 +487,15 @@ def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, L
 
     rec_eval(domain.expr, memo=memo, observer=observer)
     return active
+
+
+def _suggest_config(domain, trials, rng, prior_weight, n_EI_candidates, gamma, LF):
+    """One new config: posterior EI-argmax per hyperparameter, activity
+    routed through the space graph (factorized TPE, SURVEY.md SS3.2)."""
+    draws = _posterior_draws(
+        domain, trials, rng, prior_weight, n_EI_candidates, gamma, LF
+    )
+    return _route_draws(domain, draws)
 
 
 def suggest_batch(

@@ -30,8 +30,8 @@ try:  # pragma: no cover - environment dependent
         jax.config.update("jax_platforms", "cpu")
     from jax._src import xla_bridge as _xb
 
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "interpreter"):
-            _xb._backend_factories.pop(_name, None)
+    # drop only the tunnel plugin; removing real platform names (tpu, ...)
+    # would break import-time lowering registrations in flax/pallas
+    _xb._backend_factories.pop("axon", None)
 except Exception:
     pass

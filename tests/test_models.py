@@ -1,0 +1,152 @@
+"""Benchmark model-family tests: surrogate, nasbench, resnet population,
+plus ATPE on them (BASELINE.json configs #3-#5)."""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, atpe, fmin, rand, tpe
+from hyperopt_tpu.models import nasbench, surrogate
+
+
+def test_surrogate_space_and_objective():
+    from hyperopt_tpu.vectorize import sample_config
+    from hyperopt_tpu.fmin import space_eval
+
+    sp = surrogate.space()
+    for seed in range(20):
+        cfg_assign = sample_config(sp, np.random.default_rng(seed))
+        cfg = space_eval(sp, cfg_assign)
+        loss = surrogate.objective(cfg)
+        assert 0.0 < loss < 2.0
+        assert cfg["booster"] in ("gbtree", "dart")
+        assert 2 <= cfg["max_depth"] <= 12
+
+
+def test_tpe_on_surrogate_beats_random():
+    def run(algo, seed):
+        trials = Trials()
+        fmin(
+            surrogate.objective, surrogate.space(), algo=algo, max_evals=80,
+            trials=trials, rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+        )
+        return trials.best_trial["result"]["loss"]
+
+    tpe_best = min(run(tpe.suggest, s) for s in (0, 1))
+    rand_best = min(run(rand.suggest, s) for s in (0, 1))
+    assert tpe_best <= rand_best + 0.01
+    assert tpe_best < surrogate.best_known() + 0.08
+
+
+def test_nasbench_table_properties():
+    archs, losses = nasbench.full_table()
+    assert len(archs) == 5**6
+    assert np.isfinite(losses).all()
+    assert 4.0 < losses.min() < losses.max() < 50.0
+    # same arch -> same loss (deterministic table)
+    cfg = {f"edge{e}": 2 for e in range(6)}
+    assert nasbench.objective(cfg) == nasbench.objective(dict(cfg))
+
+
+def test_tpe_jax_on_nasbench():
+    """Choice-heavy space through the jitted categorical posterior path."""
+    from hyperopt_tpu import tpe_jax
+
+    trials = Trials()
+    fmin(
+        nasbench.objective, nasbench.space(), algo=tpe_jax.suggest,
+        max_evals=60, trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False, max_queue_len=8,
+    )
+    best = trials.best_trial["result"]["loss"]
+    opt = nasbench.optimal_loss()
+    # within 60 evals of a 15625-arch table, must land in the good tail
+    _, losses = nasbench.full_table()
+    assert best <= np.percentile(losses, 8)
+    assert best >= opt - 1e-9
+
+
+def test_atpe_runs_and_competes_on_quadratic():
+    from hyperopt_tpu import hp
+
+    def run(algo, seed):
+        trials = Trials()
+        fmin(
+            lambda x: (x - 3.0) ** 2, hp.uniform("x", -10, 10), algo=algo,
+            max_evals=70, trials=trials, rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+        )
+        return trials.best_trial["result"]["loss"]
+
+    atpe_best = np.median([run(atpe.suggest, s) for s in (0, 1, 2)])
+    rand_best = np.median([run(rand.suggest, s) for s in (0, 1, 2)])
+    assert atpe_best <= rand_best + 1e-9
+    assert atpe_best < 0.5
+
+
+def test_atpe_conditional_space_structural_integrity():
+    from hyperopt_tpu import hp
+
+    space = hp.choice(
+        "c",
+        [
+            {"kind": "a", "lr": hp.loguniform("lr_a", -5, 0)},
+            {"kind": "b", "x": hp.uniform("x_b", 0, 1)},
+        ],
+    )
+
+    def obj(cfg):
+        return cfg["lr"] if cfg["kind"] == "a" else cfg["x"] + 0.2
+
+    trials = Trials()
+    fmin(
+        obj, space, algo=atpe.suggest, max_evals=50, trials=trials,
+        rstate=np.random.default_rng(1), show_progressbar=False,
+    )
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        if vals["c"][0] == 0:
+            assert vals["lr_a"] and not vals["x_b"]
+        else:
+            assert vals["x_b"] and not vals["lr_a"]
+
+
+def test_atpe_locking_kicks_in():
+    """After convergence, ATPE should lock converged dims at least once."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.atpe import ATPEOptimizer
+    from hyperopt_tpu.base import Domain
+
+    domain = Domain(lambda cfg: 0.0, {"x": hp.uniform("x", 0, 1),
+                                      "y": hp.uniform("y", -5, 5)})
+    trials = Trials()
+    docs = []
+    rng = np.random.default_rng(0)
+    ids = trials.new_trial_ids(40)
+    for tid in ids:
+        x = 0.5 + rng.normal(0, 0.001)  # x converged
+        y = rng.uniform(-5, 5)          # y still exploring
+        misc = {"tid": tid, "cmd": None,
+                "idxs": {"x": [tid], "y": [tid]},
+                "vals": {"x": [x], "y": [y]}}
+        (d,) = trials.new_trial_docs(
+            [tid], [None], [{"status": "ok", "loss": abs(y)}], [misc]
+        )
+        d["state"] = 2
+        docs.append(d)
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    opt = ATPEOptimizer(lock_fraction=1.0)
+    locked = opt.locked_values(domain, trials, np.random.default_rng(1))
+    assert "x" in locked and abs(locked["x"] - 0.5) < 0.01
+    assert "y" not in locked
+
+
+def test_resnet_tiny_objective_lr_sensitivity():
+    from hyperopt_tpu.models import resnet
+
+    obj = resnet.population_objective(n_steps=2, batch_size=16, image_size=8)
+    good = obj({"lr": 0.05, "wd": 1e-4})
+    bad = obj({"lr": 1e-5, "wd": 1e-4})
+    assert np.isfinite(good) and np.isfinite(bad)
+    assert good < bad  # a sane lr must beat a vanishing one after 2 steps

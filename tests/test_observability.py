@@ -1,0 +1,111 @@
+"""Smoke tests for plotting / graphviz / criteria / profiling (reference:
+tests/test_plotting.py etc., SURVEY.md SS4 'non-crash smoke with Agg')."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+from hyperopt_tpu import Trials, fmin, hp, rand
+from hyperopt_tpu import criteria, graphviz as ht_graphviz, plotting
+from hyperopt_tpu.utils.profiling import StepTimer, instrument_algo
+
+
+@pytest.fixture(scope="module")
+def done_trials():
+    trials = Trials()
+    fmin(
+        lambda cfg: (cfg["x"] - 1) ** 2 + cfg["c"] * 0.1,
+        {"x": hp.uniform("x", -3, 3), "c": hp.choice("c", [0, 1])},
+        algo=rand.suggest,
+        max_evals=25,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    return trials
+
+
+def test_plot_history_smoke(done_trials):
+    fig = plotting.main_plot_history(done_trials, do_show=False)
+    assert fig is not None
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_histogram_smoke(done_trials):
+    fig = plotting.main_plot_histogram(done_trials, do_show=False)
+    assert fig is not None
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_vars_smoke(done_trials):
+    fig = plotting.main_plot_vars(done_trials, do_show=False)
+    assert fig is not None
+    matplotlib.pyplot.close("all")
+
+
+def test_plot_empty_trials():
+    assert plotting.main_plot_histogram(Trials(), do_show=False) is None
+    assert plotting.main_plot_vars(Trials(), do_show=False) is None
+    matplotlib.pyplot.close("all")
+
+
+def test_graphviz_dot_output():
+    space = hp.choice(
+        "c", [{"x": hp.uniform("x", 0, 1)}, {"y": hp.lognormal("y", 0, 1)}]
+    )
+    dot = ht_graphviz.dot_hyperparameters(space)
+    assert dot.startswith("digraph")
+    assert dot.rstrip().endswith("}")
+    for label in ("c", "x", "y", "switch"):
+        assert label in dot
+    assert dot.count("->") > 5
+
+
+# -- criteria ---------------------------------------------------------------
+
+
+def test_ei_gaussian_against_empirical():
+    rng = np.random.default_rng(0)
+    mean, var, thresh = 1.0, 4.0, 2.0
+    samples = rng.normal(mean, np.sqrt(var), size=200_000)
+    analytic = criteria.EI_gaussian(mean, var, thresh)
+    empirical = criteria.EI_empirical(samples, thresh)
+    assert analytic == pytest.approx(empirical, rel=0.02)
+
+
+def test_logei_matches_log_of_ei_in_bulk():
+    mean, var = 0.0, 1.0
+    for thresh in (-1.0, 0.0, 1.0, 3.0):
+        assert criteria.logEI_gaussian(mean, var, thresh) == pytest.approx(
+            np.log(criteria.EI_gaussian(mean, var, thresh)), abs=1e-6
+        )
+
+
+def test_logei_finite_deep_in_tail():
+    val = criteria.logEI_gaussian(0.0, 1.0, 40.0)
+    assert np.isfinite(val)
+    assert val < -700  # naive log(EI) would be -inf here
+
+
+def test_ucb():
+    assert criteria.UCB(1.0, 4.0, 2.0) == pytest.approx(5.0)
+
+
+# -- profiling --------------------------------------------------------------
+
+
+def test_step_timer_and_instrumented_algo():
+    timer = StepTimer()
+    timed = instrument_algo(rand.suggest, timer)
+    trials = Trials()
+    fmin(
+        lambda x: x**2, hp.uniform("x", -1, 1), algo=timed, max_evals=5,
+        trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    s = timer.summary()["suggest"]
+    assert s["count"] == 5
+    assert s["total_s"] >= 5 * s["min_s"]
+    timer.log_summary()

@@ -1,0 +1,131 @@
+"""Pallas GMM-scoring kernel vs the XLA reference kernel (interpret mode
+on CPU; the same kernel compiles for TPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu.ops import kernels as K
+from hyperopt_tpu.ops.pallas_kernels import (
+    ei_scores,
+    gmm_logpdf_rows,
+    pad_components,
+)
+
+
+def make_row(rng, n_comp, spread=3.0):
+    w = rng.uniform(0.1, 1.0, n_comp)
+    w = w / w.sum()
+    mu = rng.normal(0, spread, n_comp)
+    sigma = rng.uniform(0.3, 2.0, n_comp)
+    return w, mu, sigma
+
+
+def test_pad_components():
+    w = jnp.ones((2, 130))
+    mu = jnp.zeros((2, 130))
+    sig = jnp.ones((2, 130))
+    lm = jnp.zeros((2, 130))
+    pw, pm, ps, pl_ = pad_components(w, mu, sig, lm)
+    assert pw.shape == (2, 256)
+    assert float(pw[0, 130:].sum()) == 0.0
+    assert float(ps[0, 200]) == 1.0  # padded sigma stays safe
+
+
+def test_gmm_logpdf_rows_matches_xla_kernel():
+    rng = np.random.default_rng(0)
+    R, S, n_comp = 4, 128, 37
+    xs, rows = [], []
+    for _ in range(R):
+        w, mu, sigma = make_row(rng, n_comp)
+        rows.append((w, mu, sigma))
+        xs.append(rng.normal(0, 3.0, S))
+    x = jnp.asarray(np.stack(xs), jnp.float32)
+    w = jnp.asarray(np.stack([r[0] for r in rows]), jnp.float32)
+    mu = jnp.asarray(np.stack([r[1] for r in rows]), jnp.float32)
+    sig = jnp.asarray(np.stack([r[2] for r in rows]), jnp.float32)
+    lm = jnp.zeros((R, n_comp), jnp.float32)  # untruncated
+
+    got = np.asarray(gmm_logpdf_rows(x, w, mu, sig, lm, interpret=True))
+
+    for r in range(R):
+        want = np.asarray(
+            K.trunc_gmm_logpdf(
+                x[r], w[r], mu[r], sig[r],
+                jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+                jnp.asarray(False), jnp.float32(0.0),
+            )
+        )
+        np.testing.assert_allclose(got[r], want, rtol=2e-4, atol=2e-4)
+
+
+def test_gmm_logpdf_rows_with_zero_weight_padding():
+    """Components padded with w=0 must not perturb the density."""
+    rng = np.random.default_rng(1)
+    S = 128
+    w, mu, sigma = make_row(rng, 129)  # pads to 256
+    x = jnp.asarray(rng.normal(0, 2, S), jnp.float32)[None]
+    lm = jnp.zeros((1, 129), jnp.float32)
+    got = np.asarray(
+        gmm_logpdf_rows(
+            x, jnp.asarray(w, jnp.float32)[None],
+            jnp.asarray(mu, jnp.float32)[None],
+            jnp.asarray(sigma, jnp.float32)[None], lm, interpret=True,
+        )
+    )[0]
+    want = np.asarray(
+        K.trunc_gmm_logpdf(
+            x[0], jnp.asarray(w, jnp.float32), jnp.asarray(mu, jnp.float32),
+            jnp.asarray(sigma, jnp.float32),
+            jnp.float32(-jnp.inf), jnp.float32(jnp.inf),
+            jnp.asarray(False), jnp.float32(0.0),
+        )
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_ei_scores_consistency_with_parzen_pipeline():
+    """Full-path check: pallas EI scores == XLA EI scores on real fits."""
+    rng = np.random.default_rng(2)
+    cap = 64
+    obs = jnp.asarray(rng.normal(1.0, 2.0, cap), jnp.float32)
+    below_mask = jnp.asarray(np.arange(cap) < 8)
+    above_mask = jnp.asarray((np.arange(cap) >= 8) & (np.arange(cap) < 40))
+    pm, psig, pw = jnp.float32(0.0), jnp.float32(8.0), jnp.float32(1.0)
+    lf = jnp.float32(25.0)
+
+    wb, mb, sb = K.parzen_fit(obs, below_mask, pm, psig, pw, lf)
+    wa, ma, sa = K.parzen_fit(obs, above_mask, pm, psig, pw, lf)
+
+    samples = K.trunc_gmm_sample(
+        jax.random.key(0), wb, mb, sb, jnp.float32(-8.0), jnp.float32(10.0),
+        jnp.asarray(False), jnp.float32(0.0), 128,
+    )
+
+    def lmass(mu, sig):
+        from jax.scipy.special import ndtr
+
+        return jnp.log(
+            jnp.maximum(
+                ndtr((10.0 - mu) / sig) - ndtr((-8.0 - mu) / sig), 1e-30
+            )
+        )
+
+    below = (wb[None], mb[None], sb[None], lmass(mb, sb)[None])
+    above = (wa[None], ma[None], sa[None], lmass(ma, sa)[None])
+    got = np.asarray(ei_scores(samples[None], below, above, interpret=True))[0]
+
+    ll_b = K.trunc_gmm_logpdf(
+        samples, wb, mb, sb, jnp.float32(-8.0), jnp.float32(10.0),
+        jnp.asarray(False), jnp.float32(0.0),
+    )
+    ll_a = K.trunc_gmm_logpdf(
+        samples, wa, ma, sa, jnp.float32(-8.0), jnp.float32(10.0),
+        jnp.asarray(False), jnp.float32(0.0),
+    )
+    want = np.asarray(ll_b - ll_a)
+    np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-4)
+    # and the argmax (the decision that matters) agrees
+    assert int(np.argmax(got)) == int(np.argmax(want))
