@@ -111,7 +111,6 @@ def __getattr__(name):
     lazy = {
         "tpe_jax",
         "rand_jax",
-        "anneal_jax",
         "jax_trials",
         "ops",
         "parallel",
@@ -121,6 +120,8 @@ def __getattr__(name):
         "criteria",
         "plotting",
         "graphviz",
+        "vectorize",
+        "pyll_utils",
     }
     if name in lazy:
         import importlib

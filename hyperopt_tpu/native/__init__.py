@@ -1,0 +1,135 @@
+"""ctypes loader/builder for the native host-math library.
+
+Compiles ``tpe_math.cpp`` on first use (g++, cached next to the source),
+binds via ctypes (no pybind11 dependency), and exposes numpy-friendly
+wrappers with the exact :mod:`hyperopt_tpu.tpe` semantics.  Everything
+degrades gracefully: ``available()`` is False when no compiler or the
+build fails, and callers fall back to numpy.
+
+Opt out with ``HYPEROPT_TPU_NATIVE=0``; force with ``=1`` (raises if the
+build fails).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sysconfig
+import threading
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["available", "gmm_lpdf", "adaptive_parzen", "lib_path", "build"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "tpe_math.cpp")
+_LOCK = threading.Lock()
+_STATE = {"lib": None, "tried": False}
+
+
+def lib_path():
+    tag = sysconfig.get_platform().replace("-", "_")
+    return os.path.join(_HERE, f"libtpe_math_{tag}.so")
+
+
+def build(force=False):
+    """Compile the shared library; returns its path or raises."""
+    out = lib_path()
+    if os.path.exists(out) and not force:
+        if os.path.getmtime(out) >= os.path.getmtime(_SRC):
+            return out
+    cmd = [
+        "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+        _SRC, "-o", out + ".tmp",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    os.replace(out + ".tmp", out)
+    logger.info("built native tpe_math: %s", out)
+    return out
+
+
+def _load():
+    with _LOCK:
+        if _STATE["tried"]:
+            return _STATE["lib"]
+        _STATE["tried"] = True
+        mode = os.environ.get("HYPEROPT_TPU_NATIVE", "auto")
+        if mode == "0":
+            return None
+        try:
+            lib = ctypes.CDLL(build())
+        except Exception as e:
+            if mode == "1":
+                raise
+            logger.debug("native tpe_math unavailable: %s", e)
+            return None
+
+        c_double_p = ctypes.POINTER(ctypes.c_double)
+        lib.ht_gmm_lpdf.argtypes = [
+            c_double_p, ctypes.c_int64, c_double_p, c_double_p, c_double_p,
+            ctypes.c_int64, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int32, c_double_p,
+        ]
+        lib.ht_gmm_lpdf.restype = None
+        lib.ht_adaptive_parzen.argtypes = [
+            c_double_p, ctypes.c_int64, ctypes.c_double, ctypes.c_double,
+            ctypes.c_double, ctypes.c_int64, c_double_p, c_double_p, c_double_p,
+        ]
+        lib.ht_adaptive_parzen.restype = ctypes.c_int64
+        _STATE["lib"] = lib
+        return lib
+
+
+def available():
+    return _load() is not None
+
+
+def _as_c(a):
+    arr = np.ascontiguousarray(a, dtype=np.float64)
+    return arr, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def gmm_lpdf(x, w, mu, sigma, low=None, high=None, q=None, logspace=False):
+    """Native truncated/quantized (log)GMM log-density; None if no lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    x_arr, x_p = _as_c(np.atleast_1d(x))
+    w_arr, w_p = _as_c(w)
+    mu_arr, mu_p = _as_c(mu)
+    sig_arr, sig_p = _as_c(sigma)
+    out = np.empty(x_arr.shape[0], dtype=np.float64)
+    lib.ht_gmm_lpdf(
+        x_p, x_arr.shape[0], w_p, mu_p, sig_p, w_arr.shape[0],
+        float(-np.inf if low is None else low),
+        float(np.inf if high is None else high),
+        float(0.0 if q is None else q),
+        int(bool(logspace)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out
+
+
+def adaptive_parzen(mus, prior_weight, prior_mu, prior_sigma, lf):
+    """Native adaptive-Parzen fit; None if no lib."""
+    lib = _load()
+    if lib is None:
+        return None
+    mus_arr, mus_p = _as_c(np.atleast_1d(np.asarray(mus, dtype=np.float64)))
+    n = mus_arr.shape[0] if np.asarray(mus).size else 0
+    m = n + 1
+    w = np.empty(m)
+    mu = np.empty(m)
+    sig = np.empty(m)
+    lib.ht_adaptive_parzen(
+        mus_p, n, float(prior_weight), float(prior_mu), float(prior_sigma),
+        int(lf or 0),
+        w.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        mu.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        sig.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return w, mu, sig

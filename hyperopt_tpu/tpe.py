@@ -32,6 +32,14 @@ from .rand import docs_from_idxs_vals, _domain_helper
 
 logger = logging.getLogger(__name__)
 
+
+def _native():
+    """The optional C++ host-math library (None when unavailable)."""
+    from . import native as _native_mod
+
+    return _native_mod if _native_mod.available() else None
+
+
 __all__ = [
     "suggest",
     "suggest_batch",
@@ -87,6 +95,21 @@ def adaptive_parzen_normal(mus, prior_weight, prior_mu, prior_sigma, LF=None):
 
     Returns (weights, mus, sigmas) sorted by mu, weights normalized.
     """
+    if LF is None:
+        LF = _default_linear_forgetting
+    nat = _native()
+    if nat is not None:
+        fit = nat.adaptive_parzen(mus, prior_weight, prior_mu, prior_sigma, LF)
+        if fit is not None:
+            return fit
+    return adaptive_parzen_normal_numpy(mus, prior_weight, prior_mu,
+                                        prior_sigma, LF)
+
+
+def adaptive_parzen_normal_numpy(mus, prior_weight, prior_mu, prior_sigma,
+                                 LF=None):
+    """Pure-numpy adaptive-Parzen fit (the oracle the native/JAX paths are
+    validated against)."""
     if LF is None:
         LF = _default_linear_forgetting
     mus = np.asarray(mus, dtype=float)
@@ -214,6 +237,19 @@ def GMM1(weights, mus, sigmas, low=None, high=None, q=None, rng=None, size=()):
 def GMM1_lpdf(samples, weights, mus, sigmas, low=None, high=None, q=None):
     """log-density of ``samples`` under a truncated/quantized 1-D GMM."""
     samples = np.asarray(samples, dtype=float)
+    nat = _native()
+    if nat is not None:
+        out = nat.gmm_lpdf(samples.ravel(), weights, mus, sigmas,
+                           low=low, high=high, q=q, logspace=False)
+        if out is not None:
+            return out.reshape(samples.shape)
+    return GMM1_lpdf_numpy(samples, weights, mus, sigmas, low=low, high=high,
+                           q=q)
+
+
+def GMM1_lpdf_numpy(samples, weights, mus, sigmas, low=None, high=None, q=None):
+    """Pure-numpy GMM1_lpdf (oracle for native/JAX paths)."""
+    samples = np.asarray(samples, dtype=float)
     weights = np.asarray(weights, dtype=float)
     mus = np.asarray(mus, dtype=float)
     sigmas = np.maximum(np.asarray(sigmas, dtype=float), EPS)
@@ -267,6 +303,20 @@ def LGMM1(weights, mus, sigmas, low=None, high=None, q=None, rng=None, size=()):
 def LGMM1_lpdf(samples, weights, mus, sigmas, low=None, high=None, q=None):
     """log-density under a (truncated in log space, optionally quantized)
     lognormal mixture; ``samples`` are in natural space."""
+    samples = np.asarray(samples, dtype=float)
+    nat = _native()
+    if nat is not None:
+        out = nat.gmm_lpdf(samples.ravel(), weights, mus, sigmas,
+                           low=low, high=high, q=q, logspace=True)
+        if out is not None:
+            return out.reshape(samples.shape)
+    return LGMM1_lpdf_numpy(samples, weights, mus, sigmas, low=low, high=high,
+                            q=q)
+
+
+def LGMM1_lpdf_numpy(samples, weights, mus, sigmas, low=None, high=None,
+                     q=None):
+    """Pure-numpy LGMM1_lpdf (oracle for native/JAX paths)."""
     samples = np.asarray(samples, dtype=float)
     weights = np.asarray(weights, dtype=float)
     mus = np.asarray(mus, dtype=float)

@@ -1,0 +1,110 @@
+"""Native (C++) host-math library: build + exact parity with the numpy
+oracle implementations in hyperopt_tpu.tpe."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import native
+from hyperopt_tpu.tpe import (
+    GMM1_lpdf_numpy,
+    LGMM1_lpdf_numpy,
+    adaptive_parzen_normal_numpy,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain / native build failed"
+)
+
+
+def test_build_produces_loadable_lib():
+    assert os.path.exists(native.lib_path())
+    assert native.available()
+
+
+@pytest.mark.parametrize("n_obs", [0, 1, 2, 7, 40])
+def test_adaptive_parzen_parity(n_obs):
+    rng = np.random.default_rng(n_obs)
+    obs = rng.normal(0.5, 2.0, n_obs)
+    want = adaptive_parzen_normal_numpy(obs, 1.0, 0.0, 5.0, 25)
+    got = native.adaptive_parzen(obs, 1.0, 0.0, 5.0, 25)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-12, atol=1e-12)
+
+
+def test_adaptive_parzen_parity_no_forgetting():
+    rng = np.random.default_rng(9)
+    obs = rng.normal(0, 1, 30)
+    want = adaptive_parzen_normal_numpy(obs, 0.5, 1.0, 3.0, 0)
+    got = native.adaptive_parzen(obs, 0.5, 1.0, 3.0, 0)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.parametrize(
+    "low,high,q,logspace",
+    [
+        (None, None, None, False),
+        (-2.0, 3.0, None, False),
+        (0.0, 10.0, 1.0, False),
+        (None, None, None, True),
+        (-1.0, 1.0, None, True),
+        (None, None, 0.5, True),
+    ],
+)
+def test_gmm_lpdf_parity(low, high, q, logspace):
+    rng = np.random.default_rng(0)
+    K = 9
+    w = rng.uniform(0.1, 1.0, K)
+    w = w / w.sum()
+    mu = rng.normal(0.5, 1.5, K)
+    sigma = rng.uniform(0.2, 2.0, K)
+    if logspace:
+        x = rng.uniform(0.05, 6.0, 40)
+        if q:
+            x = np.maximum(np.round(x / q) * q, 0.0)
+        want = LGMM1_lpdf_numpy(x, w, mu, sigma, low=low, high=high, q=q)
+    else:
+        x = rng.uniform(-3.0, 8.0, 40)
+        if q:
+            x = np.round(x / q) * q
+            x = np.clip(x, low, high)
+        want = GMM1_lpdf_numpy(x, w, mu, sigma, low=low, high=high, q=q)
+    got = native.gmm_lpdf(x, w, mu, sigma, low=low, high=high, q=q,
+                          logspace=logspace)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
+
+
+def test_dispatch_used_by_public_api():
+    """The public GMM1_lpdf must agree with the numpy oracle regardless of
+    which backend actually served it."""
+    from hyperopt_tpu.tpe import GMM1_lpdf
+
+    rng = np.random.default_rng(3)
+    w = np.array([0.3, 0.7])
+    mu = np.array([-1.0, 2.0])
+    sigma = np.array([0.5, 1.5])
+    x = rng.normal(0, 2, 16)
+    np.testing.assert_allclose(
+        GMM1_lpdf(x, w, mu, sigma, low=-4.0, high=4.0),
+        GMM1_lpdf_numpy(x, w, mu, sigma, low=-4.0, high=4.0),
+        rtol=1e-9,
+    )
+
+
+def test_native_speedup_sane():
+    import time
+
+    rng = np.random.default_rng(1)
+    K, S = 500, 256
+    w = np.full(K, 1.0 / K)
+    mu = rng.normal(0, 3, K)
+    sigma = rng.uniform(0.5, 1.5, K)
+    x = rng.normal(0, 3, S)
+
+    t0 = time.perf_counter()
+    for _ in range(20):
+        native.gmm_lpdf(x, w, mu, sigma, low=-8.0, high=8.0)
+    native_dt = time.perf_counter() - t0
+    assert native_dt < 5.0
