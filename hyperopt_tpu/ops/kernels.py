@@ -34,17 +34,38 @@ __all__ = [
 ]
 
 
+def _below_pad(lf):
+    """Static buffer width for the compacted below set: n_below <= lf, so
+    lf slots (rounded up to a multiple of 8 sublanes) always suffice."""
+    return max(8, (int(lf) + 7) // 8 * 8)
+
+
+def compact_below(obs_row, below_row, lf_pad):
+    """Gather the (few) below-set slots of one dim into a small buffer.
+
+    The below model has at most ``n_below <= LF`` components, but the
+    observation buffer is capacity-sized; compacting before the Parzen fit
+    shrinks the candidate-scoring inner dimension ~cap/LF-fold.  A stable
+    argsort on ~mask keeps slot (time) order, so forgetting weights are
+    unchanged.
+    """
+    order = jnp.argsort(~below_row, stable=True)
+    idx = order[:lf_pad]
+    return obs_row[idx], below_row[idx]
+
+
 def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weight):
     """Shared front half of a TPE suggest step: good/bad split + vmapped
     Parzen/categorical fits for every dimension.
 
     Args mirror the ObsBuffer arrays; ``ps_consts`` is PackedSpace._consts.
-    Returns a dict with continuous fits (wb/mb/sb/wa/ma/sa: [Dc, cap+1])
-    and categorical posteriors (pb/pa: [Dk, k_max]); entries are None for
-    absent families.
+    Returns a dict with continuous fits (below compacted to [Dc, lf_pad+1],
+    above full [Dc, cap+1]) and categorical posteriors (pb/pa: [Dk, k_max]);
+    entries are None for absent families.
     """
     below, above, _ = split_below_above(losses, valid, gamma, lf)
     out = {"cont": None, "cat": None}
+    lf_pad = _below_pad(lf)
 
     cont_idx = ps_consts["cont_idx"]
     if cont_idx.shape[0]:
@@ -59,8 +80,11 @@ def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weig
         pw_v = jnp.full((dc,), prior_weight, dtype=jnp.float32)
         lf_v = jnp.full((dc,), lf, dtype=jnp.float32)
         fit = jax.vmap(parzen_fit)
+        lat_b, mask_b = jax.vmap(compact_below, in_axes=(0, 0, None))(
+            lat, act_c & below[None, :], lf_pad
+        )
         wb, mb, sb = fit(
-            lat, act_c & below[None, :],
+            lat_b, mask_b,
             ps_consts["prior_mu"], ps_consts["prior_sigma"], pw_v, lf_v,
         )
         wa, ma, sa = fit(
@@ -77,7 +101,10 @@ def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weig
         pw_v = jnp.full((dk,), prior_weight, dtype=jnp.float32)
         lf_v = jnp.full((dk,), lf, dtype=jnp.float32)
         cfit = jax.vmap(categorical_fit)
-        pb = cfit(obs_k, act_k & below[None, :], ps_consts["prior_p"], pw_v, lf_v)
+        obs_kb, mask_kb = jax.vmap(compact_below, in_axes=(0, 0, None))(
+            obs_k, act_k & below[None, :], lf_pad
+        )
+        pb = cfit(obs_kb, mask_kb, ps_consts["prior_p"], pw_v, lf_v)
         pa = cfit(obs_k, act_k & above[None, :], ps_consts["prior_p"], pw_v, lf_v)
         out["cat"] = (pb, pa)
 
