@@ -82,7 +82,7 @@ def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
-    arrays = tuple(map(jax.device_put, buf.arrays()))
+    arrays = buf.device_arrays()
     key = jax.random.key(0)
 
     out = fn(key, *arrays, batch=batch)  # compile
@@ -105,7 +105,7 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
-    arrays = tuple(map(jax.device_put, buf.arrays()))
+    arrays = buf.device_arrays()
     key = jax.random.key(1)
     jax.block_until_ready(fn(key, *arrays, batch=1))
     t0 = time.perf_counter()

@@ -46,6 +46,8 @@ class ObsBuffer:
         self.valid = np.zeros(self.capacity, dtype=bool)
         self.count = 0
         self._n_scanned = 0  # trials-list prefix already ingested
+        self._generation = 0  # bumped on every mutation
+        self._device_cache = None  # (generation, arrays-on-device)
 
     def _grow(self):
         new_cap = self.capacity * 2
@@ -76,6 +78,7 @@ class ObsBuffer:
         self.losses[i] = loss
         self.valid[i] = True
         self.count += 1
+        self._generation += 1
 
     @property
     def _label_pos(self):
@@ -116,6 +119,19 @@ class ObsBuffer:
     def arrays(self):
         """The four dense arrays at current (bucketed) capacity."""
         return self.values, self.active, self.losses, self.valid
+
+    def device_arrays(self):
+        """The four arrays on the default device, cached by generation:
+        repeated suggest calls against unchanged history transfer nothing
+        (the 'on-device history' contract of the north star)."""
+        if self._device_cache is None or self._device_cache[0] != self._generation:
+            import jax
+
+            self._device_cache = (
+                self._generation,
+                tuple(jax.device_put(a) for a in self.arrays()),
+            )
+        return self._device_cache[1]
 
 
 class JaxTrials(Trials):

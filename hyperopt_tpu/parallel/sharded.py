@@ -184,7 +184,7 @@ def sharded_suggest(
                 float(linear_forgetting), float(prior_weight),
             )
             cache[ck] = fn
-        values, active = fn(key, *buf.arrays(), batch=B)
+        values, active = fn(key, *buf.device_arrays(), batch=B)
 
     from ..tpe_jax import _cast_vals
 

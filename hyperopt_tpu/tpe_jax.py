@@ -152,7 +152,7 @@ def suggest_batch(
             domain, int(n_EI_candidates), float(gamma),
             float(linear_forgetting), float(prior_weight),
         )
-        values, active = fn(key, *buf.arrays(), batch=B)
+        values, active = fn(key, *buf.device_arrays(), batch=B)
 
     idxs, vals = dense_to_idxs_vals(
         new_ids, ps.labels, np.asarray(values), np.asarray(active)
