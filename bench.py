@@ -118,7 +118,12 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
 def main():
     from hyperopt_tpu.models.synthetic import mixed_space
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    import jax
+
+    # headline batch on an accelerator; CPU-only runs get a size that
+    # finishes in minutes (the program is deliberately TPU-sized)
+    default_batch = "4096" if jax.devices()[0].platform != "cpu" else "64"
+    batch = int(os.environ.get("BENCH_BATCH", default_batch))
     n_cand = int(os.environ.get("BENCH_N_CAND", "128"))
     n_obs = int(os.environ.get("BENCH_N_OBS", "500"))
 
@@ -127,8 +132,6 @@ def main():
 
     numpy_rate = bench_host_tpe(domain, trials, native=False)
     native_rate = bench_host_tpe(domain, trials, native=True)
-
-    import jax
 
     platform = jax.devices()[0].platform
     jax_rate, _ = bench_jax_tpe(domain, trials, batch=batch, n_cand=n_cand)

@@ -24,7 +24,11 @@ __all__ = [
     "forgetting_weights",
     "parzen_fit",
     "trunc_gmm_sample",
+    "trunc_gmm_sample_pre",
     "trunc_gmm_logpdf",
+    "gmm_precompute",
+    "gmm_logpdf_cont_pre",
+    "gmm_logpdf_quant_pre",
     "categorical_fit",
     "split_below_above",
     "ei_argmax",
@@ -191,22 +195,78 @@ def _safe_log(x):
     return jnp.log(jnp.maximum(x, F32_TINY))
 
 
-def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_samples):
-    """Draw ``n_samples`` from a truncated (latent-space) GMM.
+def gmm_precompute(weights, mus, sigmas, low, high):
+    """Per-component constants shared by sampling and scoring.
 
-    ``low``/``high`` are latent-space bounds (+-inf when unbounded);
-    ``logspace`` exponentiates draws into natural space; ``q > 0``
-    quantizes in natural space.  Inverse-CDF truncation -- no rejection.
+    Everything here is [K]-sized, so under the batch ``vmap`` (which maps
+    fits with ``in_axes=None``) it is computed once per dimension, not per
+    trial or candidate -- the [S, K] inner loops below touch only
+    precomputed reciprocals and log-constants.
+    """
+    sig = jnp.maximum(sigmas, TINY)
+    inv_s = 1.0 / sig
+    a = ndtr((low - mus) * inv_s)
+    b = ndtr((high - mus) * inv_s)
+    log_mass = _safe_log(b - a)
+    logw = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
+    # c1 folds every per-component additive term of the truncated-normal
+    # log-density, so a scored term is just c1 - 0.5 * z^2.
+    c1 = logw - log_mass - jnp.log(sig) - 0.5 * jnp.log(2.0 * jnp.pi)
+    cdf = jnp.cumsum(jnp.maximum(weights, 0.0))
+    return {
+        "mus": mus,
+        "sig": sig,
+        "inv_s": inv_s,
+        "mu_inv_s": mus * inv_s,
+        "a": a,
+        "b": b,
+        "log_mass": log_mass,
+        "logw": logw,
+        "c1": c1,
+        "cdf": cdf,
+    }
+
+
+def _inverse_cdf_onehot(u, cdf):
+    """[S, K] one-hot component pick per sample via inverse-CDF on the
+    weight cumsum.
+
+    One uniform per sample + [S, K] compares -- far cheaper on the VPU
+    than ``jax.random.categorical``'s K Gumbel draws per sample.  The
+    one-hot is the difference of adjacent step functions.  ``scaled`` is
+    clamped strictly below ``cdf[-1]`` so float rounding at ``u * cdf[-1]
+    == cdf[-1]`` cannot step past the last *positive-weight* component
+    into trailing zero-weight (padded) slots; interior zero-weight
+    components have ``cdf[j] == cdf[j-1]`` and are never selected.  The
+    forced last column only fires in the degenerate all-zero-weight case.
+    """
+    n = u.shape[0]
+    scaled = jnp.minimum(u * cdf[-1], cdf[-1] * (1.0 - 1e-6))[:, None]
+    step = jnp.concatenate(
+        [scaled < cdf[None, :-1], jnp.ones((n, 1), dtype=bool)], axis=1
+    ).astype(u.dtype)
+    return step - jnp.concatenate(
+        [jnp.zeros((n, 1), dtype=u.dtype), step[:, :-1]], axis=1
+    )
+
+
+def trunc_gmm_sample_pre(key, pre, low, high, logspace, q, n_samples):
+    """Draw ``n_samples`` from a truncated (latent-space) GMM given its
+    :func:`gmm_precompute` dict.  Inverse-CDF truncation -- no rejection.
+
+    Per-sample component parameters come from a fused one-hot
+    multiply-sum over K (XLA fuses all four reductions into one [S, K]
+    loop) -- TPU gathers serialize and were the measured bottleneck.
     """
     k_comp, k_u = jax.random.split(key)
-    logits = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
-    comp = jax.random.categorical(k_comp, logits, shape=(n_samples,))
-    m = mus[comp]
-    s = jnp.maximum(sigmas[comp], TINY)
+    u_comp = jax.random.uniform(k_comp, (n_samples,), dtype=pre["mus"].dtype)
+    onehot = _inverse_cdf_onehot(u_comp, pre["cdf"])
+    m = jnp.sum(onehot * pre["mus"], axis=1)
+    s = jnp.sum(onehot * pre["sig"], axis=1)
+    a = jnp.sum(onehot * pre["a"], axis=1)
+    b = jnp.sum(onehot * pre["b"], axis=1)
 
-    a = ndtr((low - m) / s)
-    b = ndtr((high - m) / s)
-    u = jax.random.uniform(k_u, (n_samples,), dtype=mus.dtype)
+    u = jax.random.uniform(k_u, (n_samples,), dtype=pre["mus"].dtype)
     p = jnp.clip(a + u * (b - a), TINY, 1.0 - 1e-7)
     x = m + s * ndtri(p)
     x = jnp.clip(x, low, high)
@@ -224,27 +284,30 @@ def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_sample
     return jnp.where(q > 0, rounded, nat)
 
 
-def trunc_gmm_logpdf(x, weights, mus, sigmas, low, high, logspace, q):
-    """log-density of natural-space samples ``x`` [S] under the truncated
-    (optionally quantized / log-space) GMM with components [K]."""
-    sigmas = jnp.maximum(sigmas, TINY)
-    logw = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
+def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_samples):
+    """Draw ``n_samples`` from a truncated (latent-space) GMM.
 
-    a = ndtr((low - mus) / sigmas)
-    b = ndtr((high - mus) / sigmas)
-    log_mass = _safe_log(b - a)  # [K]
+    ``low``/``high`` are latent-space bounds (+-inf when unbounded);
+    ``logspace`` exponentiates draws into natural space; ``q > 0``
+    quantizes in natural space.
+    """
+    pre = gmm_precompute(weights, mus, sigmas, low, high)
+    return trunc_gmm_sample_pre(key, pre, low, high, logspace, q, n_samples)
 
-    lat = jnp.where(logspace, _safe_log(x), x)[:, None]  # [S,1]
 
-    # continuous density
-    z = (lat - mus) / sigmas
-    log_pdf = -0.5 * z * z - jnp.log(sigmas) - 0.5 * jnp.log(2.0 * jnp.pi)
-    jac = jnp.where(logspace, jnp.squeeze(lat, -1), 0.0)  # d(log x)/dx
-    ll_cont = (
-        jax.scipy.special.logsumexp(logw + log_pdf - log_mass, axis=1) - jac
-    )
+def gmm_logpdf_cont_pre(x, pre, logspace):
+    """Continuous (unquantized) truncated-GMM log-density at natural-space
+    ``x`` [S]: one fused multiply + exp per [S, K] term.  Truncation
+    bounds are already folded into ``pre['c1']`` via the log-mass."""
+    lat = jnp.where(logspace, _safe_log(x), x)
+    z = lat[:, None] * pre["inv_s"] - pre["mu_inv_s"]
+    terms = pre["c1"] - 0.5 * z * z
+    jac = jnp.where(logspace, lat, 0.0)
+    return jax.scipy.special.logsumexp(terms, axis=1) - jac
 
-    # quantized bin mass
+
+def gmm_logpdf_quant_pre(x, pre, low, high, logspace, q):
+    """Quantized bin-mass log-density at natural-space ``x`` [S]."""
     qq = jnp.maximum(q, TINY)
     ub_nat = x + qq / 2.0
     lb_nat = x - qq / 2.0
@@ -252,11 +315,25 @@ def trunc_gmm_logpdf(x, weights, mus, sigmas, low, high, logspace, q):
     lb_lat = jnp.where(logspace, _safe_log(lb_nat), lb_nat)[:, None]
     ub_lat = jnp.minimum(ub_lat, high)
     lb_lat = jnp.maximum(lb_lat, low)
-    bin_mass = ndtr((ub_lat - mus) / sigmas) - ndtr((lb_lat - mus) / sigmas)
-    ll_q = jax.scipy.special.logsumexp(
-        logw + _safe_log(bin_mass) - log_mass, axis=1
+    inv_s = pre["inv_s"]
+    mu_inv_s = pre["mu_inv_s"]
+    bin_mass = ndtr(ub_lat * inv_s - mu_inv_s) - ndtr(lb_lat * inv_s - mu_inv_s)
+    return jax.scipy.special.logsumexp(
+        pre["logw"] + _safe_log(bin_mass) - pre["log_mass"], axis=1
     )
 
+
+def trunc_gmm_logpdf(x, weights, mus, sigmas, low, high, logspace, q):
+    """log-density of natural-space samples ``x`` [S] under the truncated
+    (optionally quantized / log-space) GMM with components [K].
+
+    General (traced-``q``) form computing both families; the suggest path
+    partitions dims by static ``q > 0`` at build time and calls the
+    ``*_pre`` halves directly so each dim pays only its own family.
+    """
+    pre = gmm_precompute(weights, mus, sigmas, low, high)
+    ll_cont = gmm_logpdf_cont_pre(x, pre, logspace)
+    ll_q = gmm_logpdf_quant_pre(x, pre, low, high, logspace, q)
     return jnp.where(q > 0, ll_q, ll_cont)
 
 
@@ -307,20 +384,53 @@ def ei_argmax(samples, ll_below, ll_above):
     return samples[jnp.argmax(score)], jnp.max(score)
 
 
-def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand):
+def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
+                 has_q=None):
     """One continuous dim: draw n_cand from the below-model, score the EI
-    log-likelihood ratio, return (best value, best score)."""
-    samples = trunc_gmm_sample(key, wb, mb, sb, low, high, logspace, q, n_cand)
-    ll_b = trunc_gmm_logpdf(samples, wb, mb, sb, low, high, logspace, q)
-    ll_a = trunc_gmm_logpdf(samples, wa, ma, sa, low, high, logspace, q)
+    log-likelihood ratio, return (best value, best score).
+
+    ``has_q`` is a *static* (trace-time) flag: True = quantized bin-mass
+    scoring only, False = continuous density only, None = traced ``q``
+    dispatch (computes both families; parity/compat path).
+    """
+    pre_b = gmm_precompute(wb, mb, sb, low, high)
+    pre_a = gmm_precompute(wa, ma, sa, low, high)
+    samples = trunc_gmm_sample_pre(key, pre_b, low, high, logspace, q, n_cand)
+    if has_q is True:
+        ll_b = gmm_logpdf_quant_pre(samples, pre_b, low, high, logspace, q)
+        ll_a = gmm_logpdf_quant_pre(samples, pre_a, low, high, logspace, q)
+    elif has_q is False:
+        ll_b = gmm_logpdf_cont_pre(samples, pre_b, logspace)
+        ll_a = gmm_logpdf_cont_pre(samples, pre_a, logspace)
+    else:
+        ll_b = jnp.where(
+            q > 0,
+            gmm_logpdf_quant_pre(samples, pre_b, low, high, logspace, q),
+            gmm_logpdf_cont_pre(samples, pre_b, logspace),
+        )
+        ll_a = jnp.where(
+            q > 0,
+            gmm_logpdf_quant_pre(samples, pre_a, low, high, logspace, q),
+            gmm_logpdf_cont_pre(samples, pre_a, logspace),
+        )
     return ei_argmax(samples, ll_b, ll_a)
 
 
 def ei_best_cat(key, p_below, p_above, n_cand):
     """One categorical dim: draw candidate categories from the below
-    posterior, score log p_b - log p_a, return (best index, best score)."""
-    logits = jnp.where(p_below > 0, _safe_log(p_below), -jnp.inf)
-    cands = jax.random.categorical(key, logits, shape=(n_cand,))
-    llr = _safe_log(p_below[cands]) - _safe_log(p_above[cands])
-    best = jnp.argmax(llr)
-    return cands[best].astype(jnp.float32), llr[best]
+    posterior, score log p_b - log p_a, return (best index, best score).
+
+    Equivalent to scoring each drawn candidate and taking the argmax:
+    the winner is the category with the highest llr among those *hit* by
+    any draw, so only the [S, K] hit mask is needed -- no per-sample
+    gathers.
+    """
+    u = jax.random.uniform(key, (n_cand,), dtype=p_below.dtype)
+    onehot = _inverse_cdf_onehot(u, jnp.cumsum(jnp.maximum(p_below, 0.0)))
+    hit = jnp.any(onehot > 0, axis=0)  # [K]
+    # padded options (p_below == 0) must never win the argmax
+    llr = jnp.where(
+        p_below > 0, _safe_log(p_below) - _safe_log(p_above), -jnp.inf
+    )
+    best = jnp.argmax(jnp.where(hit, llr, -jnp.inf))
+    return best.astype(jnp.float32), llr[best]

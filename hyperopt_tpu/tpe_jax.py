@@ -68,18 +68,33 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
         keys = jax.random.split(key, max(n_keys, 1))
 
         if fits["cont"] is not None:
-            wb, mb, sb, wa, ma, sa = fits["cont"]
+            fit_arrays = fits["cont"]  # (wb, mb, sb, wa, ma, sa)
             cont_keys = keys[: batch * Dc].reshape(batch, Dc)
-            per_dim = jax.vmap(
-                lambda k, *a: K.ei_best_cont(k, *a, n_cand=n_cand)[0],
-                in_axes=(0,) * 11,
-            )
-            per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
-            cont_vals = per_batch(
-                cont_keys, wb, mb, sb, wa, ma, sa,
-                c["low"], c["high"], c["logspace"], c["q"],
-            )  # [B, Dc]
-            new_values = new_values.at[c["cont_idx"]].set(cont_vals.T)
+            # Static q/non-q partition: only quantized dims pay the
+            # ndtr-heavy bin-mass scoring; the rest run the cheap
+            # continuous-density family (one exp per [S, K] term).
+            q_np = np.asarray(ps.q)
+            for has_q, pos in (
+                (False, np.flatnonzero(q_np <= 0)),
+                (True, np.flatnonzero(q_np > 0)),
+            ):
+                if pos.size == 0:
+                    continue
+                grp_fits = tuple(t[pos] for t in fit_arrays)
+                grp_consts = tuple(
+                    c[k][pos] for k in ("low", "high", "logspace", "q")
+                )
+                per_dim = jax.vmap(
+                    lambda k, *a: K.ei_best_cont(
+                        k, *a, n_cand=n_cand, has_q=has_q
+                    )[0],
+                    in_axes=(0,) * 11,
+                )
+                per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
+                grp_vals = per_batch(
+                    cont_keys[:, pos], *grp_fits, *grp_consts
+                )  # [B, |pos|]
+                new_values = new_values.at[c["cont_idx"][pos]].set(grp_vals.T)
 
         if fits["cat"] is not None:
             pb, pa = fits["cat"]
