@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.scipy.special import ndtr, ndtri
 
 __all__ = [
@@ -34,6 +35,8 @@ __all__ = [
     "ei_argmax",
     "ei_best_cont",
     "ei_best_cat",
+    "ei_sweep_cont",
+    "ei_sweep_cat",
     "fit_all_dims",
 ]
 
@@ -414,6 +417,62 @@ def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
             gmm_logpdf_cont_pre(samples, pre_a, logspace),
         )
     return ei_argmax(samples, ll_b, ll_a)
+
+
+def ei_sweep_cont(q_np, consts, cont_keys, fit_arrays, n_cand):
+    """Batched continuous EI sweep over all trials x continuous dims.
+
+    The single shared implementation of the candidate sweep used by both
+    the single-device (:mod:`hyperopt_tpu.tpe_jax`) and mesh-sharded
+    (:mod:`hyperopt_tpu.parallel.sharded`) suggest builders.  Dims are
+    partitioned by *static* ``q > 0`` (``q_np`` is the host numpy q
+    vector) so only quantized dims pay the ndtr-heavy bin-mass scoring;
+    the rest run the cheap continuous-density family.
+
+    Args:
+      q_np: host [Dc] numpy array of quantizations (static).
+      consts: PackedSpace._consts dict (needs low/high/logspace/q).
+      cont_keys: [B, Dc] PRNG keys.
+      fit_arrays: (wb, mb, sb, wa, ma, sa), leading dim Dc.
+      n_cand: candidates per (trial, dim) (static).
+
+    Returns (vals, scores): each [B, Dc], in cont-dim order.
+    """
+    B, Dc = cont_keys.shape
+    vals = jnp.zeros((B, Dc), jnp.float32)
+    scores = jnp.full((B, Dc), -jnp.inf, jnp.float32)
+    q_np = np.asarray(q_np)
+    for has_q, pos in (
+        (False, np.flatnonzero(q_np <= 0)),
+        (True, np.flatnonzero(q_np > 0)),
+    ):
+        if pos.size == 0:
+            continue
+        grp_fits = tuple(t[pos] for t in fit_arrays)
+        grp_consts = tuple(
+            consts[k][pos] for k in ("low", "high", "logspace", "q")
+        )
+        per_dim = jax.vmap(
+            lambda k, *a: ei_best_cont(k, *a, n_cand=n_cand, has_q=has_q),
+            in_axes=(0,) * 11,
+        )
+        per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
+        gv, gs = per_batch(cont_keys[:, pos], *grp_fits, *grp_consts)
+        vals = vals.at[:, pos].set(gv)
+        scores = scores.at[:, pos].set(gs)
+    return vals, scores
+
+
+def ei_sweep_cat(cat_keys, pb, pa, n_cand):
+    """Batched categorical EI sweep: [B, Dk] keys x [Dk, K] posteriors ->
+    (vals, scores) each [B, Dk] (values are category indices as floats,
+    before int_low offset)."""
+    per_cat = jax.vmap(
+        lambda k, b, a: ei_best_cat(k, b, a, n_cand=n_cand),
+        in_axes=(0, 0, 0),
+    )
+    per_batch = jax.vmap(per_cat, in_axes=(0, None, None))
+    return per_batch(cat_keys, pb, pa)
 
 
 def ei_best_cat(key, p_below, p_above, n_cand):

@@ -76,25 +76,15 @@ def build_sharded_suggest_fn(
         out_scores = []
         if Dc:
             cont_keys = keys[: batch * Dc].reshape(batch, Dc)
-            per_dim = jax.vmap(
-                lambda k, *a: K.ei_best_cont(k, *a, n_cand=n_cand_per_device),
-                in_axes=(0,) * 11,
-            )
-            per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
-            v, s = per_batch(
-                cont_keys, wb, mb, sb, wa, ma, sa,
-                c["low"], c["high"], c["logspace"], c["q"],
+            v, s = K.ei_sweep_cont(
+                ps.q, c, cont_keys, (wb, mb, sb, wa, ma, sa),
+                n_cand_per_device,
             )  # [B, Dc] each
             out_vals.append(v)
             out_scores.append(s)
         if Dk:
             cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            per_cat = jax.vmap(
-                lambda k, b, a: K.ei_best_cat(k, b, a, n_cand=n_cand_per_device),
-                in_axes=(0, 0, 0),
-            )
-            per_batch_cat = jax.vmap(per_cat, in_axes=(0, None, None))
-            v, s = per_batch_cat(cat_keys, pb, pa)  # [B, Dk]
+            v, s = K.ei_sweep_cat(cat_keys, pb, pa, n_cand_per_device)  # [B, Dk]
             out_vals.append(v)
             out_scores.append(s)
         vals = jnp.concatenate(out_vals, axis=1)  # [B, Dc+Dk]

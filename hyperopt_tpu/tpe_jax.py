@@ -68,43 +68,16 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
         keys = jax.random.split(key, max(n_keys, 1))
 
         if fits["cont"] is not None:
-            fit_arrays = fits["cont"]  # (wb, mb, sb, wa, ma, sa)
             cont_keys = keys[: batch * Dc].reshape(batch, Dc)
-            # Static q/non-q partition: only quantized dims pay the
-            # ndtr-heavy bin-mass scoring; the rest run the cheap
-            # continuous-density family (one exp per [S, K] term).
-            q_np = np.asarray(ps.q)
-            for has_q, pos in (
-                (False, np.flatnonzero(q_np <= 0)),
-                (True, np.flatnonzero(q_np > 0)),
-            ):
-                if pos.size == 0:
-                    continue
-                grp_fits = tuple(t[pos] for t in fit_arrays)
-                grp_consts = tuple(
-                    c[k][pos] for k in ("low", "high", "logspace", "q")
-                )
-                per_dim = jax.vmap(
-                    lambda k, *a: K.ei_best_cont(
-                        k, *a, n_cand=n_cand, has_q=has_q
-                    )[0],
-                    in_axes=(0,) * 11,
-                )
-                per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
-                grp_vals = per_batch(
-                    cont_keys[:, pos], *grp_fits, *grp_consts
-                )  # [B, |pos|]
-                new_values = new_values.at[c["cont_idx"][pos]].set(grp_vals.T)
+            cont_vals, _ = K.ei_sweep_cont(
+                ps.q, c, cont_keys, fits["cont"], n_cand
+            )  # scores unused here; XLA dead-code-eliminates them
+            new_values = new_values.at[c["cont_idx"]].set(cont_vals.T)
 
         if fits["cat"] is not None:
             pb, pa = fits["cat"]
             cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            per_cat = jax.vmap(
-                lambda k, b, a: K.ei_best_cat(k, b, a, n_cand=n_cand)[0],
-                in_axes=(0, 0, 0),
-            )
-            per_batch_cat = jax.vmap(per_cat, in_axes=(0, None, None))
-            cat_vals = per_batch_cat(cat_keys, pb, pa)  # [B, Dk]
+            cat_vals, _ = K.ei_sweep_cat(cat_keys, pb, pa, n_cand)
             new_values = new_values.at[c["cat_idx"]].set(
                 cat_vals.T + c["int_low"][:, None]
             )
