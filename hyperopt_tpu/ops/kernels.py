@@ -41,10 +41,20 @@ __all__ = [
 ]
 
 
-def _below_pad(lf):
-    """Static buffer width for the compacted below set: n_below <= lf, so
-    lf slots (rounded up to a multiple of 8 sublanes) always suffice."""
-    return max(8, (int(lf) + 7) // 8 * 8)
+def _below_pad(lf, cap=None, gamma=None):
+    """Static buffer width for the compacted below set.
+
+    ``n_below = min(ceil(gamma * sqrt(n_ok)), lf)`` and ``n_ok <= cap``, so
+    ``min(lf, ceil(gamma * sqrt(cap)))`` slots always suffice -- for typical
+    capacities this is far below ``lf`` (cap=512, gamma=.25 -> 6), which
+    shrinks every [S, K_below] sampling/scoring loop.  Rounded up to a
+    multiple of 8 sublanes."""
+    bound = int(lf)
+    if cap is not None and gamma is not None and gamma > 0:
+        import math
+
+        bound = min(bound, int(math.ceil(gamma * math.sqrt(float(cap)))))
+    return max(8, (bound + 7) // 8 * 8)
 
 
 def compact_below(obs_row, below_row, lf_pad):
@@ -72,7 +82,7 @@ def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weig
     """
     below, above, _ = split_below_above(losses, valid, gamma, lf)
     out = {"cont": None, "cat": None}
-    lf_pad = _below_pad(lf)
+    lf_pad = _below_pad(lf, cap=losses.shape[0], gamma=gamma)
 
     cont_idx = ps_consts["cont_idx"]
     if cont_idx.shape[0]:
@@ -214,43 +224,51 @@ def gmm_precompute(weights, mus, sigmas, low, high):
     logw = jnp.where(weights > 0, _safe_log(weights), -jnp.inf)
     # c1 folds every per-component additive term of the truncated-normal
     # log-density, so a scored term is just c1 - 0.5 * z^2.
-    c1 = logw - log_mass - jnp.log(sig) - 0.5 * jnp.log(2.0 * jnp.pi)
+    c1 = jnp.where(
+        weights > 0,
+        logw - log_mass - jnp.log(sig) - 0.5 * jnp.log(2.0 * jnp.pi),
+        -jnp.inf,
+    )
+    c1max = jnp.max(c1)
+    c1max = jnp.where(jnp.isfinite(c1max), c1max, 0.0)
     cdf = jnp.cumsum(jnp.maximum(weights, 0.0))
+    cdf_lo = jnp.concatenate([jnp.zeros((1,), cdf.dtype), cdf[:-1]])
     return {
         "mus": mus,
-        "sig": sig,
         "inv_s": inv_s,
         "mu_inv_s": mus * inv_s,
-        "a": a,
-        "b": b,
-        "log_mass": log_mass,
-        "logw": logw,
+        # w / truncated-mass, 0 on padded slots: the quantized bin-mass
+        # scorer sums wmass * bin_mass directly (single log at the end).
+        "wmass": jnp.where(weights > 0, weights / jnp.maximum(b - a, TINY), 0.0),
         "c1": c1,
+        # exact upper bound on any scored term (z^2 >= 0): single-pass
+        # logsumexp stabilization without the per-sample max sweep.
+        "c1max": c1max,
         "cdf": cdf,
+        "cdf_lo": cdf_lo,
+        # [K, 4] stacked per-component params (mu, sigma, cdf-low,
+        # cdf-high): the sampler's one-hot pick contracts against this
+        # once instead of running four masked reductions.
+        "params4": jnp.stack([mus, sig, a, b], axis=-1),
     }
 
 
-def _inverse_cdf_onehot(u, cdf):
+def _inverse_cdf_onehot(u, cdf, cdf_lo=None):
     """[S, K] one-hot component pick per sample via inverse-CDF on the
     weight cumsum.
 
-    One uniform per sample + [S, K] compares -- far cheaper on the VPU
-    than ``jax.random.categorical``'s K Gumbel draws per sample.  The
-    one-hot is the difference of adjacent step functions.  ``scaled`` is
-    clamped strictly below ``cdf[-1]`` so float rounding at ``u * cdf[-1]
-    == cdf[-1]`` cannot step past the last *positive-weight* component
-    into trailing zero-weight (padded) slots; interior zero-weight
-    components have ``cdf[j] == cdf[j-1]`` and are never selected.  The
-    forced last column only fires in the degenerate all-zero-weight case.
+    One uniform per sample + [S, K] interval tests -- far cheaper on the
+    VPU than ``jax.random.categorical``'s K Gumbel draws per sample.
+    Component k is picked iff ``cdf[k-1] <= scaled < cdf[k]``.  ``scaled``
+    is clamped strictly below ``cdf[-1]`` so float rounding at ``u *
+    cdf[-1] == cdf[-1]`` cannot fall outside every interval; zero-weight
+    (padded) slots have ``cdf[k] == cdf[k-1]`` -- an empty interval --
+    and are never selected.
     """
-    n = u.shape[0]
+    if cdf_lo is None:
+        cdf_lo = jnp.concatenate([jnp.zeros((1,), cdf.dtype), cdf[:-1]])
     scaled = jnp.minimum(u * cdf[-1], cdf[-1] * (1.0 - 1e-6))[:, None]
-    step = jnp.concatenate(
-        [scaled < cdf[None, :-1], jnp.ones((n, 1), dtype=bool)], axis=1
-    ).astype(u.dtype)
-    return step - jnp.concatenate(
-        [jnp.zeros((n, 1), dtype=u.dtype), step[:, :-1]], axis=1
-    )
+    return ((scaled >= cdf_lo) & (scaled < cdf)).astype(u.dtype)
 
 
 def trunc_gmm_sample_pre(key, pre, low, high, logspace, q, n_samples):
@@ -263,11 +281,15 @@ def trunc_gmm_sample_pre(key, pre, low, high, logspace, q, n_samples):
     """
     k_comp, k_u = jax.random.split(key)
     u_comp = jax.random.uniform(k_comp, (n_samples,), dtype=pre["mus"].dtype)
-    onehot = _inverse_cdf_onehot(u_comp, pre["cdf"])
-    m = jnp.sum(onehot * pre["mus"], axis=1)
-    s = jnp.sum(onehot * pre["sig"], axis=1)
-    a = jnp.sum(onehot * pre["a"], axis=1)
-    b = jnp.sum(onehot * pre["b"], axis=1)
+    onehot = _inverse_cdf_onehot(u_comp, pre["cdf"], pre["cdf_lo"])
+    # HIGHEST precision: the default TPU matmul rounds operands to
+    # bfloat16, which would deterministically bias every drawn candidate
+    # (mus/sigmas/truncation CDFs to 8 mantissa bits).  At [S, K] x [K, 4]
+    # the exact contraction is still far cheaper than masked reductions.
+    picked = jnp.matmul(
+        onehot, pre["params4"], precision=jax.lax.Precision.HIGHEST
+    )  # [S, 4]
+    m, s, a, b = (picked[:, i] for i in range(4))
 
     u = jax.random.uniform(k_u, (n_samples,), dtype=pre["mus"].dtype)
     p = jnp.clip(a + u * (b - a), TINY, 1.0 - 1e-7)
@@ -301,16 +323,35 @@ def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_sample
 def gmm_logpdf_cont_pre(x, pre, logspace):
     """Continuous (unquantized) truncated-GMM log-density at natural-space
     ``x`` [S]: one fused multiply + exp per [S, K] term.  Truncation
-    bounds are already folded into ``pre['c1']`` via the log-mass."""
+    bounds are already folded into ``pre['c1']`` via the log-mass.
+
+    Stabilized by the *static* shift ``c1max`` (an exact upper bound on
+    every term, since z^2 >= 0) instead of a per-sample max -- a single
+    pass over K rather than logsumexp's two.  Terms more than ~88 nats
+    below the bound underflow harmlessly.  If the whole sum underflows
+    (a sample in the far tail of every component) the result falls back
+    to the largest shifted term -- the one-term logsumexp answer, exact
+    where one component dominates -- so far-tail candidates keep their
+    true ordering; the max reduction has no data dependence on the sum,
+    so XLA fuses both into the same pass over the terms."""
     lat = jnp.where(logspace, _safe_log(x), x)
     z = lat[:, None] * pre["inv_s"] - pre["mu_inv_s"]
-    terms = pre["c1"] - 0.5 * z * z
+    terms = (pre["c1"] - pre["c1max"]) - 0.5 * z * z
+    sm = jnp.sum(jnp.exp(terms), axis=1)
+    mx = jnp.max(terms, axis=1)
     jac = jnp.where(logspace, lat, 0.0)
-    return jax.scipy.special.logsumexp(terms, axis=1) - jac
+    ll = jnp.where(sm > 1e-38, jnp.log(jnp.maximum(sm, 1e-38)), mx)
+    return pre["c1max"] + ll - jac
 
 
 def gmm_logpdf_quant_pre(x, pre, low, high, logspace, q):
-    """Quantized bin-mass log-density at natural-space ``x`` [S]."""
+    """Quantized bin-mass log-density at natural-space ``x`` [S].
+
+    Bin masses are non-negative, so the mixture mass is a direct weighted
+    sum (``wmass = w / truncation-mass``) with ONE log at the end -- no
+    per-term log, no logsumexp max pass.  A bin with zero mass under every
+    component scores ~log(1e-38) instead of -inf (never wins the argmax).
+    """
     qq = jnp.maximum(q, TINY)
     ub_nat = x + qq / 2.0
     lb_nat = x - qq / 2.0
@@ -321,9 +362,8 @@ def gmm_logpdf_quant_pre(x, pre, low, high, logspace, q):
     inv_s = pre["inv_s"]
     mu_inv_s = pre["mu_inv_s"]
     bin_mass = ndtr(ub_lat * inv_s - mu_inv_s) - ndtr(lb_lat * inv_s - mu_inv_s)
-    return jax.scipy.special.logsumexp(
-        pre["logw"] + _safe_log(bin_mass) - pre["log_mass"], axis=1
-    )
+    p = jnp.sum(pre["wmass"] * bin_mass, axis=1)
+    return jnp.log(jnp.maximum(p, 1e-38))
 
 
 def trunc_gmm_logpdf(x, weights, mus, sigmas, low, high, logspace, q):
