@@ -66,6 +66,7 @@ __version__ = "0.1.0"
 __all__ = [
     "__version__",
     "anneal",
+    "anneal_jax",
     "base",
     "early_stop",
     "exceptions",
@@ -111,6 +112,7 @@ def __getattr__(name):
     lazy = {
         "tpe_jax",
         "rand_jax",
+        "anneal_jax",
         "jax_trials",
         "ops",
         "parallel",

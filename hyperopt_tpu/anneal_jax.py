@@ -1,0 +1,182 @@
+"""Annealing as one jitted XLA program -- the TPU-native anneal path.
+
+Same plugin boundary and semantics as :mod:`hyperopt_tpu.anneal`
+(capability parity with the reference's ``hyperopt/anneal.py``, SURVEY.md
+SS2), re-designed for the TPU execution model like
+:mod:`hyperopt_tpu.tpe_jax`: the whole suggest step -- anchor pick
+(geometric over loss rank), per-dimension shrinking neighborhoods,
+prior fallbacks for inactive/conditional dims, conditional activity --
+is a single compiled program over the dense masked observation buffers,
+vmapped over the requested batch of trials.  No per-trial or
+per-hyperparameter Python loop.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .jax_trials import cached_suggest_fn, obs_buffer_for, packed_space_for
+from .rand import docs_from_idxs_vals
+from .vectorize import dense_to_idxs_vals
+
+__all__ = ["suggest", "suggest_batch", "build_anneal_fn"]
+
+_default_avg_best_idx = 2.0
+_default_shrink_coef = 0.1
+
+
+def build_anneal_fn(ps, avg_best_idx, shrink_coef):
+    """Compile the full annealing suggest step for a PackedSpace.
+
+    Returns jitted ``fn(key, values, active, losses, valid, batch) ->
+    (new_values [D, B], new_active [D, B])`` with ``batch`` static.
+    Matches :class:`hyperopt_tpu.anneal.AnnealingAlgo` semantics:
+
+    * anchor trial per suggestion: rank ``geometric(1/avg_best_idx) - 1``
+      into the loss-sorted ok history (clamped);
+    * continuous dims: bounded dims draw uniform on the anchor-centred
+      interval of latent width ``(high-low) * frac``, clipped to the
+      bounds; unbounded dims draw ``normal(anchor, sigma * frac)``;
+      ``frac = 1 / (1 + n_obs_d * shrink_coef)`` with per-dim obs counts;
+    * categorical dims: redraw from the prior with probability ``frac``,
+      else keep the anchor's category;
+    * any dim inactive on the anchor trial (conditional branch not taken)
+      or an empty history falls back to a prior draw.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    c = ps._consts
+    D = ps.n_dims
+    Dc = len(ps.cont_idx)
+    Dk = len(ps.cat_idx)
+    abi = float(avg_best_idx)
+    sc = float(shrink_coef)
+
+    def fn(key, values, active, losses, valid, batch):
+        kr, ku, kz, kcoin, kp = jax.random.split(key, 5)
+
+        ok = valid & jnp.isfinite(losses)
+        n_ok = jnp.sum(ok.astype(jnp.int32))
+        order = jnp.argsort(jnp.where(ok, losses, jnp.inf), stable=True)
+
+        # geometric(p)-1 ranks via inverse transform; p = 1/avg_best_idx
+        p = 1.0 / max(abi, 1.0 + 1e-9)
+        u = jax.random.uniform(kr, (batch,), minval=1e-12, maxval=1.0)
+        rank = jnp.floor(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int32)
+        rank = jnp.clip(rank, 0, jnp.maximum(n_ok - 1, 0))
+        cols = order[rank]  # [B] anchor slots
+
+        anchor_vals = values[:, cols]  # [D, B]
+        anchor_act = active[:, cols] & (n_ok > 0)  # [D, B]
+
+        # per-dim observation counts -> neighborhood shrink fraction
+        n_obs = jnp.sum((active & ok[None, :]).astype(jnp.float32), axis=1)
+        frac = 1.0 / (1.0 + n_obs * sc)  # [D]
+
+        prior_vals, _ = ps.sample_prior_fn(kp, batch)  # [D, B]
+        new_values = jnp.zeros((D, batch), dtype=jnp.float32)
+
+        if Dc:
+            ci = c["cont_idx"]
+            a_nat = anchor_vals[ci]
+            lat_a = jnp.where(
+                c["logspace"][:, None],
+                jnp.log(jnp.maximum(a_nat, 1e-30)),
+                a_nat,
+            )
+            low, high = c["low"][:, None], c["high"][:, None]
+            fr = frac[ci][:, None]
+            bounded = jnp.isfinite(low)
+
+            uu = jax.random.uniform(ku, (Dc, batch), dtype=jnp.float32)
+            zz = jax.random.normal(kz, (Dc, batch), dtype=jnp.float32)
+
+            width = (high - low) * fr
+            lo2 = jnp.maximum(low, lat_a - width / 2.0)
+            hi2 = jnp.minimum(high, lat_a + width / 2.0)
+            lat_b = lo2 + uu * jnp.maximum(hi2 - lo2, 0.0)
+            lat_u = lat_a + c["prior_sigma"][:, None] * fr * zz
+            lat = jnp.where(bounded, lat_b, lat_u)
+
+            nat = jnp.where(c["logspace"][:, None], jnp.exp(lat), lat)
+            q = c["q"][:, None]
+            qq = jnp.maximum(q, 1e-12)
+            nat_low = jnp.where(c["logspace"][:, None], jnp.exp(low), low)
+            nat_high = jnp.where(c["logspace"][:, None], jnp.exp(high), high)
+            rounded = jnp.round(nat / qq) * qq
+            rounded = jnp.clip(
+                rounded,
+                jnp.where(
+                    jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low
+                ),
+                jnp.where(
+                    jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high
+                ),
+            )
+            nat = jnp.where(q > 0, rounded, nat)
+
+            nat = jnp.where(anchor_act[ci], nat, prior_vals[ci])
+            new_values = new_values.at[ci].set(nat)
+
+        if Dk:
+            ki = c["cat_idx"]
+            coin = jax.random.uniform(kcoin, (Dk, batch))
+            redraw = coin < frac[ki][:, None]
+            cat = jnp.where(
+                redraw | ~anchor_act[ki], prior_vals[ki], anchor_vals[ki]
+            )
+            new_values = new_values.at[ki].set(cat)
+
+        return new_values, ps.active_fn(new_values)
+
+    return jax.jit(fn, static_argnames=("batch",))
+
+
+def suggest_batch(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    avg_best_idx=_default_avg_best_idx,
+    shrink_coef=_default_shrink_coef,
+):
+    """Sparse (idxs, vals) for a batch of ids -- one device program."""
+    import jax
+
+    from .tpe_jax import _cast_vals
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    B = len(new_ids)
+    key = jax.random.key(int(seed) % (2**31 - 1))
+
+    if buf.count == 0:
+        values, active = ps.sample_prior(key, B)
+    else:
+        fn = cached_suggest_fn(
+            domain, "_anneal_jax_cache",
+            (float(avg_best_idx), float(shrink_coef)), build_anneal_fn,
+        )
+        values, active = fn(key, *buf.device_arrays(), batch=B)
+
+    idxs, vals = dense_to_idxs_vals(
+        new_ids, ps.labels, np.asarray(values), np.asarray(active)
+    )
+    return _cast_vals(ps, idxs, vals)
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    avg_best_idx=_default_avg_best_idx,
+    shrink_coef=_default_shrink_coef,
+):
+    """The TPU plugin-boundary entry point: ``algo=anneal_jax.suggest``."""
+    idxs, vals = suggest_batch(
+        new_ids, domain, trials, seed,
+        avg_best_idx=avg_best_idx, shrink_coef=shrink_coef,
+    )
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

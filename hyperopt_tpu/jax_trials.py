@@ -185,3 +185,24 @@ def packed_space_for(domain) -> PackedSpace:
         ps = compile_space(domain.expr)
         domain._packed_space = ps
     return ps
+
+
+def cached_suggest_fn(domain, cache_attr, params, builder):
+    """Per-domain cache of compiled suggest programs, shared by every JAX
+    algo path (tpe_jax / anneal_jax / parallel.sharded).
+
+    ``params`` is the hashable hyperparameter tuple; the cache key adds
+    the compiled-space identity so a domain whose space object is swapped
+    recompiles.  ``builder(packed_space, *params)`` builds the jitted fn.
+    """
+    ps = packed_space_for(domain)
+    key = (id(ps),) + tuple(params)
+    cache = getattr(domain, cache_attr, None)
+    if cache is None:
+        cache = {}
+        setattr(domain, cache_attr, cache)
+    fn = cache.get(key)
+    if fn is None:
+        fn = builder(ps, *params)
+        cache[key] = fn
+    return fn

@@ -22,7 +22,7 @@ import functools
 
 import numpy as np
 
-from ..jax_trials import obs_buffer_for, packed_space_for
+from ..jax_trials import cached_suggest_fn, obs_buffer_for, packed_space_for
 from ..rand import docs_from_idxs_vals
 from ..vectorize import dense_to_idxs_vals
 from .mesh import CAND_AXIS, default_mesh
@@ -161,19 +161,14 @@ def sharded_suggest(
             if mesh is None:
                 mesh = default_mesh()
                 domain._tpe_mesh = mesh
-        cache = getattr(domain, "_sharded_tpe_cache", None)
-        if cache is None:
-            cache = {}
-            domain._sharded_tpe_cache = cache
-        ck = (id(ps), id(mesh), n_EI_per_device, gamma, linear_forgetting,
-              prior_weight)
-        fn = cache.get(ck)
-        if fn is None:
-            fn = build_sharded_suggest_fn(
-                ps, mesh, int(n_EI_per_device), float(gamma),
-                float(linear_forgetting), float(prior_weight),
-            )
-            cache[ck] = fn
+        fn = cached_suggest_fn(
+            domain, "_sharded_tpe_cache",
+            (id(mesh), int(n_EI_per_device), float(gamma),
+             float(linear_forgetting), float(prior_weight)),
+            lambda ps_, _mid, *params: build_sharded_suggest_fn(
+                ps_, mesh, *params
+            ),
+        )
         values, active = fn(key, *buf.device_arrays(), batch=B)
 
     from ..tpe_jax import _cast_vals

@@ -25,7 +25,7 @@ import logging
 import numpy as np
 
 from .rand import docs_from_idxs_vals
-from .jax_trials import obs_buffer_for, packed_space_for
+from .jax_trials import cached_suggest_fn, obs_buffer_for, packed_space_for
 from .vectorize import dense_to_idxs_vals
 
 logger = logging.getLogger(__name__)
@@ -87,21 +87,6 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
     return jax.jit(fn, static_argnames=("batch",))
 
 
-def _suggest_fn_for(domain, n_cand, gamma, lf, prior_weight):
-    key = (id(packed_space_for(domain)), n_cand, gamma, lf, prior_weight)
-    cache = getattr(domain, "_tpe_jax_cache", None)
-    if cache is None:
-        cache = {}
-        domain._tpe_jax_cache = cache
-    fn = cache.get(key)
-    if fn is None:
-        fn = build_suggest_fn(
-            packed_space_for(domain), n_cand, gamma, lf, prior_weight
-        )
-        cache[key] = fn
-    return fn
-
-
 def _cast_vals(ps, idxs, vals):
     """Dense float draws -> API types (ints for categorical-family dims)."""
     cat_labels = {ps.labels[d] for d in ps.cat_idx}
@@ -136,9 +121,11 @@ def suggest_batch(
     if buf.count < n_startup_jobs:
         values, active = ps.sample_prior(key, B)
     else:
-        fn = _suggest_fn_for(
-            domain, int(n_EI_candidates), float(gamma),
-            float(linear_forgetting), float(prior_weight),
+        fn = cached_suggest_fn(
+            domain, "_tpe_jax_cache",
+            (int(n_EI_candidates), float(gamma), float(linear_forgetting),
+             float(prior_weight)),
+            build_suggest_fn,
         )
         values, active = fn(key, *buf.device_arrays(), batch=B)
 
