@@ -99,23 +99,12 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef):
             lat_u = lat_a + c["prior_sigma"][:, None] * fr * zz
             lat = jnp.where(bounded, lat_b, lat_u)
 
-            nat = jnp.where(c["logspace"][:, None], jnp.exp(lat), lat)
-            q = c["q"][:, None]
-            qq = jnp.maximum(q, 1e-12)
-            nat_low = jnp.where(c["logspace"][:, None], jnp.exp(low), low)
-            nat_high = jnp.where(c["logspace"][:, None], jnp.exp(high), high)
-            rounded = jnp.round(nat / qq) * qq
-            rounded = jnp.clip(
-                rounded,
-                jnp.where(
-                    jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low
-                ),
-                jnp.where(
-                    jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high
-                ),
-            )
-            nat = jnp.where(q > 0, rounded, nat)
+            from .ops.kernels import quantize_nat
 
+            nat = jnp.where(c["logspace"][:, None], jnp.exp(lat), lat)
+            nat = quantize_nat(
+                nat, c["q"][:, None], low, high, c["logspace"][:, None]
+            )
             nat = jnp.where(anchor_act[ci], nat, prior_vals[ci])
             new_values = new_values.at[ci].set(nat)
 

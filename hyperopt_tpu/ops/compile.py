@@ -226,18 +226,12 @@ class PackedSpace:
                 low + u * (high - low),
                 c["prior_mu"][:, None] + c["prior_sigma"][:, None] * z,
             )
+            from .kernels import quantize_nat
+
             nat = jnp.where(c["logspace"][:, None], jnp.exp(lat), lat)
-            q = c["q"][:, None]
-            qq = jnp.maximum(q, 1e-12)
-            nat_low = jnp.where(c["logspace"][:, None], jnp.exp(low), low)
-            nat_high = jnp.where(c["logspace"][:, None], jnp.exp(high), high)
-            rounded = jnp.round(nat / qq) * qq
-            rounded = jnp.clip(
-                rounded,
-                jnp.where(jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low),
-                jnp.where(jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high),
+            nat = quantize_nat(
+                nat, c["q"][:, None], low, high, c["logspace"][:, None]
             )
-            nat = jnp.where(q > 0, rounded, nat)
             values = values.at[c["cont_idx"]].set(nat)
 
         if Dk:

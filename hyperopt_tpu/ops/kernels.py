@@ -24,6 +24,7 @@ from jax.scipy.special import ndtr, ndtri
 __all__ = [
     "forgetting_weights",
     "parzen_fit",
+    "quantize_nat",
     "trunc_gmm_sample",
     "trunc_gmm_sample_pre",
     "trunc_gmm_logpdf",
@@ -35,8 +36,12 @@ __all__ = [
     "ei_argmax",
     "ei_best_cont",
     "ei_best_cat",
+    "ei_scores_cont",
+    "ei_scores_cat",
     "ei_sweep_cont",
     "ei_sweep_cat",
+    "ei_sweep_cont_scores",
+    "ei_sweep_cat_scores",
     "fit_all_dims",
 ]
 
@@ -208,6 +213,23 @@ def _safe_log(x):
     return jnp.log(jnp.maximum(x, F32_TINY))
 
 
+def quantize_nat(nat, q, low, high, logspace):
+    """Natural-space quantization shared by every sampling path (prior,
+    TPE below-model draws, annealing neighborhoods): round to the q-grid
+    and clip to the rounded finite bounds; ``low``/``high`` are latent
+    (log-space dims exponentiate).  ``q <= 0`` passes through."""
+    qq = jnp.maximum(q, TINY)
+    nat_low = jnp.where(logspace, jnp.exp(low), low)
+    nat_high = jnp.where(logspace, jnp.exp(high), high)
+    rounded = jnp.round(nat / qq) * qq
+    rounded = jnp.clip(
+        rounded,
+        jnp.where(jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low),
+        jnp.where(jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high),
+    )
+    return jnp.where(q > 0, rounded, nat)
+
+
 def gmm_precompute(weights, mus, sigmas, low, high):
     """Per-component constants shared by sampling and scoring.
 
@@ -297,16 +319,7 @@ def trunc_gmm_sample_pre(key, pre, low, high, logspace, q, n_samples):
     x = jnp.clip(x, low, high)
 
     nat = jnp.where(logspace, jnp.exp(x), x)
-    qq = jnp.maximum(q, TINY)
-    nat_low = jnp.where(logspace, jnp.exp(low), low)
-    nat_high = jnp.where(logspace, jnp.exp(high), high)
-    rounded = jnp.round(nat / qq) * qq
-    rounded = jnp.clip(
-        rounded,
-        jnp.where(jnp.isfinite(nat_low), jnp.round(nat_low / qq) * qq, nat_low),
-        jnp.where(jnp.isfinite(nat_high), jnp.round(nat_high / qq) * qq, nat_high),
-    )
-    return jnp.where(q > 0, rounded, nat)
+    return quantize_nat(nat, q, low, high, logspace)
 
 
 def trunc_gmm_sample(key, weights, mus, sigmas, low, high, logspace, q, n_samples):
@@ -427,10 +440,11 @@ def ei_argmax(samples, ll_below, ll_above):
     return samples[jnp.argmax(score)], jnp.max(score)
 
 
-def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
-                 has_q=None):
-    """One continuous dim: draw n_cand from the below-model, score the EI
-    log-likelihood ratio, return (best value, best score).
+def ei_scores_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q,
+                   n_cand, has_q=None):
+    """One continuous dim: draw n_cand from the below-model and score the
+    EI log-likelihood ratio for EVERY candidate.  Returns (samples [S],
+    llr [S]).
 
     ``has_q`` is a *static* (trace-time) flag: True = quantized bin-mass
     scoring only, False = continuous density only, None = traced ``q``
@@ -456,31 +470,30 @@ def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
             gmm_logpdf_quant_pre(samples, pre_a, low, high, logspace, q),
             gmm_logpdf_cont_pre(samples, pre_a, logspace),
         )
-    return ei_argmax(samples, ll_b, ll_a)
+    return samples, ll_b - ll_a
 
 
-def ei_sweep_cont(q_np, consts, cont_keys, fit_arrays, n_cand):
-    """Batched continuous EI sweep over all trials x continuous dims.
+def ei_best_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
+                 has_q=None):
+    """One continuous dim: draw n_cand from the below-model, score the EI
+    log-likelihood ratio, return (best value, best score)."""
+    samples, llr = ei_scores_cont(
+        key, wb, mb, sb, wa, ma, sa, low, high, logspace, q, n_cand,
+        has_q=has_q,
+    )
+    return samples[jnp.argmax(llr)], jnp.max(llr)
 
-    The single shared implementation of the candidate sweep used by both
-    the single-device (:mod:`hyperopt_tpu.tpe_jax`) and mesh-sharded
-    (:mod:`hyperopt_tpu.parallel.sharded`) suggest builders.  Dims are
-    partitioned by *static* ``q > 0`` (``q_np`` is the host numpy q
-    vector) so only quantized dims pay the ndtr-heavy bin-mass scoring;
-    the rest run the cheap continuous-density family.
 
-    Args:
-      q_np: host [Dc] numpy array of quantizations (static).
-      consts: PackedSpace._consts dict (needs low/high/logspace/q).
-      cont_keys: [B, Dc] PRNG keys.
-      fit_arrays: (wb, mb, sb, wa, ma, sa), leading dim Dc.
-      n_cand: candidates per (trial, dim) (static).
-
-    Returns (vals, scores): each [B, Dc], in cont-dim order.
+def _ei_sweep_grouped(q_np, consts, cont_keys, fit_arrays, n_cand, kernel):
+    """Shared scaffolding of the batched continuous EI sweeps: partition
+    dims by *static* ``q > 0`` (``q_np`` is the host numpy q vector) so
+    only quantized dims pay the ndtr-heavy bin-mass scoring, run
+    ``kernel(key, *fits, *consts, n_cand=, has_q=)`` double-vmapped over
+    (trial, dim) per group, and scatter-merge the per-group outputs.
+    Every dim lands in exactly one group, so the zero inits never leak.
     """
     B, Dc = cont_keys.shape
-    vals = jnp.zeros((B, Dc), jnp.float32)
-    scores = jnp.full((B, Dc), -jnp.inf, jnp.float32)
+    outs = None
     q_np = np.asarray(q_np)
     for has_q, pos in (
         (False, np.flatnonzero(q_np <= 0)),
@@ -493,14 +506,38 @@ def ei_sweep_cont(q_np, consts, cont_keys, fit_arrays, n_cand):
             consts[k][pos] for k in ("low", "high", "logspace", "q")
         )
         per_dim = jax.vmap(
-            lambda k, *a: ei_best_cont(k, *a, n_cand=n_cand, has_q=has_q),
+            lambda k, *a: kernel(k, *a, n_cand=n_cand, has_q=has_q),
             in_axes=(0,) * 11,
         )
         per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
-        gv, gs = per_batch(cont_keys[:, pos], *grp_fits, *grp_consts)
-        vals = vals.at[:, pos].set(gv)
-        scores = scores.at[:, pos].set(gs)
-    return vals, scores
+        res = per_batch(cont_keys[:, pos], *grp_fits, *grp_consts)
+        if outs is None:
+            outs = tuple(
+                jnp.zeros((B, Dc) + r.shape[2:], r.dtype) for r in res
+            )
+        outs = tuple(o.at[:, pos].set(r) for o, r in zip(outs, res))
+    return outs
+
+
+def ei_sweep_cont(q_np, consts, cont_keys, fit_arrays, n_cand):
+    """Batched continuous EI sweep over all trials x continuous dims.
+
+    The single shared implementation of the candidate sweep used by both
+    the single-device (:mod:`hyperopt_tpu.tpe_jax`) and mesh-sharded
+    (:mod:`hyperopt_tpu.parallel.sharded`) suggest builders.
+
+    Args:
+      q_np: host [Dc] numpy array of quantizations (static).
+      consts: PackedSpace._consts dict (needs low/high/logspace/q).
+      cont_keys: [B, Dc] PRNG keys.
+      fit_arrays: (wb, mb, sb, wa, ma, sa), leading dim Dc.
+      n_cand: candidates per (trial, dim) (static).
+
+    Returns (vals, scores): each [B, Dc], in cont-dim order.
+    """
+    return _ei_sweep_grouped(
+        q_np, consts, cont_keys, fit_arrays, n_cand, ei_best_cont
+    )
 
 
 def ei_sweep_cat(cat_keys, pb, pa, n_cand):
@@ -509,6 +546,26 @@ def ei_sweep_cat(cat_keys, pb, pa, n_cand):
     before int_low offset)."""
     per_cat = jax.vmap(
         lambda k, b, a: ei_best_cat(k, b, a, n_cand=n_cand),
+        in_axes=(0, 0, 0),
+    )
+    per_batch = jax.vmap(per_cat, in_axes=(0, None, None))
+    return per_batch(cat_keys, pb, pa)
+
+
+def ei_sweep_cont_scores(q_np, consts, cont_keys, fit_arrays, n_cand):
+    """Per-candidate form of :func:`ei_sweep_cont` for the joint-EI path:
+    returns (vals, llrs) each [B, Dc, S] -- every candidate's value and
+    EI log-likelihood ratio, no per-dim argmax."""
+    return _ei_sweep_grouped(
+        q_np, consts, cont_keys, fit_arrays, n_cand, ei_scores_cont
+    )
+
+
+def ei_sweep_cat_scores(cat_keys, pb, pa, n_cand):
+    """Per-candidate form of :func:`ei_sweep_cat` for the joint-EI path:
+    (vals, llrs) each [B, Dk, S]."""
+    per_cat = jax.vmap(
+        lambda k, b, a: ei_scores_cat(k, b, a, n_cand=n_cand),
         in_axes=(0, 0, 0),
     )
     per_batch = jax.vmap(per_cat, in_axes=(0, None, None))
@@ -533,3 +590,21 @@ def ei_best_cat(key, p_below, p_above, n_cand):
     )
     best = jnp.argmax(jnp.where(hit, llr, -jnp.inf))
     return best.astype(jnp.float32), llr[best]
+
+
+def ei_scores_cat(key, p_below, p_above, n_cand):
+    """One categorical dim, per-candidate form for the joint-EI path:
+    draw n_cand categories from the below posterior and return
+    (category indices [S] as floats, llr [S]).  Index and llr come out of
+    one exact [S, K] x [K, 2] contraction against the one-hot pick."""
+    u = jax.random.uniform(key, (n_cand,), dtype=p_below.dtype)
+    onehot = _inverse_cdf_onehot(u, jnp.cumsum(jnp.maximum(p_below, 0.0)))
+    llr_k = jnp.where(
+        p_below > 0, _safe_log(p_below) - _safe_log(p_above), 0.0
+    )  # zero-weight options are never drawn; 0 keeps the matmul finite
+    k = p_below.shape[0]
+    table = jnp.stack(
+        [jnp.arange(k, dtype=p_below.dtype), llr_k], axis=-1
+    )  # [K, 2]
+    picked = jnp.matmul(onehot, table, precision=jax.lax.Precision.HIGHEST)
+    return picked[:, 0], picked[:, 1]

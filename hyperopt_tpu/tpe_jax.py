@@ -39,13 +39,24 @@ _default_n_startup_jobs = 20
 _default_linear_forgetting = 25
 
 
-def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
+def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
     """Compile the full TPE suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
     (new_values [D, B], new_active [D, B])`` with ``batch`` static.
     Buffer capacity is baked into the trace via the array shapes
     (power-of-2 bucketed by ObsBuffer -> bounded recompiles).
+
+    ``joint_ei=False`` (default) keeps the reference's factorized
+    posterior: each hyperparameter's EI argmax is taken independently
+    (SURVEY.md SS3.2e -- parity behavior).  ``joint_ei=True`` scores
+    whole candidate *configurations* instead: candidate s of a trial is
+    the s-th draw of every dimension together; its score is the sum of
+    per-dim log-likelihood ratios over the dims *active* in that
+    configuration (conditional branches contribute only when taken), and
+    the trial takes the argmax configuration column.  Affordable only
+    because the accelerator path draws hundreds of candidates per dim
+    (SURVEY.md SS7 'hard parts': joint variant behind a flag).
     """
     import jax
     import jax.numpy as jnp
@@ -60,7 +71,7 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
     lf_f = float(lf)
     pw = float(prior_weight)
 
-    def fn(key, values, active, losses, valid, batch):
+    def fn_factorized(key, values, active, losses, valid, batch):
         fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
         new_values = jnp.zeros((D, batch), dtype=jnp.float32)
 
@@ -84,6 +95,42 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight):
 
         return new_values, ps.active_fn(new_values)
 
+    def fn_joint(key, values, active, losses, valid, batch):
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        n_keys = batch * (Dc + Dk)
+        keys = jax.random.split(key, max(n_keys, 1))
+
+        cand_values = jnp.zeros((batch, D, n_cand), dtype=jnp.float32)
+        llrs = jnp.zeros((batch, D, n_cand), dtype=jnp.float32)
+        if fits["cont"] is not None:
+            cont_keys = keys[: batch * Dc].reshape(batch, Dc)
+            v, l = K.ei_sweep_cont_scores(
+                ps.q, c, cont_keys, fits["cont"], n_cand
+            )
+            cand_values = cand_values.at[:, c["cont_idx"]].set(v)
+            llrs = llrs.at[:, c["cont_idx"]].set(l)
+        if fits["cat"] is not None:
+            pb, pa = fits["cat"]
+            cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
+            v, l = K.ei_sweep_cat_scores(cat_keys, pb, pa, n_cand)
+            cand_values = cand_values.at[:, c["cat_idx"]].set(
+                v + c["int_low"][None, :, None]
+            )
+            llrs = llrs.at[:, c["cat_idx"]].set(l)
+
+        # configuration s = column s of every dim; only dims active in
+        # that configuration contribute to its joint score
+        flat = jnp.moveaxis(cand_values, 0, 1).reshape(D, batch * n_cand)
+        cand_active = ps.active_fn(flat).reshape(D, batch, n_cand)
+        cand_active = jnp.moveaxis(cand_active, 0, 1)  # [B, D, S]
+        joint = jnp.sum(jnp.where(cand_active, llrs, 0.0), axis=1)  # [B, S]
+        best = jnp.argmax(joint, axis=1)  # [B]
+        new_values = jnp.take_along_axis(
+            cand_values, best[:, None, None], axis=2
+        )[..., 0].T  # [D, B]
+        return new_values, ps.active_fn(new_values)
+
+    fn = fn_joint if joint_ei else fn_factorized
     return jax.jit(fn, static_argnames=("batch",))
 
 
@@ -108,6 +155,7 @@ def suggest_batch(
     n_EI_candidates=_default_n_EI_candidates,
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
+    joint_ei=False,
 ):
     """Sparse (idxs, vals) for a batch of ids -- one device program for the
     whole batch (B trials x D dims x n_EI_candidates candidates)."""
@@ -124,7 +172,7 @@ def suggest_batch(
         fn = cached_suggest_fn(
             domain, "_tpe_jax_cache",
             (int(n_EI_candidates), float(gamma), float(linear_forgetting),
-             float(prior_weight)),
+             float(prior_weight), bool(joint_ei)),
             build_suggest_fn,
         )
         values, active = fn(key, *buf.device_arrays(), batch=B)
@@ -145,8 +193,14 @@ def suggest(
     n_EI_candidates=_default_n_EI_candidates,
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
+    joint_ei=False,
 ):
-    """The TPU plugin-boundary entry point: ``algo=tpe_jax.suggest``."""
+    """The TPU plugin-boundary entry point: ``algo=tpe_jax.suggest``.
+
+    ``partial(tpe_jax.suggest, joint_ei=True)`` switches from the
+    reference's factorized per-dimension EI argmax to whole-configuration
+    scoring (see :func:`build_suggest_fn`).
+    """
     idxs, vals = suggest_batch(
         new_ids, domain, trials, seed,
         prior_weight=prior_weight,
@@ -154,5 +208,6 @@ def suggest(
         n_EI_candidates=n_EI_candidates,
         gamma=gamma,
         linear_forgetting=linear_forgetting,
+        joint_ei=joint_ei,
     )
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

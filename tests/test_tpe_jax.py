@@ -181,3 +181,76 @@ def test_tpe_jax_reproducible():
         return [t["misc"]["vals"]["x"][0] for t in trials.trials]
 
     assert run() == run()
+
+
+def test_tpe_jax_joint_ei_conditional_space():
+    """joint_ei=True scores whole configurations; draws must still respect
+    bounds, types, and conditional activity, and be deterministic."""
+    from functools import partial
+
+    space = {
+        "x": hp.uniform("x", -5, 5),
+        "arch": hp.choice(
+            "arch",
+            [
+                {"k": 0, "depth": hp.randint("depth", 2, 8)},
+                {"k": 1, "w": hp.quniform("w", 0, 10, 1)},
+            ],
+        ),
+    }
+
+    def obj(cfg):
+        a = cfg["arch"]
+        extra = 0.1 * (a["depth"] - 5) ** 2 if a["k"] == 0 else a["w"] * 0.01
+        return cfg["x"] ** 2 + extra
+
+    algo = partial(tpe_jax.suggest, joint_ei=True, n_startup_jobs=10)
+
+    def run():
+        trials = Trials()
+        fmin(
+            obj, space, algo=algo, max_evals=40, trials=trials,
+            rstate=np.random.default_rng(11), show_progressbar=False,
+        )
+        return trials
+
+    trials = run()
+    assert len(trials) == 40
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        (x,) = vals["x"]
+        assert -5 <= x <= 5
+        (arm,) = vals["arch"]
+        if arm == 0:
+            (depth,) = vals["depth"]
+            assert 2 <= depth < 8 and vals["w"] == []
+        else:
+            (w,) = vals["w"]
+            assert w == round(w) and 0 <= w <= 10 and vals["depth"] == []
+    assert trials.losses() == run().losses()  # fixed seed -> identical
+
+
+def test_tpe_jax_joint_ei_beats_random_on_correlated():
+    """Whole-configuration scoring handles a correlated objective: loss
+    depends on x + y, which the factorized marginals cannot represent."""
+    from functools import partial
+
+    space = {"x": hp.uniform("x", -5, 5), "y": hp.uniform("y", -5, 5)}
+
+    def obj(cfg):
+        return (cfg["x"] + cfg["y"] - 1.0) ** 2
+
+    def best_with(algo):
+        outs = []
+        for seed in (0, 1):
+            trials = Trials()
+            fmin(
+                obj, space, algo=algo, max_evals=60, trials=trials,
+                rstate=np.random.default_rng(seed), show_progressbar=False,
+            )
+            outs.append(min(trials.losses()))
+        return float(np.mean(outs))
+
+    joint = best_with(partial(tpe_jax.suggest, joint_ei=True))
+    random = best_with(rand_jax.suggest)
+    assert joint < random, (joint, random)
