@@ -33,7 +33,6 @@ __all__ = [
     "gmm_logpdf_quant_pre",
     "categorical_fit",
     "split_below_above",
-    "ei_argmax",
     "ei_best_cont",
     "ei_best_cat",
     "ei_scores_cont",
@@ -432,12 +431,6 @@ def split_below_above(losses, valid, gamma, lf):
     below = valid & (rank < n_below)
     above = valid & ~below
     return below, above, n_below
-
-
-def ei_argmax(samples, ll_below, ll_above):
-    """Factorized EI: the candidate maximizing log l(x) - log g(x)."""
-    score = ll_below - ll_above
-    return samples[jnp.argmax(score)], jnp.max(score)
 
 
 def ei_scores_cont(key, wb, mb, sb, wa, ma, sa, low, high, logspace, q,

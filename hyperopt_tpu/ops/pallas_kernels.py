@@ -1,16 +1,30 @@
 """Pallas TPU kernel for the TPE hot op: batched GMM log-density scoring.
 
 The suggest step's FLOPs live in scoring S candidates against K mixture
-components for every (trial x dimension) row -- an [R, S, K] logsumexp.
-The XLA path materializes [S, K] score matrices per row; this kernel
-streams the component axis through VMEM in 128-wide chunks with an online
-(flash-style) logsumexp, so VMEM pressure is O(S + 128) per row instead
-of O(S*K), and the row grid pipelines HBM->VMEM copies against VPU work
-(pallas_guide.md: grids+BlockSpec, fori_loop, online reductions).
+components for every (dimension) row -- an [R, S, K] logsumexp with
+per-row components.  The XLA path recomputes the [S, K] terms for its
+max and sum passes; this kernel streams the component axis through VMEM
+in 128-lane chunks with an online (flash-style) logsumexp, one pass over
+the terms, while the (row-block, sample-tile) grid pipelines HBM->VMEM
+copies against VPU work (pallas_guide.md: grids+BlockSpec, fori_loop,
+online reductions).
+
+TPU tiling: rows are processed 8 at a time (sublane width) and samples
+in 512-wide tiles (lane-aligned), so every block shape is (8, *) with a
+last dimension divisible by 128 -- the layout the Mosaic lowering
+requires.  ``pad_rows`` / ``pad_components`` provide the padding.
 
 Exposed as ``ei_scores(...)`` = log l(x) - log g(x) for the continuous
 (unquantized) family; quantized/categorical dims stay on the XLA path.
 ``interpret=True`` runs the same kernel on CPU for tests.
+
+Measured on a TPU v5e chip (round 1): this kernel scores 16 x 524k x 640
+terms in ~52-70 ms, while the XLA scorer in :mod:`.kernels`
+(static-shift single pass, compiler-fused) does the same work in ~29 ms
+-- XLA's fusion wins for this elementwise+reduction shape, so the
+production suggest path stays on XLA and this kernel is kept as the
+verified VMEM-streaming alternative (useful as a template for ops XLA
+fuses poorly).
 """
 
 from __future__ import annotations
@@ -19,10 +33,12 @@ import functools
 
 import numpy as np
 
-__all__ = ["gmm_logpdf_rows", "ei_scores", "pad_components"]
+__all__ = ["gmm_logpdf_rows", "ei_scores", "pad_components", "pad_rows"]
 
 _LOG_SQRT_2PI = 0.9189385332046727  # log(sqrt(2*pi))
 LANE = 128
+SUBLANE = 8
+S_TILE = 512
 
 
 def pad_components(w, mu, sigma, log_mass, lane=LANE):
@@ -42,34 +58,50 @@ def pad_components(w, mu, sigma, log_mass, lane=LANE):
     )
 
 
-def _gmm_row_kernel(x_ref, w_ref, mu_ref, sig_ref, lm_ref, out_ref):
-    """One grid row: out[1, S] = logsumexp_k(log w_k + N(x | mu_k, sig_k)).
+def pad_rows(x, sublane=SUBLANE, constant_values=0.0):
+    """Pad the row axis to a multiple of the sublane width.
 
-    Streams K in 128-lane chunks with an online max/accumulator pair.
+    Pass ``constant_values=1.0`` for sigma-like arrays the kernel takes a
+    log of -- zero-padded rows would produce NaNs in-kernel."""
+    import jax.numpy as jnp
+
+    r = x.shape[0]
+    pad = (-r) % sublane
+    if pad == 0:
+        return x
+    return jnp.pad(
+        x, [(0, pad)] + [(0, 0)] * (x.ndim - 1),
+        constant_values=constant_values,
+    )
+
+
+def _gmm_rows_kernel(x_ref, w_ref, mu_ref, sig_ref, lm_ref, out_ref):
+    """One grid cell: out[8, T] = logsumexp_k(log w_k + logN(x | mu_k,
+    sig_k) - log_mass_k) for an 8-row block and a T-sample tile.
+
+    Streams K in 128-lane chunks with an online max/accumulator pair;
+    the [8, T, 128] term tensor lives only for one chunk.
     """
     import jax
     import jax.numpy as jnp
     from jax.experimental import pallas as pl
 
-    S = x_ref.shape[1]
+    T = x_ref.shape[1]
     K = w_ref.shape[1]
-    x = x_ref[0, :]  # [S]
+    x = x_ref[...]  # [8, T]
 
     def chunk(i, carry):
-        m, acc = carry  # running max [S], running sum [S]
+        m, acc = carry  # running max / running sum, each [8, T]
         sl = pl.ds(i * LANE, LANE)
-        w = w_ref[0, sl]
-        mu = mu_ref[0, sl]
-        sig = sig_ref[0, sl]
-        lm = lm_ref[0, sl]
+        w = w_ref[:, sl]      # [8, 128]
+        mu = mu_ref[:, sl]
+        sig = sig_ref[:, sl]
+        lm = lm_ref[:, sl]
         logw = jnp.where(w > 0, jnp.log(jnp.maximum(w, 1e-30)), -jnp.inf)
-        z = (x[:, None] - mu[None, :]) / sig[None, :]  # [S, 128]
-        t = (
-            (logw - jnp.log(sig) - lm)[None, :]
-            - 0.5 * z * z
-            - _LOG_SQRT_2PI
-        )
-        tmax = jnp.max(t, axis=1)
+        c1 = logw - jnp.log(sig) - lm - _LOG_SQRT_2PI  # [8, 128]
+        z = (x[:, :, None] - mu[:, None, :]) / sig[:, None, :]  # [8, T, 128]
+        t = c1[:, None, :] - 0.5 * z * z
+        tmax = jnp.max(t, axis=2)  # [8, T]
         m_new = jnp.maximum(m, tmax)
         safe = jnp.isfinite(m_new)
         scale = jnp.where(
@@ -77,35 +109,39 @@ def _gmm_row_kernel(x_ref, w_ref, mu_ref, sig_ref, lm_ref, out_ref):
         )
         add = jnp.where(
             safe,
-            jnp.sum(jnp.exp(t - jnp.where(safe, m_new, 0.0)[:, None]), axis=1),
+            jnp.sum(
+                jnp.exp(t - jnp.where(safe, m_new, 0.0)[:, :, None]), axis=2
+            ),
             0.0,
         )
         return m_new, acc * scale + add
 
-    m0 = jnp.full((S,), -jnp.inf, dtype=jnp.float32)
-    a0 = jnp.zeros((S,), dtype=jnp.float32)
+    m0 = jnp.full(x.shape, -jnp.inf, dtype=jnp.float32)
+    a0 = jnp.zeros(x.shape, dtype=jnp.float32)
     m, acc = jax.lax.fori_loop(0, K // LANE, chunk, (m0, a0))
-    out_ref[0, :] = m + jnp.log(jnp.maximum(acc, 1e-30))
+    out_ref[...] = m + jnp.log(jnp.maximum(acc, 1e-30))
 
 
 @functools.lru_cache(maxsize=32)
-def _build_rows_call(R, S, K, interpret):
+def _build_rows_call(R, S, K, s_tile, interpret):
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    row = lambda r: (r, 0)
+    xs_map = lambda r, s: (r, s)
+    comp_map = lambda r, s: (r, 0)
     call = pl.pallas_call(
-        _gmm_row_kernel,
-        grid=(R,),
+        _gmm_rows_kernel,
+        grid=(R // SUBLANE, S // s_tile),
         in_specs=[
-            pl.BlockSpec((1, S), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K), row, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, K), row, memory_space=pltpu.VMEM),
+            pl.BlockSpec((SUBLANE, s_tile), xs_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((SUBLANE, K), comp_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((SUBLANE, K), comp_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((SUBLANE, K), comp_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((SUBLANE, K), comp_map, memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, S), row, memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((SUBLANE, s_tile), xs_map,
+                               memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((R, S), jax.numpy.float32),
         interpret=bool(interpret),
     )
@@ -116,25 +152,36 @@ def gmm_logpdf_rows(x, w, mu, sigma, log_mass, interpret=False):
     """Batched truncated-GMM log-density (latent space, unquantized).
 
     Args:
-      x: [R, S] latent-space sample rows.
-      w/mu/sigma/log_mass: [R, K] per-row mixture components (K padded to
-        a multiple of 128; ``pad_components`` does this).
+      x: [R, S] latent-space sample rows (one row per dimension; a batch
+        of trials flattens its candidates into the row).
+      w/mu/sigma/log_mass: [R, K] per-row mixture components.
+    Rows are padded to a multiple of 8, K to a multiple of 128, and S
+    must divide by a 128-multiple tile (padded here if needed).
     Returns [R, S] log-densities (without the log-space jacobian, which
     the caller applies -- it does not depend on the mixture).
     """
     import jax.numpy as jnp
 
-    w, mu, sigma, log_mass = pad_components(w, mu, sigma, log_mass)
     R, S = x.shape
-    K = w.shape[1]
-    call = _build_rows_call(R, S, K, interpret)
-    return call(
+    w, mu, sigma, log_mass = pad_components(w, mu, sigma, log_mass)
+    x = pad_rows(x)
+    w, mu, log_mass = pad_rows(w), pad_rows(mu), pad_rows(log_mass)
+    sigma = pad_rows(sigma, constant_values=1.0)  # log(sig) in-kernel
+    s_tile = S_TILE if S % S_TILE == 0 else LANE
+    s_pad = (-S) % s_tile
+    if s_pad:
+        x = jnp.pad(x, [(0, 0), (0, s_pad)])
+    call = _build_rows_call(
+        x.shape[0], x.shape[1], w.shape[1], s_tile, interpret
+    )
+    out = call(
         x.astype(jnp.float32),
         w.astype(jnp.float32),
         mu.astype(jnp.float32),
         sigma.astype(jnp.float32),
         log_mass.astype(jnp.float32),
     )
+    return out[:R, :S]
 
 
 def ei_scores(x_lat, below, above, interpret=False):
