@@ -113,6 +113,7 @@ def __getattr__(name):
         "tpe_jax",
         "rand_jax",
         "anneal_jax",
+        "device_loop",
         "jax_trials",
         "ops",
         "parallel",

@@ -1,0 +1,257 @@
+"""The whole optimization loop on-device: ``fmin_on_device``.
+
+The reference's fmin (SURVEY.md SS3.1) alternates host-side suggest and
+host-side evaluate; even this repo's jitted ``tpe_jax.suggest`` pays one
+device dispatch + host round-trip per batch.  For objectives that are
+themselves JAX-traceable (surrogates, analytic benchmarks, small neural
+nets -- anything a TPU can evaluate), the entire ask-evaluate-append
+history loop compiles to ONE XLA program: a ``lax.scan`` whose carry is
+the dense observation buffers, with the TPE (or annealing/random) suggest
+kernels and the vmapped objective fused into each step.  Zero host
+round-trips until the final result -- this is the fully pipelined
+suggest<->evaluate path of SURVEY.md SS7/M4, and the execution model the
+reference cannot express.
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.device_loop import fmin_on_device
+
+    out = fmin_on_device(
+        lambda cfg: (cfg["x"] - 1.0) ** 2,   # jnp math, vmapped by us
+        {"x": hp.uniform("x", -5.0, 5.0)},
+        max_evals=512,
+    )
+    out["best"]["x"], out["best_loss"], out["losses"]
+
+The objective receives a dict of ``[batch]`` value arrays (natural
+space; categorical/randint dims as float indices -- round/cast inside)
+plus, for conditional spaces, an ``active`` dict of ``[batch]`` masks
+under the keyword ``active`` if the callable accepts it.  It must return
+``[batch]`` losses (jnp).  Non-finite losses are masked out of the
+posterior, matching the host driver's error handling (SURVEY.md SS5).
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import numpy as np
+
+from .ops.compile import compile_space
+
+__all__ = ["fmin_on_device", "compile_fmin"]
+
+
+def _round_up(n, m):
+    return (n + m - 1) // m * m
+
+
+def compile_fmin(
+    fn,
+    space,
+    max_evals,
+    batch_size=1,
+    algo="tpe",
+    n_startup_jobs=20,
+    n_EI_candidates=24,
+    gamma=0.25,
+    prior_weight=1.0,
+    linear_forgetting=25,
+    joint_ei=False,
+    avg_best_idx=2.0,
+    shrink_coef=0.1,
+):
+    """Compile a full HPO experiment into one reusable device program.
+
+    Returns ``runner(seed=0, return_trials=False) -> result dict``; the
+    seed is a traced input, so repeated runs (seed sweeps, CV repeats)
+    reuse the compilation.
+
+    Args:
+      fn: JAX-traceable objective over a dict of [batch] value arrays.
+      space: an ``hp.*`` space (pytree of pyll graphs).
+      max_evals: total evaluations (rounded up to a batch multiple).
+      batch_size: trials suggested + evaluated per step (population mode
+        when > 1 -- all members of a step share the same posterior).
+      algo: 'tpe' | 'anneal' | 'rand'.
+      joint_ei: TPE only -- whole-configuration scoring (see tpe_jax).
+
+    The result dict has ``best`` ({label: python value}), ``best_loss``,
+    ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
+    ``return_trials=True``, a rebuilt host ``Trials`` store (one
+    device->host copy per array plus list-of-docs assembly).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .ops import kernels as K
+
+    if algo not in ("tpe", "anneal", "rand"):
+        raise ValueError(f"unknown algo {algo!r}: expected tpe|anneal|rand")
+    ps = compile_space(space)
+    c = ps._consts
+    D = ps.n_dims
+    Dc = len(ps.cont_idx)
+    Dk = len(ps.cat_idx)
+    B = int(batch_size)
+    assert B >= 1
+    n_steps = -(-int(max_evals) // B)
+    N = n_steps * B
+    cap = _round_up(N, 128)
+    n_cand = int(n_EI_candidates)
+    gamma_f = float(gamma)
+    lf_f = float(linear_forgetting)
+    pw = float(prior_weight)
+    startup_steps = -(-int(n_startup_jobs) // B)
+
+    accepts_active = "active" in inspect.signature(fn).parameters
+
+    def eval_batch(values, active):
+        """values/active [D, B] -> losses [B] via the user objective."""
+        cfg = {label: values[d] for d, label in enumerate(ps.labels)}
+        if accepts_active:
+            return fn(cfg, active={
+                label: active[d] for d, label in enumerate(ps.labels)
+            })
+        return fn(cfg)
+
+    def suggest(key, step, values, active, losses, valid):
+        if algo == "rand":
+            return ps.sample_prior_fn(key, B)
+
+        def prior(_):
+            return ps.sample_prior_fn(key, B)
+
+        def model(_):
+            if algo == "anneal":
+                return _anneal_step(key, values, active, losses, valid)
+            return _tpe_step(key, values, active, losses, valid)
+
+        # static startup split: scan unrolls nothing -- use lax.cond on
+        # the traced step counter
+        return jax.lax.cond(step < startup_steps, prior, model, None)
+
+    def _tpe_step(key, values, active, losses, valid):
+        from .tpe_jax import build_suggest_fn
+
+        # the returned fn is jitted; nested jit inlines under the scan trace
+        fn_ = build_suggest_fn(ps, n_cand, gamma_f, lf_f, pw, joint_ei=joint_ei)
+        return fn_(key, values, active, losses, valid, batch=B)
+
+    def _anneal_step(key, values, active, losses, valid):
+        from .anneal_jax import build_anneal_fn
+
+        fn_ = build_anneal_fn(ps, avg_best_idx, shrink_coef)
+        return fn_(key, values, active, losses, valid, batch=B)
+
+    def step(base_key, carry, i):
+        values, active, losses, valid = carry
+        key = jax.random.fold_in(base_key, i)
+        new_vals, new_act = suggest(key, i, values, active, losses, valid)
+        new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
+        idx = i * B + jnp.arange(B)
+        values = values.at[:, idx].set(new_vals)
+        active = active.at[:, idx].set(new_act)
+        losses = losses.at[idx].set(new_losses)
+        valid = valid.at[idx].set(True)
+        return (values, active, losses, valid), new_losses
+
+    @jax.jit
+    def run(seed_arr):
+        base_key = jax.random.key(seed_arr)
+        values = jnp.zeros((D, cap), dtype=jnp.float32)
+        active = jnp.zeros((D, cap), dtype=bool)
+        losses = jnp.zeros(cap, dtype=jnp.float32)
+        valid = jnp.zeros(cap, dtype=bool)
+        (values, active, losses, valid), _ = jax.lax.scan(
+            lambda carry, i: step(base_key, carry, i),
+            (values, active, losses, valid),
+            jnp.arange(n_steps),
+        )
+        ok = valid & jnp.isfinite(losses)
+        keyed = jnp.where(ok, losses, jnp.inf)
+        best_i = jnp.argmin(keyed)
+        return values, active, losses, valid, best_i
+
+    cat_dims = set(ps.cat_idx.tolist())
+
+    def runner(seed=0, return_trials=False):
+        values, active, losses, valid, best_i = jax.block_until_ready(
+            run(jnp.uint32(int(seed) % (2**32)))
+        )
+        values_np = np.asarray(values)[:, :N]
+        active_np = np.asarray(active)[:, :N]
+        losses_np = np.asarray(losses)[:N]
+        if not np.isfinite(losses_np).any():
+            from .exceptions import AllTrialsFailed
+
+            raise AllTrialsFailed(
+                "every on-device trial returned a non-finite loss"
+            )
+        bi = int(best_i)
+
+        best = {}
+        for d, label in enumerate(ps.labels):
+            if not active_np[d, bi]:
+                continue
+            v = float(values_np[d, bi])
+            best[label] = int(round(v)) if d in cat_dims else v
+
+        out = {
+            "best": best,
+            "best_loss": float(losses_np[bi]),
+            "best_index": bi,
+            "losses": losses_np,
+            "values": values_np,
+            "active": active_np,
+            "n_evals": N,
+        }
+        if return_trials:
+            out["trials"] = _to_trials(ps, values_np, active_np, losses_np)
+        return out
+
+    return runner
+
+
+def fmin_on_device(fn, space, max_evals, seed=0, return_trials=False, **kw):
+    """One-shot convenience over :func:`compile_fmin` (compiles every
+    call; use compile_fmin directly for seed sweeps)."""
+    return compile_fmin(fn, space, max_evals, **kw)(
+        seed=seed, return_trials=return_trials
+    )
+
+
+def _to_trials(ps, values, active, losses):
+    """Rebuild a host ``Trials`` store from the device history."""
+    from .base import JOB_STATE_DONE, STATUS_OK, Trials
+
+    trials = Trials()
+    n = values.shape[1]
+    ids = trials.new_trial_ids(n)
+    cat = set(ps.cat_idx.tolist())
+    miscs = []
+    for i, tid in enumerate(ids):
+        t_idxs, t_vals = {}, {}
+        for d, label in enumerate(ps.labels):
+            if active[d, i]:
+                v = float(values[d, i])
+                t_idxs[label] = [tid]
+                t_vals[label] = [int(round(v)) if d in cat else v]
+            else:
+                t_idxs[label] = []
+                t_vals[label] = []
+        miscs.append({
+            "tid": tid,
+            "cmd": ("domain_attachment", "FMinIter_Domain"),
+            "workdir": None,
+            "idxs": t_idxs,
+            "vals": t_vals,
+        })
+    results = [
+        {"status": STATUS_OK, "loss": float(losses[i])} for i in range(n)
+    ]
+    docs = trials.new_trial_docs(ids, [None] * n, results, miscs)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return trials

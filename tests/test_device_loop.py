@@ -1,0 +1,137 @@
+"""The on-device optimization loop: one compiled program per experiment
+(suggest + evaluate + history append under lax.scan)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.device_loop import compile_fmin, fmin_on_device
+
+
+def quad_space():
+    return {
+        "x": hp.uniform("x", -5.0, 5.0),
+        "y": hp.loguniform("y", np.log(1e-3), np.log(10.0)),
+    }
+
+
+def quad_obj(cfg):
+    return (cfg["x"] - 1.0) ** 2 + (jnp.log(cfg["y"]) - jnp.log(0.1)) ** 2
+
+
+def test_device_loop_tpe_beats_random():
+    n = 160
+    tpe_out = fmin_on_device(quad_obj, quad_space(), max_evals=n, seed=0)
+    rand_out = fmin_on_device(
+        quad_obj, quad_space(), max_evals=n, algo="rand", seed=0
+    )
+    assert tpe_out["n_evals"] == n
+    assert tpe_out["best_loss"] < rand_out["best_loss"]
+    assert abs(tpe_out["best"]["x"] - 1.0) < 1.0
+    # history bookkeeping: best really is the min of the losses
+    assert tpe_out["best_loss"] == pytest.approx(float(tpe_out["losses"].min()))
+
+
+def test_device_loop_runner_reuse_and_determinism():
+    runner = compile_fmin(quad_obj, quad_space(), max_evals=64, batch_size=8)
+    a = runner(seed=3)
+    b = runner(seed=3)
+    c = runner(seed=4)
+    np.testing.assert_array_equal(a["losses"], b["losses"])
+    assert not np.array_equal(a["losses"], c["losses"])
+
+
+def cond_space():
+    return {
+        "lr": hp.loguniform("lr", np.log(1e-4), np.log(1.0)),
+        "arch": hp.choice(
+            "arch",
+            [
+                {"k": 0, "depth": hp.quniform("depth", 2, 8, 1)},
+                {"k": 1, "w": hp.uniform("w", 0.0, 1.0)},
+            ],
+        ),
+    }
+
+
+def cond_obj(cfg, active):
+    base = (jnp.log(cfg["lr"]) - jnp.log(3e-3)) ** 2
+    arm = jnp.where(
+        active["depth"],
+        0.1 * (cfg["depth"] - 5.0) ** 2,
+        1.0 + (cfg["w"] - 0.5) ** 2,
+    )
+    return base + arm
+
+
+@pytest.mark.parametrize("algo,joint", [("tpe", False), ("tpe", True),
+                                        ("anneal", False)])
+def test_device_loop_conditional_space(algo, joint):
+    out = fmin_on_device(
+        cond_obj, cond_space(), max_evals=96, batch_size=8,
+        algo=algo, joint_ei=joint, seed=0,
+    )
+    # conditional bookkeeping: exactly one branch active per trial
+    d = {l: i for i, l in enumerate(["arch", "depth", "lr", "w"])}
+    act = out["active"]
+    assert act.shape[1] == 96
+    assert np.array_equal(act[d["depth"]], out["values"][d["arch"]] == 0)
+    assert np.array_equal(act[d["w"]], out["values"][d["arch"]] == 1)
+    # best config only contains active labels
+    if out["best"]["arch"] == 0:
+        assert "depth" in out["best"] and "w" not in out["best"]
+    else:
+        assert "w" in out["best"] and "depth" not in out["best"]
+    # quantized dim stays on grid
+    depths = out["values"][d["depth"]][act[d["depth"]]]
+    assert np.all(depths == np.round(depths))
+
+
+def test_device_loop_trials_rebuild():
+    out = fmin_on_device(
+        cond_obj, cond_space(), max_evals=48, batch_size=8, seed=2,
+        return_trials=True,
+    )
+    trials = out["trials"]
+    assert len(trials) == 48
+    assert min(trials.losses()) == pytest.approx(out["best_loss"])
+    best = trials.best_trial
+    assert best["result"]["loss"] == pytest.approx(out["best_loss"])
+    # docs carry the sparse idxs/vals encoding (conditional dims absent)
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        assert (len(vals["depth"]) == 1) != (len(vals["w"]) == 1)
+
+
+def test_device_loop_nan_losses_masked():
+    """Trials whose objective returns NaN are excluded from the posterior
+    but the loop still runs to completion."""
+
+    def obj(cfg):
+        loss = (cfg["x"] - 1.0) ** 2
+        return jnp.where(cfg["x"] < -4.0, jnp.nan, loss)
+
+    out = fmin_on_device(
+        obj, {"x": hp.uniform("x", -5.0, 5.0)}, max_evals=80, seed=0
+    )
+    assert np.isfinite(out["best_loss"])
+    assert out["best_loss"] < 1.0
+
+
+def test_device_loop_rejects_unknown_algo():
+    with pytest.raises(ValueError, match="unknown algo"):
+        compile_fmin(quad_obj, quad_space(), max_evals=8, algo="random")
+
+
+def test_device_loop_all_failed_raises():
+    from hyperopt_tpu.exceptions import AllTrialsFailed
+
+    runner = compile_fmin(
+        lambda cfg: jnp.full_like(cfg["x"], jnp.nan),
+        {"x": hp.uniform("x", -1.0, 1.0)},
+        max_evals=24,
+    )
+    with pytest.raises(AllTrialsFailed):
+        runner(seed=0)
