@@ -59,6 +59,8 @@ def compile_fmin(
     joint_ei=False,
     avg_best_idx=2.0,
     shrink_coef=0.1,
+    mesh=None,
+    trial_axis="trial",
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -74,6 +76,11 @@ def compile_fmin(
         when > 1 -- all members of a step share the same posterior).
       algo: 'tpe' | 'anneal' | 'rand'.
       joint_ei: TPE only -- whole-configuration scoring (see tpe_jax).
+      mesh: optional ``jax.sharding.Mesh``; the population axis of every
+        step (suggest batch + objective evaluation) is sharded over
+        ``trial_axis`` with GSPMD sharding constraints -- the history
+        buffers stay replicated (every device needs the full posterior).
+        ``batch_size`` must be a multiple of the axis size.
 
     The result dict has ``best`` ({label: python value}), ``best_loss``,
     ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
@@ -83,15 +90,11 @@ def compile_fmin(
     import jax
     import jax.numpy as jnp
 
-    from .ops import kernels as K
-
     if algo not in ("tpe", "anneal", "rand"):
         raise ValueError(f"unknown algo {algo!r}: expected tpe|anneal|rand")
     ps = compile_space(space)
-    c = ps._consts
+    _ = ps._consts  # materialize device constants outside the trace
     D = ps.n_dims
-    Dc = len(ps.cont_idx)
-    Dk = len(ps.cat_idx)
     B = int(batch_size)
     assert B >= 1
     n_steps = -(-int(max_evals) // B)
@@ -102,6 +105,14 @@ def compile_fmin(
     lf_f = float(linear_forgetting)
     pw = float(prior_weight)
     startup_steps = -(-int(n_startup_jobs) // B)
+
+    if mesh is not None:
+        n_dev = int(mesh.shape[trial_axis])
+        if B % n_dev:
+            raise ValueError(
+                f"batch_size={B} must be a multiple of mesh axis "
+                f"{trial_axis!r} size {n_dev}"
+            )
 
     accepts_active = "active" in inspect.signature(fn).parameters
 
@@ -143,11 +154,24 @@ def compile_fmin(
         fn_ = build_anneal_fn(ps, avg_best_idx, shrink_coef)
         return fn_(key, values, active, losses, valid, batch=B)
 
+    def _shard_batch(x, spec_tail):
+        """Pin the population axis of a per-step array onto the mesh."""
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P(*spec_tail))
+        )
+
     def step(base_key, carry, i):
         values, active, losses, valid = carry
         key = jax.random.fold_in(base_key, i)
         new_vals, new_act = suggest(key, i, values, active, losses, valid)
+        new_vals = _shard_batch(new_vals, (None, trial_axis))
+        new_act = _shard_batch(new_act, (None, trial_axis))
         new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
+        new_losses = _shard_batch(new_losses, (trial_axis,))
         idx = i * B + jnp.arange(B)
         values = values.at[:, idx].set(new_vals)
         active = active.at[:, idx].set(new_act)
@@ -222,7 +246,7 @@ def fmin_on_device(fn, space, max_evals, seed=0, return_trials=False, **kw):
 
 def _to_trials(ps, values, active, losses):
     """Rebuild a host ``Trials`` store from the device history."""
-    from .base import JOB_STATE_DONE, STATUS_OK, Trials
+    from .base import JOB_STATE_DONE, STATUS_FAIL, STATUS_OK, Trials
 
     trials = Trials()
     n = values.shape[1]
@@ -247,7 +271,10 @@ def _to_trials(ps, values, active, losses):
             "vals": t_vals,
         })
     results = [
-        {"status": STATUS_OK, "loss": float(losses[i])} for i in range(n)
+        {"status": STATUS_OK, "loss": float(losses[i])}
+        if np.isfinite(losses[i])
+        else {"status": STATUS_FAIL, "loss": None}
+        for i in range(n)
     ]
     docs = trials.new_trial_docs(ids, [None] * n, results, miscs)
     for doc in docs:

@@ -135,3 +135,43 @@ def test_device_loop_all_failed_raises():
     )
     with pytest.raises(AllTrialsFailed):
         runner(seed=0)
+
+
+def test_device_loop_sharded_population():
+    """batch axis sharded over an 8-device mesh (GSPMD constraints);
+    converges and stays deterministic."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices()[:8])
+    assert devs.size == 8  # conftest forces the 8-device CPU platform
+    mesh = Mesh(devs, ("trial",))
+    runner = compile_fmin(
+        quad_obj, quad_space(), max_evals=256, batch_size=16, mesh=mesh
+    )
+    a = runner(seed=0)
+    b = runner(seed=0)
+    np.testing.assert_array_equal(a["losses"], b["losses"])
+    assert a["best_loss"] < 0.5
+
+    with pytest.raises(ValueError, match="multiple of mesh axis"):
+        compile_fmin(
+            quad_obj, quad_space(), max_evals=64, batch_size=3, mesh=mesh
+        )
+
+
+def test_device_loop_trials_rebuild_marks_failures():
+    from hyperopt_tpu.base import STATUS_FAIL, STATUS_OK
+
+    def obj(cfg):
+        return jnp.where(cfg["x"] < 0.0, jnp.nan, cfg["x"] ** 2)
+
+    out = fmin_on_device(
+        obj, {"x": hp.uniform("x", -1.0, 1.0)}, max_evals=40, seed=0,
+        return_trials=True,
+    )
+    statuses = out["trials"].statuses()
+    assert STATUS_FAIL in statuses and STATUS_OK in statuses
+    losses = [l for l in out["trials"].losses() if l is not None]
+    assert losses and all(np.isfinite(losses))
+    assert min(losses) == pytest.approx(out["best_loss"])
