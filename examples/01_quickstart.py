@@ -1,0 +1,47 @@
+"""Quickstart: minimize a function over a mixed search space.
+
+Identical shape to the reference hyperopt workflow -- only the algo
+module changes (tpe_jax = the TPU path; tpe = the host parity path).
+
+    python examples/01_quickstart.py
+"""
+
+import numpy as np
+
+from hyperopt_tpu import STATUS_OK, Trials, fmin, hp, space_eval, tpe_jax
+
+
+def objective(cfg):
+    loss = (cfg["x"] - 0.7) ** 2 + abs(cfg["n_layers"] - 3) * 0.1
+    if cfg["activation"] == "relu":
+        loss += 0.05
+    # dict-return form with status, like the reference
+    return {"loss": loss, "status": STATUS_OK}
+
+
+space = {
+    "x": hp.uniform("x", -5.0, 5.0),
+    "n_layers": hp.quniform("n_layers", 1, 8, 1),
+    "activation": hp.choice("activation", ["relu", "gelu", "tanh"]),
+    "lr": hp.loguniform("lr", np.log(1e-5), np.log(1e-1)),
+}
+
+
+def main():
+    trials = Trials()
+    best = fmin(
+        objective,
+        space,
+        algo=tpe_jax.suggest,
+        max_evals=100,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    print("argmin (index form):", best)
+    print("argmin (config form):", space_eval(space, best))
+    print("best loss:", trials.best_trial["result"]["loss"])
+
+
+if __name__ == "__main__":
+    main()

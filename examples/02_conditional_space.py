@@ -1,0 +1,51 @@
+"""Conditional (tree-structured) spaces: hp.choice subtrees.
+
+A trial only carries values for the hyperparameters on its active
+branch -- the sparse idxs/vals encoding of the reference, reproduced by
+the compiled dense+mask sampler.
+
+    python examples/02_conditional_space.py
+"""
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin, hp, tpe_jax
+
+space = hp.choice(
+    "model",
+    [
+        {
+            "type": "mlp",
+            "depth": hp.randint("mlp_depth", 2, 8),
+            "width": hp.qloguniform("mlp_width", np.log(32), np.log(1024), 32),
+        },
+        {
+            "type": "cnn",
+            "blocks": hp.randint("cnn_blocks", 1, 5),
+            "channels": hp.quniform("cnn_channels", 16, 128, 16),
+        },
+    ],
+)
+
+
+def objective(cfg):
+    if cfg["type"] == "mlp":
+        return abs(cfg["depth"] - 4) * 0.2 + abs(cfg["width"] - 256) / 1024
+    return abs(cfg["blocks"] - 3) * 0.15 + abs(cfg["channels"] - 64) / 256
+
+
+def main():
+    trials = Trials()
+    fmin(
+        objective, space, algo=tpe_jax.suggest, max_evals=120, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+    best = trials.best_trial
+    print("best loss:", best["result"]["loss"])
+    print("best vals (sparse; inactive branch empty):")
+    for label, vals in sorted(best["misc"]["vals"].items()):
+        print(f"  {label}: {vals}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Distributed trial farming over a shared filesystem.
+
+The Mongo-worker role of the reference (SURVEY.md SS3.4) on the queue
+substrate TPU pods actually share: a directory. The driver enqueues NEW
+trials; workers reserve them with an atomic rename (CAS), evaluate, and
+write results back. Dead workers' reservations are reaped after
+--reserve-timeout.
+
+Run the driver:
+    python examples/04_distributed_workers.py /tmp/exp1
+Run N workers (any hosts mounting the same path):
+    hyperopt-tpu-worker --dir /tmp/exp1
+
+(This example also works standalone: with no workers attached it spawns
+two local worker subprocesses.)
+"""
+
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+
+from hyperopt_tpu import fmin, tpe_jax
+from hyperopt_tpu.distributed import FileTrials
+
+# NOTE: like the reference's Mongo workers, the objective ships to the
+# workers by pickle, so it must live in an importable module -- a
+# __main__-level function would fail to unpickle on the worker side.
+from hyperopt_tpu.models.synthetic import branin_fn, DOMAINS
+
+space = DOMAINS["branin"].make_space()
+objective = branin_fn
+
+
+def main():
+    exp_dir = sys.argv[1] if len(sys.argv) > 1 else tempfile.mkdtemp()
+    print("experiment dir:", exp_dir)
+
+    trials = FileTrials(exp_dir)
+    workers = [
+        subprocess.Popen(
+            [sys.executable, "-m", "hyperopt_tpu.distributed.worker",
+             "--dir", exp_dir, "--poll-interval", "0.05",
+             "--last-job-timeout", "60"],
+        )
+        for _ in range(2)
+    ]
+    try:
+        best = fmin(
+            objective, space, algo=tpe_jax.suggest, max_evals=40,
+            trials=trials, rstate=np.random.default_rng(0),
+            show_progressbar=False, max_queue_len=4,
+        )
+        print("best:", best)
+        print("best loss:", min(trials.losses()))
+    finally:
+        for w in workers:
+            w.terminate()
+
+
+if __name__ == "__main__":
+    main()

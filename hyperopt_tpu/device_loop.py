@@ -107,6 +107,11 @@ def compile_fmin(
     startup_steps = -(-int(n_startup_jobs) // B)
 
     if mesh is not None:
+        if trial_axis not in mesh.shape:
+            raise ValueError(
+                f"trial_axis {trial_axis!r} is not an axis of the mesh "
+                f"(axes: {tuple(mesh.shape)})"
+            )
         n_dev = int(mesh.shape[trial_axis])
         if B % n_dev:
             raise ValueError(
