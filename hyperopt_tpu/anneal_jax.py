@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .jax_trials import cached_suggest_fn, obs_buffer_for, packed_space_for
+from .jax_trials import cached_suggest_fn, host_key, obs_buffer_for, packed_space_for
 from .rand import docs_from_idxs_vals
 from .vectorize import dense_to_idxs_vals
 
@@ -138,7 +138,7 @@ def suggest_batch(
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     B = len(new_ids)
-    key = jax.random.key(int(seed) % (2**31 - 1))
+    key = host_key(int(seed) % (2**31 - 1))
 
     if buf.count == 0:
         values, active = ps.sample_prior(key, B)
@@ -149,9 +149,8 @@ def suggest_batch(
         )
         values, active = fn(key, *buf.device_arrays(), batch=B)
 
-    idxs, vals = dense_to_idxs_vals(
-        new_ids, ps.labels, np.asarray(values), np.asarray(active)
-    )
+    values, active = jax.device_get((values, active))
+    idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
 
 

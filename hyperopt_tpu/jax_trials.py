@@ -3,8 +3,10 @@
 The north-star ``JaxTrials`` backend (BASELINE.json / SURVEY.md SS7 stance
 #3): observation history lives in preallocated dense buffers (values +
 active-masks per hyperparameter, losses + validity), grown by doubling so
-jitted suggest steps see a small set of static shapes (power-of-2 bucketed
-capacity -> bounded recompiles, SURVEY.md SS7 'shape polymorphism').
+jitted suggest steps see a small set of static shapes (GROWTH_FACTOR-
+bucketed capacity -> bounded recompiles, SURVEY.md SS7 'shape
+polymorphism'; a recompile costs seconds, padded-slot compute costs
+microseconds, so buckets are coarse: 4x per growth).
 
 ``ObsBuffer`` is the packing engine: it incrementally mirrors any
 ``Trials`` store (only completed, status-ok, finite-loss trials enter the
@@ -20,9 +22,10 @@ import numpy as np
 from .base import JOB_STATE_DONE, STATUS_OK, Trials
 from .ops.compile import PackedSpace
 
-__all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY"]
+__all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY", "GROWTH_FACTOR"]
 
 MIN_CAPACITY = 128
+GROWTH_FACTOR = 4
 
 
 class ObsBuffer:
@@ -50,7 +53,7 @@ class ObsBuffer:
         self._device_cache = None  # (generation, arrays-on-device)
 
     def _grow(self):
-        new_cap = self.capacity * 2
+        new_cap = self.capacity * GROWTH_FACTOR
         for name in ("values", "active"):
             old = getattr(self, name)
             new = np.zeros((old.shape[0], new_cap), dtype=old.dtype)
@@ -185,6 +188,24 @@ def packed_space_for(domain) -> PackedSpace:
         ps = compile_space(domain.expr)
         domain._packed_space = ps
     return ps
+
+
+def host_key(seed):
+    """A PRNG key built on the CPU backend.
+
+    ``jax.random.key`` dispatches a (tiny) program to the default device;
+    on a remote-attached TPU that is a full round-trip (~90 ms measured
+    over the tunnel) per suggest call.  Keys are 8 bytes of bit-twiddling
+    -- make them on the host CPU and let the consuming program upload.
+    """
+    import jax
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        return jax.random.key(seed)
+    with jax.default_device(cpu):
+        return jax.random.key(seed)
 
 
 def cached_suggest_fn(domain, cache_attr, params, builder):

@@ -22,7 +22,7 @@ import functools
 
 import numpy as np
 
-from ..jax_trials import cached_suggest_fn, obs_buffer_for, packed_space_for
+from ..jax_trials import cached_suggest_fn, host_key, obs_buffer_for, packed_space_for
 from ..rand import docs_from_idxs_vals
 from ..vectorize import dense_to_idxs_vals
 from .mesh import CAND_AXIS, default_mesh
@@ -151,7 +151,7 @@ def sharded_suggest(
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     B = len(new_ids)
-    key = jax.random.key(int(seed) % (2**31 - 1))
+    key = host_key(int(seed) % (2**31 - 1))
 
     if buf.count < n_startup_jobs:
         values, active = ps.sample_prior(key, B)
@@ -173,9 +173,8 @@ def sharded_suggest(
 
     from ..tpe_jax import _cast_vals
 
-    idxs, vals = dense_to_idxs_vals(
-        new_ids, ps.labels, np.asarray(values), np.asarray(active)
-    )
+    values, active = jax.device_get((values, active))
+    idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     idxs, vals = _cast_vals(ps, idxs, vals)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
 

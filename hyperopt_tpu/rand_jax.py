@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .jax_trials import packed_space_for
+from .jax_trials import host_key, packed_space_for
 from .rand import docs_from_idxs_vals
 from .tpe_jax import _cast_vals
 from .vectorize import dense_to_idxs_vals
@@ -21,11 +21,10 @@ def suggest_batch(new_ids, domain, trials, seed):
     import jax
 
     ps = packed_space_for(domain)
-    key = jax.random.key(int(seed) % (2**31 - 1))
+    key = host_key(int(seed) % (2**31 - 1))
     values, active = ps.sample_prior(key, len(new_ids))
-    idxs, vals = dense_to_idxs_vals(
-        new_ids, ps.labels, np.asarray(values), np.asarray(active)
-    )
+    values, active = jax.device_get((values, active))
+    idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
 
 

@@ -131,11 +131,15 @@ def dense_to_idxs_vals(new_ids, labels, values, active):
     idxs = {}
     vals = {}
     new_ids = list(new_ids)
+    values = np.asarray(values)
+    active = np.asarray(active, dtype=bool)  # int masks must not fancy-index
     for d, label in enumerate(labels):
-        mask = np.asarray(active[d])
-        row = np.asarray(values[d])
-        idxs[label] = [tid for tid, m in zip(new_ids, mask) if m]
-        vals[label] = [row[i].item() for i, m in enumerate(mask) if m]
+        mask = active[d]
+        if mask.all():
+            idxs[label] = list(new_ids)
+        else:
+            idxs[label] = [tid for tid, m in zip(new_ids, mask) if m]
+        vals[label] = values[d][mask].tolist()
     return idxs, vals
 
 

@@ -109,3 +109,18 @@ def test_step_timer_and_instrumented_algo():
     assert s["count"] == 5
     assert s["total_s"] >= 5 * s["min_s"]
     timer.log_summary()
+
+
+def test_enable_compilation_cache(tmp_path):
+    import jax
+
+    from hyperopt_tpu.utils import enable_compilation_cache
+
+    d = enable_compilation_cache(str(tmp_path / "xla"))
+    assert d == str(tmp_path / "xla")
+    import os
+    assert os.path.isdir(d)
+    assert jax.config.jax_compilation_cache_dir == d
+    # a compile lands entries in the cache directory
+    jax.jit(lambda x: x * 2 + 1)(jax.numpy.arange(8)).block_until_ready()
+    # (cache writes are async/best-effort; config acceptance is the contract)

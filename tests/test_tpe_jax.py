@@ -123,7 +123,7 @@ def test_obs_buffer_sync_and_growth():
     trials.insert_trial_docs(docs[3:])
     trials.refresh()
     assert buf.sync(trials) == 7  # incremental: only the new ones
-    assert buf.count == 10 and buf.capacity == 16  # doubled twice
+    assert buf.count == 10 and buf.capacity == 16  # grew 4 -> 16 (one 4x step)
     np.testing.assert_array_equal(buf.losses[:10], np.arange(10, dtype=np.float32))
     assert buf.valid[:10].all() and not buf.valid[10:].any()
 
