@@ -13,8 +13,6 @@ per-hyperparameter Python loop.
 
 from __future__ import annotations
 
-import numpy as np
-
 from .jax_trials import cached_suggest_fn, host_key, obs_buffer_for, packed_space_for
 from .rand import docs_from_idxs_vals
 from .vectorize import dense_to_idxs_vals

@@ -7,8 +7,6 @@ per trial (SURVEY.md SS3.3 -> SS7 stance #1).
 
 from __future__ import annotations
 
-import numpy as np
-
 from .jax_trials import host_key, packed_space_for
 from .rand import docs_from_idxs_vals
 from .tpe_jax import _cast_vals

@@ -22,8 +22,6 @@ from __future__ import annotations
 import functools
 import logging
 
-import numpy as np
-
 from .rand import docs_from_idxs_vals
 from .jax_trials import cached_suggest_fn, host_key, obs_buffer_for, packed_space_for
 from .vectorize import dense_to_idxs_vals

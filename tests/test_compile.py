@@ -121,3 +121,83 @@ def test_sample_prior_deterministic():
     v1, _ = ps.sample_prior(jax.random.key(9), 16)
     v2, _ = ps.sample_prior(jax.random.key(9), 16)
     np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+
+
+def test_compile_deeply_nested_choice_stress():
+    """Three levels of hp.choice nesting (the NAS-style stress case,
+    SURVEY.md SS7 'hard parts'): activity masks must reflect the full
+    conjunction of ancestor choices on every path, on the compiled
+    sampler, the host sampler, tpe_jax, and the device loop."""
+    import jax
+    import numpy as np
+
+    from hyperopt_tpu import Domain, Trials, fmin, hp, tpe_jax
+    from hyperopt_tpu.ops.compile import compile_space
+
+    space = hp.choice("l1", [
+        {"arm": 0, "a": hp.uniform("a", 0, 1)},
+        {"arm": 1, "sub": hp.choice("l2", [
+            {"k": 0, "b": hp.uniform("b", 0, 1)},
+            {"k": 1, "deep": hp.choice("l3", [
+                {"z": 0, "c": hp.quniform("c", 0, 10, 1)},
+                {"z": 1, "d": hp.randint("d", 3)},
+            ])},
+        ])},
+    ])
+
+    ps = compile_space(space)
+    assert not ps.unconditional
+    values, active = ps.sample_prior(jax.random.key(0), 256)
+    values, active = np.asarray(values), np.asarray(active)
+    lbl = {l: i for i, l in enumerate(ps.labels)}
+    l1, l2, l3 = values[lbl["l1"]], values[lbl["l2"]], values[lbl["l3"]]
+    # conjunction of ancestors, per level
+    np.testing.assert_array_equal(active[lbl["a"]], l1 == 0)
+    np.testing.assert_array_equal(active[lbl["l2"]], l1 == 1)
+    np.testing.assert_array_equal(active[lbl["b"]], (l1 == 1) & (l2 == 0))
+    np.testing.assert_array_equal(active[lbl["l3"]], (l1 == 1) & (l2 == 1))
+    np.testing.assert_array_equal(
+        active[lbl["c"]], (l1 == 1) & (l2 == 1) & (l3 == 0)
+    )
+    np.testing.assert_array_equal(
+        active[lbl["d"]], (l1 == 1) & (l2 == 1) & (l3 == 1)
+    )
+
+    def obj(cfg):
+        if cfg["arm"] == 0:
+            return cfg["a"]
+        sub = cfg["sub"]
+        if sub["k"] == 0:
+            return 1.0 + sub["b"]
+        deep = sub["deep"]
+        return (2.0 + deep["c"] / 10.0) if deep["z"] == 0 else 2.0 + deep["d"]
+
+    trials = Trials()
+    fmin(obj, space, algo=tpe_jax.suggest, max_evals=60, trials=trials,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        arm = vals["l1"][0]
+        assert (len(vals["a"]) == 1) == (arm == 0)
+        assert (len(vals["l2"]) == 1) == (arm == 1)
+        if arm == 1 and vals["l2"][0] == 1:
+            assert len(vals["l3"]) == 1
+            z = vals["l3"][0]
+            assert (len(vals["c"]) == 1) == (z == 0)
+            assert (len(vals["d"]) == 1) == (z == 1)
+    assert min(trials.losses()) < 1.0  # found the best (arm 0) branch
+
+    # device loop over the same nested space
+    from hyperopt_tpu.device_loop import fmin_on_device
+    import jax.numpy as jnp
+
+    def dev_obj(cfg, active):
+        return jnp.where(
+            active["a"], cfg["a"],
+            jnp.where(active["b"], 1.0 + cfg["b"],
+                      jnp.where(active["c"], 2.0 + cfg["c"] / 10.0,
+                                2.0 + cfg["d"])),
+        )
+
+    out = fmin_on_device(dev_obj, space, max_evals=64, batch_size=8, seed=0)
+    assert out["best_loss"] < 1.0

@@ -112,15 +112,25 @@ def test_step_timer_and_instrumented_algo():
 
 
 def test_enable_compilation_cache(tmp_path):
+    import os
+
     import jax
 
     from hyperopt_tpu.utils import enable_compilation_cache
 
-    d = enable_compilation_cache(str(tmp_path / "xla"))
-    assert d == str(tmp_path / "xla")
-    import os
-    assert os.path.isdir(d)
-    assert jax.config.jax_compilation_cache_dir == d
-    # a compile lands entries in the cache directory
-    jax.jit(lambda x: x * 2 + 1)(jax.numpy.arange(8)).block_until_ready()
-    # (cache writes are async/best-effort; config acceptance is the contract)
+    prev = (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+    )
+    try:
+        d = enable_compilation_cache(str(tmp_path / "xla"))
+        assert d == str(tmp_path / "xla")
+        assert os.path.isdir(d)
+        assert jax.config.jax_compilation_cache_dir == d
+        # a compile flows through the now-enabled cache
+        jax.jit(lambda x: x * 2 + 1)(jax.numpy.arange(8)).block_until_ready()
+    finally:  # process-global config: restore for later tests
+        jax.config.update("jax_compilation_cache_dir", prev[0])
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", prev[1])
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", prev[2])
