@@ -61,6 +61,7 @@ def compile_fmin(
     shrink_coef=0.1,
     mesh=None,
     trial_axis="trial",
+    loss_threshold=None,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -81,6 +82,11 @@ def compile_fmin(
         ``trial_axis`` with GSPMD sharding constraints -- the history
         buffers stay replicated (every device needs the full posterior).
         ``batch_size`` must be a multiple of the axis size.
+      loss_threshold: stop as soon as a trial reaches this loss (fmin's
+        stopping-rule parity) -- the scan becomes a ``lax.while_loop``,
+        so a threshold hit early really does cut device wall-clock.
+        Untouched tail slots stay invalid; ``n_evals`` in the result is
+        the count actually run.
 
     The result dict has ``best`` ({label: python value}), ``best_loss``,
     ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
@@ -92,6 +98,9 @@ def compile_fmin(
 
     if algo not in ("tpe", "anneal", "rand"):
         raise ValueError(f"unknown algo {algo!r}: expected tpe|anneal|rand")
+    from .fmin import validate_loss_threshold
+
+    validate_loss_threshold(loss_threshold)
     ps = compile_space(space)
     _ = ps._consts  # materialize device constants outside the trace
     D = ps.n_dims
@@ -191,25 +200,48 @@ def compile_fmin(
         active = jnp.zeros((D, cap), dtype=bool)
         losses = jnp.zeros(cap, dtype=jnp.float32)
         valid = jnp.zeros(cap, dtype=bool)
-        (values, active, losses, valid), _ = jax.lax.scan(
-            lambda carry, i: step(base_key, carry, i),
-            (values, active, losses, valid),
-            jnp.arange(n_steps),
-        )
+        if loss_threshold is None:
+            (values, active, losses, valid), _ = jax.lax.scan(
+                lambda carry, i: step(base_key, carry, i),
+                (values, active, losses, valid),
+                jnp.arange(n_steps),
+            )
+            n_done = jnp.int32(n_steps)
+        else:
+            thr = jnp.float32(loss_threshold)
+
+            def cond(state):
+                i, hit, _ = state
+                return (i < n_steps) & ~hit
+
+            def body(state):
+                i, hit, carry = state
+                carry, new_losses = step(base_key, carry, i)
+                hit = hit | jnp.any(
+                    jnp.isfinite(new_losses) & (new_losses <= thr)
+                )
+                return i + 1, hit, carry
+
+            n_done, _, (values, active, losses, valid) = jax.lax.while_loop(
+                cond, body,
+                (jnp.int32(0), jnp.bool_(False),
+                 (values, active, losses, valid)),
+            )
         ok = valid & jnp.isfinite(losses)
         keyed = jnp.where(ok, losses, jnp.inf)
         best_i = jnp.argmin(keyed)
-        return values, active, losses, valid, best_i
+        return values, active, losses, valid, best_i, n_done
 
     cat_dims = set(ps.cat_idx.tolist())
 
     def runner(seed=0, return_trials=False):
-        values, active, losses, valid, best_i = jax.block_until_ready(
+        values, active, losses, valid, best_i, n_done = jax.block_until_ready(
             run(jnp.uint32(int(seed) % (2**32)))
         )
-        values_np = np.asarray(values)[:, :N]
-        active_np = np.asarray(active)[:, :N]
-        losses_np = np.asarray(losses)[:N]
+        n_ran = int(n_done) * B
+        values_np = np.asarray(values)[:, :n_ran]
+        active_np = np.asarray(active)[:, :n_ran]
+        losses_np = np.asarray(losses)[:n_ran]
         if not np.isfinite(losses_np).any():
             from .exceptions import AllTrialsFailed
 
@@ -232,7 +264,7 @@ def compile_fmin(
             "losses": losses_np,
             "values": values_np,
             "active": active_np,
-            "n_evals": N,
+            "n_evals": n_ran,
         }
         if return_trials:
             out["trials"] = _to_trials(ps, values_np, active_np, losses_np)

@@ -175,3 +175,21 @@ def test_device_loop_trials_rebuild_marks_failures():
     losses = [l for l in out["trials"].losses() if l is not None]
     assert losses and all(np.isfinite(losses))
     assert min(losses) == pytest.approx(out["best_loss"])
+
+
+def test_device_loop_loss_threshold_stops_early():
+    runner = compile_fmin(
+        quad_obj, quad_space(), max_evals=512, batch_size=8,
+        loss_threshold=0.5,
+    )
+    out = runner(seed=0)
+    assert out["best_loss"] <= 0.5
+    assert out["n_evals"] < 512  # stopped before the budget
+    assert len(out["losses"]) == out["n_evals"]
+    # threshold never reached -> full budget
+    runner2 = compile_fmin(
+        quad_obj, quad_space(), max_evals=40, batch_size=8,
+        loss_threshold=-1.0,
+    )
+    out2 = runner2(seed=0)
+    assert out2["n_evals"] == 40
