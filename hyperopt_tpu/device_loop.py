@@ -62,6 +62,7 @@ def compile_fmin(
     mesh=None,
     trial_axis="trial",
     loss_threshold=None,
+    no_progress_steps=None,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -87,6 +88,10 @@ def compile_fmin(
         so a threshold hit early really does cut device wall-clock.
         Untouched tail slots stay invalid; ``n_evals`` in the result is
         the count actually run.
+      no_progress_steps: stop after this many consecutive *steps* (each
+        ``batch_size`` trials) without improving the best loss -- the
+        on-device counterpart of ``early_stop.no_progress_loss``.
+        Composes with ``loss_threshold``.
 
     The result dict has ``best`` ({label: python value}), ``best_loss``,
     ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
@@ -101,6 +106,11 @@ def compile_fmin(
     from .fmin import validate_loss_threshold
 
     validate_loss_threshold(loss_threshold)
+    if no_progress_steps is not None and (
+        not isinstance(no_progress_steps, (int, np.integer))
+        or no_progress_steps < 1
+    ):
+        raise ValueError("no_progress_steps must be a positive integer")
     ps = compile_space(space)
     _ = ps._consts  # materialize device constants outside the trace
     D = ps.n_dims
@@ -200,7 +210,7 @@ def compile_fmin(
         active = jnp.zeros((D, cap), dtype=bool)
         losses = jnp.zeros(cap, dtype=jnp.float32)
         valid = jnp.zeros(cap, dtype=bool)
-        if loss_threshold is None:
+        if loss_threshold is None and no_progress_steps is None:
             (values, active, losses, valid), _ = jax.lax.scan(
                 lambda carry, i: step(base_key, carry, i),
                 (values, active, losses, valid),
@@ -208,24 +218,41 @@ def compile_fmin(
             )
             n_done = jnp.int32(n_steps)
         else:
-            thr = jnp.float32(loss_threshold)
+            thr = jnp.float32(
+                loss_threshold if loss_threshold is not None else -jnp.inf
+            )
+            stale_cap = (
+                jnp.int32(no_progress_steps)
+                if no_progress_steps is not None
+                else jnp.int32(n_steps + 1)
+            )
 
             def cond(state):
-                i, hit, _ = state
-                return (i < n_steps) & ~hit
+                i, stop, _, _, _ = state
+                return (i < n_steps) & ~stop
 
             def body(state):
-                i, hit, carry = state
+                i, stop, best, stale, carry = state
                 carry, new_losses = step(base_key, carry, i)
-                hit = hit | jnp.any(
-                    jnp.isfinite(new_losses) & (new_losses <= thr)
+                fin = jnp.isfinite(new_losses)
+                batch_best = jnp.min(jnp.where(fin, new_losses, jnp.inf))
+                improved = batch_best < best
+                best = jnp.minimum(best, batch_best)
+                # no_progress_loss parity: the stale counter only runs
+                # once SOME finite best exists -- all-failed startup
+                # batches must not stop the experiment
+                stale = jnp.where(
+                    improved | ~jnp.isfinite(best), 0, stale + 1
                 )
-                return i + 1, hit, carry
+                stop = (best <= thr) | (stale >= stale_cap)
+                return i + 1, stop, best, stale, carry
 
-            n_done, _, (values, active, losses, valid) = jax.lax.while_loop(
-                cond, body,
-                (jnp.int32(0), jnp.bool_(False),
-                 (values, active, losses, valid)),
+            n_done, _, _, _, (values, active, losses, valid) = (
+                jax.lax.while_loop(
+                    cond, body,
+                    (jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
+                     jnp.int32(0), (values, active, losses, valid)),
+                )
             )
         ok = valid & jnp.isfinite(losses)
         keyed = jnp.where(ok, losses, jnp.inf)

@@ -576,7 +576,11 @@ def ei_best_cat(key, p_below, p_above, n_cand):
     """
     u = jax.random.uniform(key, (n_cand,), dtype=p_below.dtype)
     onehot = _inverse_cdf_onehot(u, jnp.cumsum(jnp.maximum(p_below, 0.0)))
-    hit = jnp.any(onehot > 0, axis=0)  # [K]
+    # hit counts via an [1, S] x [S, K] contraction -- measured faster
+    # than the elementwise any-reduction under the (trial, dim) vmap
+    hit = jnp.matmul(
+        jnp.ones((1, n_cand), onehot.dtype), onehot
+    )[0] > 0  # [K]
     # padded options (p_below == 0) must never win the argmax
     llr = jnp.where(
         p_below > 0, _safe_log(p_below) - _safe_log(p_above), -jnp.inf

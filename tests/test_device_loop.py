@@ -193,3 +193,46 @@ def test_device_loop_loss_threshold_stops_early():
     )
     out2 = runner2(seed=0)
     assert out2["n_evals"] == 40
+
+
+def test_device_loop_no_progress_stops_early():
+    """On-device counterpart of early_stop.no_progress_loss: a constant
+    objective stops after startup + no_progress_steps batches."""
+
+    def flat(cfg):
+        return jnp.ones_like(cfg["x"])
+
+    runner = compile_fmin(
+        flat, {"x": hp.uniform("x", -1.0, 1.0)}, max_evals=400,
+        batch_size=8, no_progress_steps=3,
+    )
+    out = runner(seed=0)
+    # first batch sets best=1.0; every later batch is stale
+    assert out["n_evals"] == 8 * 4, out["n_evals"]
+    # an improving objective resets the stale counter, so with identical
+    # settings it must survive strictly longer than the flat one
+    out2 = compile_fmin(
+        quad_obj, quad_space(), max_evals=400, batch_size=8,
+        no_progress_steps=3,
+    )(seed=0)
+    assert out2["n_evals"] > out["n_evals"], (out2["n_evals"], out["n_evals"])
+
+    # all-failed batches must NOT advance the stale counter (parity with
+    # early_stop.no_progress_loss: never stop before a best exists)
+    def nan_then_quad(cfg):
+        return jnp.where(cfg["x"] > 4.0, cfg["x"] ** 2, jnp.nan)
+
+    out3 = compile_fmin(
+        nan_then_quad, {"x": hp.uniform("x", -5.0, 5.0)}, max_evals=200,
+        batch_size=4, no_progress_steps=2,
+    )(seed=0)
+    assert np.isfinite(out3["best_loss"])  # survived failed batches
+
+    with pytest.raises(ValueError, match="no_progress_steps"):
+        compile_fmin(
+            quad_obj, quad_space(), max_evals=8, no_progress_steps=0
+        )
+    with pytest.raises(ValueError, match="no_progress_steps"):
+        compile_fmin(
+            quad_obj, quad_space(), max_evals=8, no_progress_steps=2.7
+        )
