@@ -63,6 +63,7 @@ def compile_fmin(
     trial_axis="trial",
     loss_threshold=None,
     no_progress_steps=None,
+    warm_capacity=0,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -92,6 +93,11 @@ def compile_fmin(
         ``batch_size`` trials) without improving the best loss -- the
         on-device counterpart of ``early_stop.no_progress_loss``.
         Composes with ``loss_threshold``.
+      warm_capacity: reserve history slots for warm starts; ``runner(...,
+        init=prev_out)`` resumes from a previous result dict's history
+        (checkpoint/resume for the on-device path). Warm trials feed the
+        posterior and count toward the startup threshold but not toward
+        this run's ``max_evals``.
 
     The result dict has ``best`` ({label: python value}), ``best_loss``,
     ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
@@ -118,12 +124,12 @@ def compile_fmin(
     assert B >= 1
     n_steps = -(-int(max_evals) // B)
     N = n_steps * B
-    cap = _round_up(N, 128)
+    W = int(warm_capacity)
+    cap = _round_up(W + N, 128)
     n_cand = int(n_EI_candidates)
     gamma_f = float(gamma)
     lf_f = float(linear_forgetting)
     pw = float(prior_weight)
-    startup_steps = -(-int(n_startup_jobs) // B)
 
     if mesh is not None:
         if trial_axis not in mesh.shape:
@@ -161,9 +167,10 @@ def compile_fmin(
                 return _anneal_step(key, values, active, losses, valid)
             return _tpe_step(key, values, active, losses, valid)
 
-        # static startup split: scan unrolls nothing -- use lax.cond on
-        # the traced step counter
-        return jax.lax.cond(step < startup_steps, prior, model, None)
+        # startup on history size (cold: == step * B; warm starts skip
+        # straight to the model once enough history is loaded)
+        n_hist = jnp.sum(valid.astype(jnp.int32))
+        return jax.lax.cond(n_hist < n_startup_jobs, prior, model, None)
 
     def _tpe_step(key, values, active, losses, valid):
         from .tpe_jax import build_suggest_fn
@@ -188,7 +195,7 @@ def compile_fmin(
             x, NamedSharding(mesh, P(*spec_tail))
         )
 
-    def step(base_key, carry, i):
+    def step(base_key, c0, carry, i):
         values, active, losses, valid = carry
         key = jax.random.fold_in(base_key, i)
         new_vals, new_act = suggest(key, i, values, active, losses, valid)
@@ -196,7 +203,7 @@ def compile_fmin(
         new_act = _shard_batch(new_act, (None, trial_axis))
         new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
         new_losses = _shard_batch(new_losses, (trial_axis,))
-        idx = i * B + jnp.arange(B)
+        idx = c0 + i * B + jnp.arange(B)
         values = values.at[:, idx].set(new_vals)
         active = active.at[:, idx].set(new_act)
         losses = losses.at[idx].set(new_losses)
@@ -204,15 +211,11 @@ def compile_fmin(
         return (values, active, losses, valid), new_losses
 
     @jax.jit
-    def run(seed_arr):
+    def run(seed_arr, values, active, losses, valid, c0, best0):
         base_key = jax.random.key(seed_arr)
-        values = jnp.zeros((D, cap), dtype=jnp.float32)
-        active = jnp.zeros((D, cap), dtype=bool)
-        losses = jnp.zeros(cap, dtype=jnp.float32)
-        valid = jnp.zeros(cap, dtype=bool)
         if loss_threshold is None and no_progress_steps is None:
             (values, active, losses, valid), _ = jax.lax.scan(
-                lambda carry, i: step(base_key, carry, i),
+                lambda carry, i: step(base_key, c0, carry, i),
                 (values, active, losses, valid),
                 jnp.arange(n_steps),
             )
@@ -233,7 +236,7 @@ def compile_fmin(
 
             def body(state):
                 i, stop, best, stale, carry = state
-                carry, new_losses = step(base_key, carry, i)
+                carry, new_losses = step(base_key, c0, carry, i)
                 fin = jnp.isfinite(new_losses)
                 batch_best = jnp.min(jnp.where(fin, new_losses, jnp.inf))
                 improved = batch_best < best
@@ -250,7 +253,7 @@ def compile_fmin(
             n_done, _, _, _, (values, active, losses, valid) = (
                 jax.lax.while_loop(
                     cond, body,
-                    (jnp.int32(0), jnp.bool_(False), jnp.float32(jnp.inf),
+                    (jnp.int32(0), best0 <= thr, best0,
                      jnp.int32(0), (values, active, losses, valid)),
                 )
             )
@@ -261,14 +264,53 @@ def compile_fmin(
 
     cat_dims = set(ps.cat_idx.tolist())
 
-    def runner(seed=0, return_trials=False):
+    zero_buffers = []  # device-resident, reused by every cold run
+
+    def runner(seed=0, return_trials=False, init=None):
+        c0 = 0
+        best0 = np.float32(np.inf)
+        if init is None:
+            if not zero_buffers:  # non-donated, so safely reusable
+                zero_buffers.append(jax.device_put((
+                    np.zeros((D, cap), dtype=np.float32),
+                    np.zeros((D, cap), dtype=bool),
+                    np.zeros(cap, dtype=np.float32),
+                    np.zeros(cap, dtype=bool),
+                )))
+            values0, active0, losses0, valid0 = zero_buffers[0]
+        else:
+            iv = np.asarray(init["values"], dtype=np.float32)
+            ia = np.asarray(init["active"], dtype=bool)
+            il = np.asarray(init["losses"], dtype=np.float32)
+            c0 = il.shape[0]
+            if c0 > W:
+                raise ValueError(
+                    f"init history has {c0} trials but warm_capacity={W}; "
+                    "recompile with a larger warm_capacity"
+                )
+            values0 = np.zeros((D, cap), dtype=np.float32)
+            active0 = np.zeros((D, cap), dtype=bool)
+            losses0 = np.zeros(cap, dtype=np.float32)
+            valid0 = np.zeros(cap, dtype=bool)
+            values0[:, :c0] = iv
+            active0[:, :c0] = ia
+            losses0[:c0] = il
+            valid0[:c0] = True
+            fin = il[np.isfinite(il)]
+            if fin.size:  # early-stop rules see the warm best
+                best0 = np.float32(fin.min())
         values, active, losses, valid, best_i, n_done = jax.block_until_ready(
-            run(jnp.uint32(int(seed) % (2**32)))
+            run(
+                jnp.uint32(int(seed) % (2**32)),
+                values0, active0, losses0, valid0, jnp.int32(c0),
+                jnp.float32(best0),
+            )
         )
         n_ran = int(n_done) * B
-        values_np = np.asarray(values)[:, :n_ran]
-        active_np = np.asarray(active)[:, :n_ran]
-        losses_np = np.asarray(losses)[:n_ran]
+        total = c0 + n_ran
+        values_np = np.asarray(values)[:, :total]
+        active_np = np.asarray(active)[:, :total]
+        losses_np = np.asarray(losses)[:total]
         if not np.isfinite(losses_np).any():
             from .exceptions import AllTrialsFailed
 
@@ -288,10 +330,13 @@ def compile_fmin(
             "best": best,
             "best_loss": float(losses_np[bi]),
             "best_index": bi,
+            # full experiment history (warm prefix + this run) -- feed
+            # straight back in as ``init=`` to resume again
             "losses": losses_np,
             "values": values_np,
             "active": active_np,
             "n_evals": n_ran,
+            "n_total": total,
         }
         if return_trials:
             out["trials"] = _to_trials(ps, values_np, active_np, losses_np)
