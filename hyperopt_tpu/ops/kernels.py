@@ -427,7 +427,10 @@ def split_below_above(losses, valid, gamma, lf):
 
     keyed = jnp.where(valid, losses, jnp.inf)
     order = jnp.argsort(keyed, stable=True)  # stable: slot order breaks ties
-    rank = jnp.argsort(order, stable=True)
+    # inverse permutation by scatter -- cheaper than a second sort
+    rank = jnp.zeros_like(order).at[order].set(
+        jnp.arange(order.shape[0], dtype=order.dtype)
+    )
     below = valid & (rank < n_below)
     above = valid & ~below
     return below, above, n_below
