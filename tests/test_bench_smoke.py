@@ -1,0 +1,31 @@
+"""bench.py is the driver's measurement contract: it must always print
+exactly one valid JSON line with the expected schema. Run it small, on
+the hermetic CPU platform, as a real subprocess."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def test_bench_prints_one_json_line():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_BATCH"] = "16"
+    env["BENCH_N_CAND"] = "16"
+    env["BENCH_N_OBS"] = "60"
+    out = subprocess.run(
+        [sys.executable, "bench.py"],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    lines = [l for l in out.stdout.strip().splitlines() if l.startswith("{")]
+    assert len(lines) == 1, out.stdout
+    d = json.loads(lines[0])
+    for k in ("metric", "value", "unit", "vs_baseline", "platform", "batch"):
+        assert k in d, d
+    assert d["metric"] == "tpe_suggestions_per_sec_20dim_mixed"
+    assert d["value"] > 0 and d["vs_baseline"] > 0
+    assert d["unit"] == "suggestions/s"
