@@ -67,6 +67,7 @@ __all__ = [
     "__version__",
     "anneal",
     "anneal_jax",
+    "atpe_jax",
     "base",
     "early_stop",
     "exceptions",
@@ -113,6 +114,7 @@ def __getattr__(name):
         "tpe_jax",
         "rand_jax",
         "anneal_jax",
+        "atpe_jax",
         "device_loop",
         "jax_trials",
         "ops",

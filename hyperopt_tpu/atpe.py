@@ -172,7 +172,8 @@ def suggest(new_ids, domain, trials, seed, n_startup_jobs=20,
     """The algo plugin-boundary entry point: ``algo=atpe.suggest``."""
     rng = ensure_rng(seed)
     opt = getattr(domain, "_atpe_optimizer", None)
-    if opt is None or opt.lock_fraction != lock_fraction:
+    if (opt is None or opt.lock_fraction != lock_fraction
+            or opt.elite_count != elite_count):
         opt = ATPEOptimizer(lock_fraction=lock_fraction, elite_count=elite_count)
         domain._atpe_optimizer = opt
     helper = _domain_helper(domain)

@@ -1,0 +1,80 @@
+"""Adaptive TPE on the TPU path.
+
+Couples :class:`hyperopt_tpu.atpe.ATPEOptimizer`'s online decisions --
+per-step TPE hyperparameters (gamma / n_EI_candidates / prior_weight)
+and converged-parameter locking -- with the jitted suggest program of
+:mod:`hyperopt_tpu.tpe_jax` (via its shared :func:`tpe_jax.suggest_dense`
+engine). The decision layer is cheap host statistics over the trial
+history (exactly :mod:`hyperopt_tpu.atpe`); the candidate sweep runs
+on-device. Locked hyperparameters are overwritten in the dense draw and
+conditional activity is re-derived, so locking an ``hp.choice`` arm
+consistently re-routes its subtree. Lock decisions roll per suggestion,
+matching the host path's ``lock_fraction`` semantics for batched calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .atpe import ATPEOptimizer
+from .jax_trials import obs_buffer_for, packed_space_for
+from .pyll.stochastic import ensure_rng
+from .rand import docs_from_idxs_vals
+from .vectorize import dense_to_idxs_vals
+
+__all__ = ["suggest"]
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    n_startup_jobs=20,
+    linear_forgetting=25,
+    lock_fraction=0.5,
+    elite_count=8,
+):
+    """``algo=atpe_jax.suggest``: adaptive TPE with the device sweep."""
+    from . import tpe_jax
+
+    rng = ensure_rng(seed)
+    opt = getattr(domain, "_atpe_jax_optimizer", None)
+    if (opt is None or opt.lock_fraction != lock_fraction
+            or opt.elite_count != elite_count):
+        opt = ATPEOptimizer(lock_fraction=lock_fraction,
+                            elite_count=elite_count)
+        domain._atpe_jax_optimizer = opt
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    B = len(new_ids)
+    warm = buf.count >= n_startup_jobs
+
+    kw = {}
+    if warm:
+        kw = opt.tpe_settings(domain, trials)
+    values, active = tpe_jax.suggest_dense(
+        domain, trials, int(rng.integers(0, 2**31 - 1)), B,
+        n_startup_jobs=n_startup_jobs,
+        linear_forgetting=linear_forgetting,
+        **kw,
+    )
+    values = np.array(values)
+
+    if warm:
+        pos = {label: d for d, label in enumerate(ps.labels)}
+        relock = False
+        for j in range(B):  # per-suggestion lock roll (host-path parity)
+            for label, v in opt.locked_values(domain, trials, rng).items():
+                d = pos.get(label)
+                if d is not None:
+                    values[d, j] = float(v)
+                    relock = True
+        if relock:
+            # locking may re-route choice subtrees: recompute activity
+            active = np.asarray(ps.active_fn(values))
+
+    idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
+    idxs, vals = tpe_jax._cast_vals(ps, idxs, vals)
+    return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

@@ -28,7 +28,7 @@ from .vectorize import dense_to_idxs_vals
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["suggest", "suggest_batch", "build_suggest_fn"]
+__all__ = ["suggest", "suggest_batch", "suggest_dense", "build_suggest_fn"]
 
 _default_prior_weight = 1.0
 _default_n_EI_candidates = 128
@@ -143,6 +143,42 @@ def _cast_vals(ps, idxs, vals):
     return idxs, vals
 
 
+def suggest_dense(
+    domain,
+    trials,
+    seed,
+    batch,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    joint_ei=False,
+):
+    """Dense draws for a batch: (values [D, batch], active [D, batch]) as
+    host numpy -- one device program (prior during startup, TPE after).
+    The shared engine under :func:`suggest_batch` and adaptive variants
+    (:mod:`hyperopt_tpu.atpe_jax`)."""
+    import jax
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    key = host_key(int(seed) % (2**31 - 1))
+
+    if buf.count < n_startup_jobs:
+        values, active = ps.sample_prior(key, batch)
+    else:
+        fn = cached_suggest_fn(
+            domain, "_tpe_jax_cache",
+            (int(n_EI_candidates), float(gamma), float(linear_forgetting),
+             float(prior_weight), bool(joint_ei)),
+            build_suggest_fn,
+        )
+        values, active = fn(key, *buf.device_arrays(), batch=batch)
+
+    return jax.device_get((values, active))
+
+
 def suggest_batch(
     new_ids,
     domain,
@@ -157,25 +193,16 @@ def suggest_batch(
 ):
     """Sparse (idxs, vals) for a batch of ids -- one device program for the
     whole batch (B trials x D dims x n_EI_candidates candidates)."""
-    import jax
-
     ps = packed_space_for(domain)
-    buf = obs_buffer_for(domain, trials)
-    B = len(new_ids)
-    key = host_key(int(seed) % (2**31 - 1))
-
-    if buf.count < n_startup_jobs:
-        values, active = ps.sample_prior(key, B)
-    else:
-        fn = cached_suggest_fn(
-            domain, "_tpe_jax_cache",
-            (int(n_EI_candidates), float(gamma), float(linear_forgetting),
-             float(prior_weight), bool(joint_ei)),
-            build_suggest_fn,
-        )
-        values, active = fn(key, *buf.device_arrays(), batch=B)
-
-    values, active = jax.device_get((values, active))
+    values, active = suggest_dense(
+        domain, trials, seed, len(new_ids),
+        prior_weight=prior_weight,
+        n_startup_jobs=n_startup_jobs,
+        n_EI_candidates=n_EI_candidates,
+        gamma=gamma,
+        linear_forgetting=linear_forgetting,
+        joint_ei=joint_ei,
+    )
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
 
