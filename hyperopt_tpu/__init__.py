@@ -68,6 +68,7 @@ __all__ = [
     "anneal",
     "anneal_jax",
     "atpe_jax",
+    "device_loop",
     "base",
     "early_stop",
     "exceptions",

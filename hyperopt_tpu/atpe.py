@@ -99,8 +99,17 @@ class ATPEOptimizer:
     # -- parameter locking --------------------------------------------------
     def locked_values(self, domain, trials, rng):
         """{label: value} of converged hyperparameters to freeze this step."""
+        if rng.uniform() > self.lock_fraction:
+            return {}
+        return self.lock_candidates(domain, trials)
+
+    def lock_candidates(self, domain, trials):
+        """The gate-free half of :meth:`locked_values`: which labels have
+        converged across the elite set, and to what value.  Invariant for
+        a fixed history, so batched suggests compute it once and roll
+        only the per-suggestion gate."""
         ok = _ok_trials(trials)
-        if len(ok) < 20 or rng.uniform() > self.lock_fraction:
+        if len(ok) < 20:
             return {}
         ok.sort(key=lambda t: float(t["result"]["loss"]))
         elite = ok[: self.elite_count]

@@ -64,9 +64,12 @@ def suggest(
 
     if warm:
         pos = {label: d for d, label in enumerate(ps.labels)}
+        cands = opt.lock_candidates(domain, trials)  # invariant per call
         relock = False
         for j in range(B):  # per-suggestion lock roll (host-path parity)
-            for label, v in opt.locked_values(domain, trials, rng).items():
+            if not cands or rng.uniform() > opt.lock_fraction:
+                continue
+            for label, v in cands.items():
                 d = pos.get(label)
                 if d is not None:
                     values[d, j] = float(v)

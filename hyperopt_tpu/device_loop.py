@@ -155,7 +155,7 @@ def compile_fmin(
             })
         return fn(cfg)
 
-    def suggest(key, step, values, active, losses, valid):
+    def suggest(key, values, active, losses, valid):
         if algo == "rand":
             return ps.sample_prior_fn(key, B)
 
@@ -167,8 +167,9 @@ def compile_fmin(
                 return _anneal_step(key, values, active, losses, valid)
             return _tpe_step(key, values, active, losses, valid)
 
-        # startup on history size (cold: == step * B; warm starts skip
-        # straight to the model once enough history is loaded)
+        # startup on history size; every evaluated trial counts, failed
+        # or not, matching the reference driver (len(trials) gates
+        # startup there; failures only mask out of the posterior)
         n_hist = jnp.sum(valid.astype(jnp.int32))
         return jax.lax.cond(n_hist < n_startup_jobs, prior, model, None)
 
@@ -197,8 +198,10 @@ def compile_fmin(
 
     def step(base_key, c0, carry, i):
         values, active, losses, valid = carry
-        key = jax.random.fold_in(base_key, i)
-        new_vals, new_act = suggest(key, i, values, active, losses, valid)
+        # fold the warm offset too: a resumed run must not replay the
+        # original run's per-step key stream
+        key = jax.random.fold_in(jax.random.fold_in(base_key, c0), i)
+        new_vals, new_act = suggest(key, values, active, losses, valid)
         new_vals = _shard_batch(new_vals, (None, trial_axis))
         new_act = _shard_batch(new_act, (None, trial_axis))
         new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
