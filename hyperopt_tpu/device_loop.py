@@ -99,7 +99,9 @@ def compile_fmin(
         posterior and count toward the startup threshold but not toward
         this run's ``max_evals``.
 
-    The result dict has ``best`` ({label: python value}), ``best_loss``,
+    The result dict has ``best`` ({label: python value}, the same
+    index-form encoding ``fmin`` returns -- ``space_eval(space, best)``
+    resolves it to a concrete config), ``best_loss``,
     ``losses`` [N], ``values`` [D, N], ``active`` [D, N] and, when
     ``return_trials=True``, a rebuilt host ``Trials`` store (one
     device->host copy per array plus list-of-docs assembly).

@@ -332,3 +332,17 @@ def test_device_loop_resume_uses_fresh_stream():
     first = runner(seed=0)
     resumed = runner(seed=0, init=first)
     assert not np.array_equal(first["values"][0], resumed["values"][0, 32:])
+
+
+def test_device_loop_best_is_space_eval_compatible():
+    """The best dict uses the same index-form encoding fmin returns, so
+    space_eval resolves it to a concrete config."""
+    from hyperopt_tpu import space_eval
+
+    out = fmin_on_device(cond_obj, cond_space(), max_evals=48, batch_size=8,
+                         seed=0)
+    cfg = space_eval(cond_space(), out["best"])
+    assert set(cfg) == {"lr", "arch"}
+    arm = cfg["arch"]
+    assert ("depth" in arm) != ("w" in arm)
+    assert arm["k"] in (0, 1)
