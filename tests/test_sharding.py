@@ -1,6 +1,8 @@
 """Sharded-suggest tests on the virtual 8-device CPU mesh (SURVEY.md SS4:
 run the real thing small -- xla_force_host_platform_device_count=8)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -102,3 +104,71 @@ def test_multihost_single_process_degenerates():
     assert multihost.shard_ids_for_host([1, 2, 3, 4], 0, 2) == [1, 3]
     assert multihost.shard_ids_for_host([1, 2, 3, 4], 1, 2) == [2, 4]
     assert multihost.initialize() is False
+
+
+@pytest.mark.slow
+def test_multihost_two_process_broadcast(tmp_path):
+    """The multihost helpers over a REAL two-process jax.distributed
+    runtime (reference pattern: run the real thing small, SURVEY.md SS4):
+    process 0's suggested configs reach process 1 via broadcast, and
+    trial ids round-robin across hosts."""
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker_src = textwrap.dedent("""
+        import os, sys
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        try:  # scrub a pre-latched tunnel plugin (private API; see conftest)
+            from jax._src import xla_bridge as xb
+            xb._backend_factories.pop("axon", None)
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+        pid, port = int(sys.argv[1]), sys.argv[2]
+        from hyperopt_tpu.parallel import multihost
+        multihost.initialize(f"127.0.0.1:{port}", num_processes=2,
+                             process_id=pid)
+        assert multihost.is_multihost()
+        import numpy as np, jax.numpy as jnp
+        if pid == 0:
+            vals = jnp.arange(12.0).reshape(3, 4)
+            act = jnp.ones((3, 4), bool)
+        else:
+            vals, act = jnp.zeros((3, 4)), jnp.zeros((3, 4), bool)
+        v, a = multihost.broadcast_configs(vals, act)
+        assert np.allclose(np.asarray(v), np.arange(12.0).reshape(3, 4))
+        assert np.asarray(a).all()
+        ids = multihost.shard_ids_for_host(list(range(10)))
+        print(f"RESULT pid={pid} ids={ids}", flush=True)
+    """)
+    script = tmp_path / "mh_worker.py"
+    script.write_text(worker_src)
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:  # never orphan a worker holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert "RESULT pid=0 ids=[0, 2, 4, 6, 8]" in outs[0]
+    assert "RESULT pid=1 ids=[1, 3, 5, 7, 9]" in outs[1]
