@@ -254,3 +254,40 @@ def test_tpe_jax_joint_ei_beats_random_on_correlated():
     joint = best_with(partial(tpe_jax.suggest, joint_ei=True))
     random = best_with(rand_jax.suggest)
     assert joint < random, (joint, random)
+
+
+def test_tpe_jax_wide_space_68_labels():
+    """Scaling smoke: a 68-label mixed space (24 uniform, 12 loguniform,
+    8 quantized, 12 flat choices, 4 nested choices) compiles and
+    optimizes end-to-end."""
+    space = {}
+    for i in range(24):
+        space[f"u{i}"] = hp.uniform(f"u{i}", -1, 1)
+    for i in range(12):
+        space[f"l{i}"] = hp.loguniform(f"l{i}", -5, 1)
+    for i in range(8):
+        space[f"q{i}"] = hp.quniform(f"q{i}", 0, 20, 1)
+    for i in range(12):
+        space[f"c{i}"] = hp.choice(f"c{i}", list(range(4)))
+    for i in range(4):
+        space[f"nest{i}"] = hp.choice(f"nest{i}", [
+            {"k": 0, "a": hp.uniform(f"na{i}", 0, 1)},
+            {"k": 1, "b": hp.randint(f"nb{i}", 5)},
+        ])
+
+    def obj(cfg):
+        loss = sum(cfg[f"u{i}"] ** 2 for i in range(24))
+        return loss + sum(abs(cfg[f"c{i}"] - 1) for i in range(12)) * 0.1
+
+    trials = Trials()
+    fmin(obj, space, algo=tpe_jax.suggest, max_evals=50, trials=trials,
+         rstate=np.random.default_rng(0), show_progressbar=False)
+    assert len(trials) == 50
+    assert np.isfinite(min(trials.losses()))
+    # every trial carries exactly one branch per nested choice
+    for t in trials.trials:
+        vals = t["misc"]["vals"]
+        for i in range(4):
+            arm = vals[f"nest{i}"][0]
+            assert (len(vals[f"na{i}"]) == 1) == (arm == 0)
+            assert (len(vals[f"nb{i}"]) == 1) == (arm == 1)
