@@ -147,6 +147,10 @@ def bench_device_loop(n_evals=8192, batch=128):
         runner(seed=1)
         return n_evals / (time.perf_counter() - t0)
     except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_device_loop failed:", file=sys.stderr)
+        traceback.print_exc()
         return None
 
 

@@ -60,11 +60,13 @@ def _raw_score(arch):
     for e1 in range(N_EDGES):
         for e2 in range(e1 + 1, N_EDGES):
             s += _PAIRS[e1, e2, arch[e1], arch[e2]]
-    # deterministic residual (per-arch 'training noise')
-    h = np.uint64(0)
+    # deterministic residual (per-arch 'training noise'); Python ints with
+    # an explicit 64-bit mask give the same wraparound as uint64 without
+    # numpy's overflow RuntimeWarning
+    h = 0
     for op in arch:
-        h = np.uint64(h * np.uint64(1000003) + np.uint64(op + 1))
-    resid = (float(h % np.uint64(10_000)) / 10_000.0 - 0.5) * 0.3
+        h = (h * 1000003 + op + 1) & 0xFFFFFFFFFFFFFFFF
+    resid = (float(h % 10_000) / 10_000.0 - 0.5) * 0.3
     return s + resid
 
 

@@ -22,6 +22,7 @@ import numpy as np
 from jax.scipy.special import ndtr, ndtri
 
 __all__ = [
+    "check_prior_weight",
     "forgetting_weights",
     "parzen_fit",
     "quantize_nat",
@@ -45,19 +46,35 @@ __all__ = [
 ]
 
 
+def check_prior_weight(prior_weight):
+    """Host-level builder guard (call at build time, never under trace):
+    ``_inverse_cdf_onehot`` has no all-zero-weight fallback, so a
+    zero-weight prior with an empty below set would sample from zeroed
+    (mu=sigma=0) component params and silently return constants."""
+    if prior_weight <= 0:
+        raise ValueError(
+            "prior_weight must be > 0: a zero-weight prior degenerates "
+            "the below-model mixture for dims with no observations"
+        )
+
+
 def _below_pad(lf, cap=None, gamma=None):
     """Static buffer width for the compacted below set.
 
-    ``n_below = min(ceil(gamma * sqrt(n_ok)), lf)`` and ``n_ok <= cap``, so
-    ``min(lf, ceil(gamma * sqrt(cap)))`` slots always suffice -- for typical
-    capacities this is far below ``lf`` (cap=512, gamma=.25 -> 6), which
-    shrinks every [S, K_below] sampling/scoring loop.  Rounded up to a
-    multiple of 8 sublanes."""
+    The device computes ``n_below = min(ceil(gamma * sqrt(n_ok)), lf)`` in
+    float32 (:func:`split_below_above`) with ``n_ok <= cap``, so the host
+    bound is ``min(lf, ceil_f64(gamma * sqrt(cap)) + 1)`` -- the +1 absorbs
+    float32-vs-float64 ceil disagreement at exact integer boundaries (the
+    device value can land one above the float64 ceil, and when that ceil is
+    already a multiple of 8 the sublane round-up adds no slack).  For
+    typical capacities this is far below ``lf`` (cap=512, gamma=.25 -> 7),
+    which shrinks every [S, K_below] sampling/scoring loop.  Rounded up to
+    a multiple of 8 sublanes."""
     bound = int(lf)
     if cap is not None and gamma is not None and gamma > 0:
         import math
 
-        bound = min(bound, int(math.ceil(gamma * math.sqrt(float(cap)))))
+        bound = min(bound, int(math.ceil(gamma * math.sqrt(float(cap)))) + 1)
     return max(8, (bound + 7) // 8 * 8)
 
 
@@ -363,6 +380,15 @@ def gmm_logpdf_quant_pre(x, pre, low, high, logspace, q):
     sum (``wmass = w / truncation-mass``) with ONE log at the end -- no
     per-term log, no logsumexp max pass.  A bin with zero mass under every
     component scores ~log(1e-38) instead of -inf (never wins the argmax).
+
+    Known drift vs the log-domain reference math: candidates whose total
+    bin mass underflows float32 (< ~1e-38) all collapse to the same floor
+    score, losing relative ordering in the far tail.  Acceptable for the
+    suggest path because candidates are drawn from the *below* model, so
+    their below-mass is never in the underflow tail and the above-mass
+    floor only saturates the llr in the candidate's favor uniformly; the
+    traced-``q`` parity path (:func:`trunc_gmm_logpdf`) shares this
+    behavior by construction.
     """
     qq = jnp.maximum(q, TINY)
     ub_nat = x + qq / 2.0

@@ -53,6 +53,7 @@ def build_sharded_suggest_fn(
 
     from ..ops import kernels as K
 
+    K.check_prior_weight(prior_weight)
     c = ps._consts
     D = ps.n_dims
     Dc = len(ps.cont_idx)

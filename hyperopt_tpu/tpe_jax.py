@@ -61,11 +61,7 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
 
     from .ops import kernels as K
 
-    if prior_weight <= 0:
-        raise ValueError(
-            "prior_weight must be > 0: a zero-weight prior degenerates "
-            "the below-model mixture for dims with no observations"
-        )
+    K.check_prior_weight(prior_weight)
     c = ps._consts
     D = ps.n_dims
     Dc = len(ps.cont_idx)

@@ -235,3 +235,44 @@ def test_split_matches_numpy_filter():
     n_below = int(np.asarray(below).sum())
     want_below_idx = set(np.argsort(losses, kind="stable")[:n_below])
     assert set(np.nonzero(np.asarray(below))[0]) == want_below_idx
+
+
+def test_below_pad_one_slot_slack():
+    """Regression (ADVICE r1): split_below_above computes
+    ceil(gamma*sqrt(n_ok)) in float32 on device; _below_pad bounds it on
+    the host in float64.  The pad must keep >= 1 slot of slack above the
+    device count wherever the lf cap doesn't apply, so a float32 ceil
+    landing one above the float64 ceil at an exact integer boundary can
+    never overflow the buffer -- including when the float64 bound is a
+    multiple of 8 and the sublane round-up would otherwise add no slack."""
+    import math
+
+    for cap in (64, 256, 512, 1024, 2048, 4096):
+        for gamma in (0.25, 0.2, 0.5):
+            lf = 1000  # never the binding constraint
+            pad = K._below_pad(lf, cap=cap, gamma=gamma)
+            dev_ceil = int(
+                np.ceil(np.float32(gamma) * np.sqrt(np.float32(cap)))
+            )
+            assert pad >= dev_ceil + 1, (cap, gamma, pad, dev_ceil)
+    # the case where the round-up alone adds no slack: bound is exactly a
+    # multiple of 8 (cap=1024, gamma=.25 -> ceil(8.0)=8); without the +1
+    # the pad would be 8 with zero slack
+    assert K._below_pad(1000, cap=1024, gamma=0.25) >= 9
+    # lf-capped regime needs no slack: device mins with the same lf float
+    assert K._below_pad(25, cap=10**6, gamma=0.25) >= 25
+
+
+def test_check_prior_weight_guard():
+    """Regression (ADVICE r1): every suggest builder must reject
+    prior_weight <= 0 at build time."""
+    from hyperopt_tpu import hp, tpe_jax
+    from hyperopt_tpu.ops.compile import compile_space
+    from hyperopt_tpu.parallel.mesh import default_mesh
+    from hyperopt_tpu.parallel.sharded import build_sharded_suggest_fn
+
+    ps = compile_space({"x": hp.uniform("x", 0, 1)})
+    with pytest.raises(ValueError, match="prior_weight must be > 0"):
+        tpe_jax.build_suggest_fn(ps, 16, 0.25, 25.0, 0.0)
+    with pytest.raises(ValueError, match="prior_weight must be > 0"):
+        build_sharded_suggest_fn(ps, default_mesh(), 16, 0.25, 25.0, 0.0)
