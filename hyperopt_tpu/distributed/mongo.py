@@ -9,9 +9,12 @@ imports are gated; :class:`hyperopt_tpu.distributed.FileTrials` provides
 the same role on a shared filesystem without extra dependencies and is the
 recommended backend on TPU pods.
 
-This module is exercised only where pymongo + a mongod are available; its
-protocol-level logic mirrors FileJobQueue (same states, same CAS shape),
-which carries the tested behavior.
+Executed coverage: ``tests/test_mongo_spark.py`` runs this module's real
+protocol code (reserve CAS under thread contention, reaping, GridFS
+domain shipping, full async fmin with worker threads, the CLI loop)
+against an in-memory pymongo/gridfs double implementing exactly the
+client surface used here -- the reference's real-mongod test strategy
+(SURVEY.md SS4) adapted to an image without mongod.
 """
 
 from __future__ import annotations

@@ -6,8 +6,10 @@ its own thread (<= ``parallelism`` in flight), cancels via job groups on
 timeout, and posts results back into the driver-side store under a lock.
 Requires ``pyspark`` (not bundled in the TPU image) -- import-gated; the
 same dispatch control-flow runs dependency-free in
-:class:`hyperopt_tpu.distributed.ThreadTrials`, which carries the tested
-behavior.
+:class:`hyperopt_tpu.distributed.ThreadTrials`.  Executed coverage:
+``tests/test_mongo_spark.py`` drives THIS module end-to-end (dispatcher
+threads, 1-task jobs, timeout cancellation via job groups, error
+writeback) over an in-memory SparkSession double.
 """
 
 from __future__ import annotations

@@ -1,0 +1,342 @@
+"""In-memory doubles for pymongo/gridfs and pyspark.
+
+The reference tests its Mongo backend against a real temporary ``mongod``
+(SURVEY.md SS4); this image has neither mongod nor pymongo, so these
+doubles implement exactly the slice of the client APIs that
+``hyperopt_tpu.distributed.mongo`` / ``spark`` call -- enough to execute
+the real protocol code (CAS reservation via ``find_one_and_update`` with
+sort, ``update_many`` reaping, GridFS attachment put/find_one/delete,
+1-task-job dispatch with job-group cancellation) end to end in-process.
+
+They are test equipment, not features: install via
+:func:`install_fake_mongo` / :func:`install_fake_spark` (monkeypatch
+scoped), which drop module objects into ``sys.modules`` so the gated
+``import pymongo`` / ``import pyspark`` in the backend modules succeed.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import sys
+import threading
+import types
+
+# ---------------------------------------------------------------------------
+# pymongo double
+# ---------------------------------------------------------------------------
+
+
+class InsertOneResult:
+    def __init__(self, inserted_id):
+        self.inserted_id = inserted_id
+
+
+class UpdateResult:
+    def __init__(self, matched_count, modified_count):
+        self.matched_count = matched_count
+        self.modified_count = modified_count
+
+
+class DeleteResult:
+    def __init__(self, deleted_count):
+        self.deleted_count = deleted_count
+
+
+def _get_path(doc, key):
+    """Dotted-path lookup; returns (value, present)."""
+    cur = doc
+    for part in key.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None, False
+        cur = cur[part]
+    return cur, True
+
+
+def _set_path(doc, key, value):
+    parts = key.split(".")
+    cur = doc
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+def _match(doc, query):
+    for k, cond in (query or {}).items():
+        val, present = _get_path(doc, k)
+        if isinstance(cond, dict) and any(
+            isinstance(op, str) and op.startswith("$") for op in cond
+        ):
+            for op, operand in cond.items():
+                if op == "$lt":
+                    if not present or val is None or not (val < operand):
+                        return False
+                elif op == "$gt":
+                    if not present or val is None or not (val > operand):
+                        return False
+                else:
+                    raise NotImplementedError(f"query operator {op}")
+        else:
+            if (val if present else None) != cond:
+                return False
+    return True
+
+
+class Collection:
+    """The jobs-collection surface MongoJobs uses, with CAS atomicity
+    provided by a collection-level lock (mongod's document-level
+    atomicity, conservatively)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._docs = []
+        self._lock = threading.RLock()
+        self._ids = itertools.count(1)
+
+    # -- writes -------------------------------------------------------------
+    def insert_one(self, doc):
+        with self._lock:
+            stored = copy.deepcopy(doc)
+            if "_id" not in stored:
+                stored["_id"] = next(self._ids)
+            doc["_id"] = stored["_id"]  # pymongo mutates the caller's doc
+            self._docs.append(stored)
+            return InsertOneResult(stored["_id"])
+
+    @staticmethod
+    def _apply_update(doc, update):
+        for op, fields in update.items():
+            if op != "$set":
+                raise NotImplementedError(f"update operator {op}")
+            for k, v in fields.items():
+                _set_path(doc, k, copy.deepcopy(v))
+
+    def find_one_and_update(self, filter, update, sort=None,
+                            return_document=False):
+        """The reservation CAS: match+sort+update one doc atomically."""
+        with self._lock:
+            matches = self._sorted(
+                [d for d in self._docs if _match(d, filter)], sort
+            )
+            if not matches:
+                return None
+            target = matches[0]
+            before = copy.deepcopy(target)
+            self._apply_update(target, update)
+            return copy.deepcopy(target) if return_document else before
+
+    def update_one(self, filter, update):
+        with self._lock:
+            for d in self._docs:
+                if _match(d, filter):
+                    self._apply_update(d, update)
+                    return UpdateResult(1, 1)
+            return UpdateResult(0, 0)
+
+    def update_many(self, filter, update):
+        with self._lock:
+            n = 0
+            for d in self._docs:
+                if _match(d, filter):
+                    self._apply_update(d, update)
+                    n += 1
+            return UpdateResult(n, n)
+
+    def delete_many(self, filter):
+        with self._lock:
+            keep = [d for d in self._docs if not _match(d, filter)]
+            n = len(self._docs) - len(keep)
+            self._docs[:] = keep
+            return DeleteResult(n)
+
+    # -- reads --------------------------------------------------------------
+    @staticmethod
+    def _sorted(docs, sort):
+        out = list(docs)
+        for key, direction in reversed(sort or []):
+            out.sort(key=lambda d: _get_path(d, key)[0], reverse=direction < 0)
+        return out
+
+    def find(self, filter=None, sort=None):
+        with self._lock:
+            return [
+                copy.deepcopy(d)
+                for d in self._sorted(
+                    (d for d in self._docs if _match(d, filter)), sort
+                )
+            ]
+
+    def find_one(self, filter=None, sort=None):
+        res = self.find(filter, sort=sort)
+        return res[0] if res else None
+
+
+class Database:
+    def __init__(self, name):
+        self.name = name
+        self._collections = {}
+        self._gridfs = {}  # collection-prefix -> {file_id: (filename, bytes)}
+        self._lock = threading.RLock()
+
+    def __getitem__(self, name):
+        with self._lock:
+            coll = self._collections.get(name)
+            if coll is None:
+                coll = self._collections[name] = Collection(name)
+            return coll
+
+
+class MongoClient:
+    """Same connection string -> same server state (class-level registry),
+    so driver and worker 'connections' share one database like a real
+    mongod."""
+
+    _registry = {}
+    _registry_lock = threading.RLock()
+
+    def __init__(self, conn_str="mongodb://localhost:27017"):
+        with MongoClient._registry_lock:
+            dbs = MongoClient._registry.get(conn_str)
+            if dbs is None:
+                dbs = MongoClient._registry[conn_str] = {}
+            self._dbs = dbs
+
+    def __getitem__(self, dbname):
+        with MongoClient._registry_lock:
+            db = self._dbs.get(dbname)
+            if db is None:
+                db = self._dbs[dbname] = Database(dbname)
+            return db
+
+
+class _GridOut:
+    def __init__(self, file_id, data):
+        self._id = file_id
+        self._data = data
+
+    def read(self):
+        return self._data
+
+
+class GridFS:
+    """put / find_one({'filename': ...}) / delete -- the attachment slice."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, db, collection="fs"):
+        with db._lock:
+            self._files = db._gridfs.setdefault(collection, {})
+        self._lock = db._lock
+
+    def put(self, data, filename=None, **kw):
+        if isinstance(data, str):
+            data = data.encode()
+        with self._lock:
+            file_id = next(GridFS._ids)
+            self._files[file_id] = (filename, bytes(data))
+            return file_id
+
+    def find_one(self, query):
+        filename = query["filename"]
+        with self._lock:
+            for file_id in sorted(self._files, reverse=True):
+                fn, data = self._files[file_id]
+                if fn == filename:
+                    return _GridOut(file_id, data)
+        return None
+
+    def delete(self, file_id):
+        with self._lock:
+            self._files.pop(file_id, None)
+
+
+def install_fake_mongo(monkeypatch):
+    """sys.modules['pymongo'|'gridfs'] -> these doubles; registry reset."""
+    pymongo_mod = types.ModuleType("pymongo")
+    pymongo_mod.MongoClient = MongoClient
+    gridfs_mod = types.ModuleType("gridfs")
+    gridfs_mod.GridFS = GridFS
+    monkeypatch.setitem(sys.modules, "pymongo", pymongo_mod)
+    monkeypatch.setitem(sys.modules, "gridfs", gridfs_mod)
+    MongoClient._registry.clear()
+    return pymongo_mod
+
+
+# ---------------------------------------------------------------------------
+# pyspark double
+# ---------------------------------------------------------------------------
+
+
+class _FakeRDD:
+    def __init__(self, sc, data, group, fn=None):
+        self._sc = sc
+        self._data = data
+        self._group = group
+        self._fn = fn
+
+    def map(self, f):
+        return _FakeRDD(self._sc, self._data, self._group, f)
+
+    def collect(self):
+        def check():
+            if self._group is not None and self._group in self._sc._cancelled:
+                raise RuntimeError(f"job group {self._group} cancelled")
+
+        check()
+        out = []
+        for x in self._data:
+            out.append(self._fn(x) if self._fn else x)
+            # Spark cancels at task boundaries; a group cancelled while the
+            # task ran surfaces as a failed collect
+            check()
+        return out
+
+
+class FakeSparkContext:
+    """Thread-local job groups + cancellable collects, like SparkContext."""
+
+    def __init__(self, default_parallelism=2):
+        self.defaultParallelism = default_parallelism
+        self._local = threading.local()
+        self._cancelled = set()
+        self.cancel_calls = []
+        self.parallelize_calls = 0
+        self._lock = threading.Lock()
+
+    def setJobGroup(self, group, description, interruptOnCancel=False):
+        self._local.group = group
+
+    def cancelJobGroup(self, group):
+        with self._lock:
+            self._cancelled.add(group)
+            self.cancel_calls.append(group)
+
+    def parallelize(self, data, numSlices=None):
+        with self._lock:
+            self.parallelize_calls += 1
+        return _FakeRDD(self, list(data), getattr(self._local, "group", None))
+
+
+class FakeSparkSession:
+    def __init__(self, default_parallelism=2):
+        self.sparkContext = FakeSparkContext(default_parallelism)
+
+
+class _Builder:
+    def getOrCreate(self):
+        return FakeSparkSession()
+
+
+def install_fake_spark(monkeypatch):
+    """sys.modules['pyspark'|'pyspark.sql'] -> doubles; returns the module."""
+    pyspark_mod = types.ModuleType("pyspark")
+    sql_mod = types.ModuleType("pyspark.sql")
+
+    class SparkSession(FakeSparkSession):
+        builder = _Builder()
+
+    sql_mod.SparkSession = SparkSession
+    pyspark_mod.sql = sql_mod
+    monkeypatch.setitem(sys.modules, "pyspark", pyspark_mod)
+    monkeypatch.setitem(sys.modules, "pyspark.sql", sql_mod)
+    return pyspark_mod

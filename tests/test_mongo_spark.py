@@ -1,0 +1,406 @@
+"""Execute the Mongo and Spark backends for real.
+
+The reference's own tests run these protocols against a real temp mongod
+/ local SparkSession (SURVEY.md SS4).  Neither client library exists in
+this image, so ``fake_backends`` provides in-memory doubles of exactly
+the client surface the backends call -- the code under test here is the
+REAL ``hyperopt_tpu.distributed.mongo`` / ``spark`` (CAS reservation,
+reaping, GridFS domain shipping, dispatcher threads, job-group
+cancellation), not the doubles.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fake_backends import install_fake_mongo, install_fake_spark
+
+from hyperopt_tpu import STATUS_OK, fmin, hp, rand, tpe
+from hyperopt_tpu.base import (
+    JOB_STATE_CANCEL,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    JOB_STATE_RUNNING,
+    Domain,
+)
+from hyperopt_tpu.models.synthetic import DOMAINS
+
+
+@pytest.fixture
+def fake_mongo(monkeypatch):
+    return install_fake_mongo(monkeypatch)
+
+
+@pytest.fixture
+def fake_spark(monkeypatch):
+    return install_fake_spark(monkeypatch)
+
+
+def _quad(x):
+    return (x - 3.0) ** 2
+
+
+def _exploding(x):
+    raise RuntimeError("mongo kaboom")
+
+
+# ---------------------------------------------------------------------------
+# MongoJobs protocol level
+# ---------------------------------------------------------------------------
+
+
+def _make_doc(tid, exp_key=None):
+    return {
+        "tid": tid,
+        "state": JOB_STATE_NEW,
+        "spec": None,
+        "result": {"status": "new"},
+        "misc": {"tid": tid, "cmd": None, "idxs": {}, "vals": {}},
+        "exp_key": exp_key,
+        "owner": None,
+        "version": 0,
+        "book_time": None,
+        "refresh_time": None,
+    }
+
+
+def test_reserve_cas_orders_by_tid_and_is_exclusive(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_cas")
+    for tid in (2, 0, 1):
+        jobs.publish(_make_doc(tid))
+    d = jobs.reserve("w1")
+    assert d["tid"] == 0 and d["state"] == JOB_STATE_RUNNING
+    assert d["owner"] == "w1" and d["book_time"] is not None
+    assert jobs.reserve("w2")["tid"] == 1
+    assert jobs.reserve("w3")["tid"] == 2
+    assert jobs.reserve("w4") is None  # drained
+
+
+def test_reserve_contention_each_job_taken_once(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_race")
+    n_jobs = 40
+    for tid in range(n_jobs):
+        jobs.publish(_make_doc(tid))
+
+    taken = []
+    taken_lock = threading.Lock()
+    start = threading.Barrier(8)
+
+    def worker(owner):
+        start.wait()  # all workers hit the queue together
+        while True:
+            doc = jobs.reserve(owner)
+            if doc is None:
+                return
+            with taken_lock:
+                taken.append((doc["tid"], owner))
+            time.sleep(0.001)  # simulate work so reserves interleave
+
+    threads = [
+        threading.Thread(target=worker, args=(f"w{i}",)) for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    tids = [t for t, _ in taken]
+    assert sorted(tids) == list(range(n_jobs))  # every job exactly once
+    assert len({o for _, o in taken}) > 1  # really contended
+
+
+def test_reserve_respects_exp_key(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_key")
+    jobs.publish(_make_doc(0, exp_key="A"))
+    jobs.publish(_make_doc(1, exp_key="B"))
+    d = jobs.reserve("w", exp_key="B")
+    assert d["tid"] == 1
+    assert jobs.reserve("w", exp_key="B") is None  # A's job not taken
+
+
+def test_reap_returns_stale_running_jobs(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_reap")
+    jobs.publish(_make_doc(0))
+    jobs.reserve("w-dead")
+    assert jobs.reap(None) == 0  # disabled -> no-op
+    time.sleep(0.05)
+    assert jobs.reap(0.01) == 1
+    doc = jobs.coll.find_one({"tid": 0})
+    assert doc["state"] == JOB_STATE_NEW and doc["owner"] is None
+    # reservable again after the reap
+    assert jobs.reserve("w-live")["tid"] == 0
+
+
+def test_complete_done_and_error_writeback(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_done")
+    jobs.publish(_make_doc(0))
+    jobs.publish(_make_doc(1))
+    d0 = jobs.reserve("w")
+    jobs.complete(d0, result={"status": STATUS_OK, "loss": 0.5})
+    d1 = jobs.reserve("w")
+    jobs.complete(d1, error=("<class 'RuntimeError'>", "kaboom"))
+    done = jobs.coll.find_one({"tid": 0})
+    assert done["state"] == JOB_STATE_DONE
+    assert done["result"]["loss"] == 0.5
+    err = jobs.coll.find_one({"tid": 1})
+    assert err["state"] == JOB_STATE_ERROR
+    assert err["misc"]["error"][1] == "kaboom"
+
+
+def test_gridfs_attachments_roundtrip_and_replace(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoTrials
+
+    trials = MongoTrials("mongo://localhost:27017/db_att/jobs")
+    assert "blob" not in trials.attachments
+    trials.attachments["blob"] = b"\x00\x01"
+    assert trials.attachments["blob"] == b"\x00\x01"
+    trials.attachments["blob"] = "text-replaces"  # str path + overwrite
+    assert trials.attachments["blob"] == b"text-replaces"
+    with pytest.raises(KeyError):
+        trials.attachments["missing"]
+
+
+# ---------------------------------------------------------------------------
+# MongoTrials + MongoWorker end-to-end fmin
+# ---------------------------------------------------------------------------
+
+
+def _worker_pool(conn, n_workers, stop, exp_key=None):
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker
+
+    threads = []
+    for i in range(n_workers):
+        jobs = MongoJobs.new_from_connection_str(conn)
+        worker = MongoWorker(jobs, exp_key=exp_key)
+
+        def loop(w=worker, owner=f"host{i}:{1000 + i}"):
+            while not stop.is_set():
+                if not w.run_one(owner):
+                    time.sleep(0.01)
+
+        th = threading.Thread(target=loop, daemon=True)
+        th.start()
+        threads.append(th)
+    return threads
+
+
+def test_fmin_through_mongo_trials_with_workers(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoTrials
+
+    conn = "localhost:27017/db_fmin"
+    trials = MongoTrials(f"mongo://{conn}/jobs", exp_key="exp1")
+    stop = threading.Event()
+    workers = _worker_pool(conn, 2, stop)
+    try:
+        best = fmin(
+            _quad,
+            hp.uniform("x", -10, 10),
+            algo=tpe.suggest,
+            max_evals=10,
+            trials=trials,
+            rstate=np.random.default_rng(0),
+            show_progressbar=False,
+            max_queue_len=4,
+        )
+    finally:
+        stop.set()
+        for th in workers:
+            th.join(timeout=10)
+    assert len(trials) == 10
+    assert all(t["state"] == JOB_STATE_DONE for t in trials.trials)
+    assert "x" in best
+    # results were computed by the worker threads (owner stamped by reserve)
+    owners = {t["owner"] for t in trials.trials}
+    assert owners <= {"host0:1000", "host1:1001"} and owners
+
+
+def test_mongo_worker_marks_failed_jobs_error(fake_mongo):
+    import pickle
+
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker, MongoTrials
+
+    conn = "localhost:27017/db_err"
+    trials = MongoTrials(f"mongo://{conn}/jobs")
+    domain = Domain(_exploding, hp.uniform("x", 0, 1))
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    docs = rand.suggest(trials.new_trial_ids(1), domain, trials, seed=0)
+    trials.insert_trial_docs(docs)
+
+    jobs = MongoJobs.new_from_connection_str(conn)
+    assert MongoWorker(jobs).run_one("w:1")
+    trials.refresh()
+    t = trials.trials[0]
+    assert t["state"] == JOB_STATE_ERROR
+    assert "mongo kaboom" in t["misc"]["error"][1]
+
+
+def test_mongo_refresh_reaps_with_reserve_timeout(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoTrials
+
+    conn = "localhost:27017/db_refresh_reap"
+    trials = MongoTrials(f"mongo://{conn}/jobs", reserve_timeout=0.01)
+    jobs = MongoJobs.new_from_connection_str(conn)
+    jobs.publish(_make_doc(0))
+    jobs.reserve("w-dead")
+    time.sleep(0.05)
+    trials.refresh()  # reaps as a side effect
+    assert jobs.coll.find_one({"tid": 0})["state"] == JOB_STATE_NEW
+
+
+def test_mongo_new_trial_ids_unique_across_drivers(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoTrials
+
+    conn = "mongo://localhost:27017/db_ids/jobs"
+    t1 = MongoTrials(conn)
+    t2 = MongoTrials(conn)
+    ids1 = t1.new_trial_ids(3)
+    domain = Domain(_quad, hp.uniform("x", -10, 10))
+    docs = rand.suggest(ids1, domain, t1, seed=0)
+    t1.insert_trial_docs(docs)
+    ids2 = t2.new_trial_ids(3)  # second driver sees the collection floor
+    assert not (set(ids1) & set(ids2))
+
+
+def test_mongo_delete_all_scoped_to_exp_key(fake_mongo):
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoTrials
+
+    conn = "localhost:27017/db_del"
+    jobs = MongoJobs.new_from_connection_str(conn)
+    jobs.publish(_make_doc(0, exp_key="keep"))
+    jobs.publish(_make_doc(1, exp_key="drop"))
+    trials = MongoTrials(f"mongo://{conn}/jobs", exp_key="drop")
+    trials.delete_all()
+    remaining = jobs.coll.find({})
+    assert [d["exp_key"] for d in remaining] == ["keep"]
+
+
+def test_main_worker_cli_runs_max_jobs(fake_mongo):
+    import pickle
+
+    from hyperopt_tpu.distributed.mongo import MongoTrials, main_worker
+
+    conn = "localhost:27017/db_cli"
+    trials = MongoTrials(f"mongo://{conn}/jobs")
+    domain = Domain(_quad, hp.uniform("x", -10, 10))
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    docs = rand.suggest(trials.new_trial_ids(2), domain, trials, seed=0)
+    trials.insert_trial_docs(docs)
+
+    rc = main_worker(["--mongo", conn, "--max-jobs", "2"])
+    assert rc == 0
+    trials.refresh()
+    assert [t["state"] for t in trials.trials] == [JOB_STATE_DONE] * 2
+    assert all(t["result"]["status"] == STATUS_OK for t in trials.trials)
+
+
+# ---------------------------------------------------------------------------
+# SparkTrials
+# ---------------------------------------------------------------------------
+
+
+def test_spark_trials_fmin_end_to_end(fake_spark):
+    from fake_backends import FakeSparkSession
+
+    from hyperopt_tpu.distributed.spark import SparkTrials
+
+    session = FakeSparkSession()
+    trials = SparkTrials(parallelism=2, spark_session=session)
+    best = fmin(
+        _quad,
+        hp.uniform("x", -10, 10),
+        algo=rand.suggest,
+        max_evals=8,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    assert len(trials) == 8
+    assert all(t["state"] == JOB_STATE_DONE for t in trials.trials)
+    assert "x" in best
+    # each trial really ran as its own 1-task job on the fake cluster
+    assert session.sparkContext.parallelize_calls == 8
+    assert all(t["owner"] == "spark" for t in trials.trials)
+
+
+def test_spark_trials_battery_quality(fake_spark):
+    """The reference pattern: algos are tested by running fmin end-to-end
+    on the battery -- here through the Spark dispatch path."""
+    from fake_backends import FakeSparkSession
+
+    from hyperopt_tpu.distributed.spark import SparkTrials
+
+    dom = DOMAINS["quadratic1"]
+    trials = SparkTrials(parallelism=4, spark_session=FakeSparkSession())
+    fmin(
+        dom.fn, dom.make_space(), algo=tpe.suggest, max_evals=50,
+        trials=trials, rstate=np.random.default_rng(1),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert min(trials.losses()) < 1.0
+
+
+def test_spark_trials_timeout_cancels(fake_spark):
+    from fake_backends import FakeSparkSession
+
+    from hyperopt_tpu.distributed.spark import SparkTrials
+
+    def slow(x):
+        time.sleep(0.15)
+        return x
+
+    session = FakeSparkSession()
+    trials = SparkTrials(parallelism=1, timeout=0.5, spark_session=session)
+    fmin(
+        slow, hp.uniform("x", 0, 1), algo=rand.suggest, max_evals=500,
+        trials=trials, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert trials._fmin_cancelled
+    assert trials._fmin_cancelled_reason == "fmin run timeout"
+    assert len(trials) < 500
+    # the inflight job group was cancelled on the fake SparkContext
+    assert session.sparkContext.cancel_calls
+    states = [t["state"] for t in trials.trials]
+    assert JOB_STATE_CANCEL in states or len(states) < 500
+
+
+def test_spark_trials_error_capture(fake_spark):
+    from fake_backends import FakeSparkSession
+
+    from hyperopt_tpu.distributed.spark import SparkTrials
+
+    def flaky(x):
+        if x > 0:
+            raise ValueError("positive!")
+        return x
+
+    trials = SparkTrials(parallelism=2, spark_session=FakeSparkSession())
+    fmin(
+        flaky, hp.uniform("x", -1, 1), algo=rand.suggest, max_evals=10,
+        trials=trials, rstate=np.random.default_rng(3),
+        show_progressbar=False, return_argmin=False,
+    )
+    states = {t["state"] for t in trials.trials}
+    assert JOB_STATE_DONE in states and JOB_STATE_ERROR in states
+    err = next(t for t in trials.trials if t["state"] == JOB_STATE_ERROR)
+    assert "positive!" in err["misc"]["error"][1]
+
+
+def test_spark_trials_default_session_from_builder(fake_spark):
+    from hyperopt_tpu.distributed.spark import SparkTrials
+
+    trials = SparkTrials()  # pyspark.sql.SparkSession.builder.getOrCreate()
+    assert trials.parallelism == 2  # fake defaultParallelism
+    assert trials._supports_cancel
