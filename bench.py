@@ -154,6 +154,64 @@ def bench_device_loop(n_evals=8192, batch=128):
         return None
 
 
+def bench_best_at_1k(n_trials=1000, seed=7):
+    """BASELINE.json's second headline metric: wall-clock to best-loss @
+    1k trials on the 20-dim mixed space -- a realistic suggest->evaluate
+    fmin loop (``algo=tpe_jax.suggest``, per-trial sequential asks, the
+    path a migrating hyperopt user runs first).
+
+    Returns (seconds, best_loss, n_trials).
+    """
+    import numpy as np
+
+    from hyperopt_tpu import fmin
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import JaxTrials
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+
+    trials = JaxTrials()
+    t0 = time.perf_counter()
+    fmin(
+        mixed_space_fn,
+        mixed_space(),
+        algo=tpe_jax.suggest,
+        max_evals=n_trials,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+        return_argmin=False,
+    )
+    dt = time.perf_counter() - t0
+    return dt, float(min(trials.losses())), n_trials
+
+
+def bench_best_at_1k_device_loop(n_trials=1000, n_cand=128, seed=7):
+    """The same 1k-trial experiment as ONE on-device program
+    (``device_loop.compile_fmin``): suggest + evaluate + history append
+    fused under a ``lax.scan``.  Compile time excluded (the program is
+    reusable across seeds); returns (seconds, best_loss, n_actually_run --
+    compile_fmin rounds max_evals up to a batch multiple)."""
+    try:
+        from hyperopt_tpu.device_loop import compile_fmin
+        from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn_jax
+
+        runner = compile_fmin(
+            mixed_space_fn_jax, mixed_space(), max_evals=n_trials,
+            batch_size=32, n_EI_candidates=n_cand,
+        )
+        runner(seed=seed + 1)  # compile
+        t0 = time.perf_counter()
+        out = runner(seed=seed)
+        dt = time.perf_counter() - t0
+        return dt, float(out["best_loss"]), int(out["n_evals"])
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_best_at_1k_device_loop failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None, None, 0
+
+
 def main():
     from hyperopt_tpu.models.synthetic import mixed_space
 
@@ -161,10 +219,14 @@ def main():
 
     # headline batch on an accelerator; CPU-only runs get a size that
     # finishes in minutes (the program is deliberately TPU-sized)
-    default_batch = "4096" if jax.devices()[0].platform != "cpu" else "64"
+    on_accel = jax.devices()[0].platform != "cpu"
+    default_batch = "4096" if on_accel else "64"
     batch = int(os.environ.get("BENCH_BATCH", default_batch))
     n_cand = int(os.environ.get("BENCH_N_CAND", "128"))
     n_obs = int(os.environ.get("BENCH_N_OBS", "500"))
+    n_trials_1k = int(
+        os.environ.get("BENCH_N_TRIALS", "1000" if on_accel else "60")
+    )
 
     space = mixed_space()  # 20-dim mixed continuous/categorical
     domain, trials = build_history(n_obs, space)
@@ -176,6 +238,14 @@ def main():
     jax_rate, _ = bench_jax_tpe(domain, trials, batch=batch, n_cand=n_cand)
     latency_rate = bench_jax_latency(domain, trials, n_cand=n_cand)
     loop_rate = bench_device_loop() if platform != "cpu" else None
+
+    sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
+    if platform != "cpu":
+        dl_sec_1k, dl_best_1k, dl_n = bench_best_at_1k_device_loop(
+            n_trials=n_trials_1k, n_cand=n_cand
+        )
+    else:
+        dl_sec_1k, dl_best_1k, dl_n = None, None, 0
 
     print(
         json.dumps(
@@ -192,6 +262,16 @@ def main():
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),
+                "seconds_to_best_at_1k": round(sec_1k, 2),
+                "best_loss_at_1k": round(best_1k, 5),
+                "n_trials_1k": n_trials_1k,
+                "device_loop_seconds_at_1k": (
+                    round(dl_sec_1k, 3) if dl_sec_1k is not None else None
+                ),
+                "device_loop_best_at_1k": (
+                    round(dl_best_1k, 5) if dl_best_1k is not None else None
+                ),
+                "device_loop_n_trials": dl_n,
                 "batch": batch,
                 "n_EI_candidates": n_cand,
                 "n_obs": n_obs,

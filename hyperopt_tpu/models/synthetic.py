@@ -293,12 +293,31 @@ def mixed_space_fn(cfg):
     objective cost ~0 so suggest dominates)."""
     t = 0.0
     for k, v in cfg.items():
-        if k.startswith("u"):
-            t += (v - 1.0) ** 2 / 50.0
-        elif k.startswith("lu"):
+        if k.startswith("lu"):
             t += (math.log(max(v, 1e-12))) ** 2 / 50.0
+        elif k.startswith("u"):
+            t += (v - 1.0) ** 2 / 50.0
         elif k.startswith("qu"):
             t += abs(v - 10.0) / 100.0
         elif k.startswith("ri") or k.startswith("ch"):
             t += 0.02 * (v % 3)
+    return t
+
+
+def mixed_space_fn_jax(cfg):
+    """``mixed_space_fn`` as jnp math over ``[batch]`` value arrays -- the
+    device-loop twin (``device_loop.compile_fmin`` needs a JAX-traceable
+    objective).  Categorical/randint dims arrive as float indices."""
+    import jax.numpy as jnp
+
+    t = 0.0
+    for k, v in cfg.items():
+        if k.startswith("lu"):
+            t = t + jnp.log(jnp.maximum(v, 1e-12)) ** 2 / 50.0
+        elif k.startswith("u"):
+            t = t + (v - 1.0) ** 2 / 50.0
+        elif k.startswith("qu"):
+            t = t + jnp.abs(v - 10.0) / 100.0
+        elif k.startswith("ri") or k.startswith("ch"):
+            t = t + 0.02 * (jnp.round(v).astype(jnp.int32) % 3)
     return t

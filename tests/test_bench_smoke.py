@@ -15,6 +15,7 @@ def test_bench_prints_one_json_line():
     env["BENCH_BATCH"] = "16"
     env["BENCH_N_CAND"] = "16"
     env["BENCH_N_OBS"] = "60"
+    env["BENCH_N_TRIALS"] = "40"
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=900, env=env,
@@ -29,3 +30,9 @@ def test_bench_prints_one_json_line():
     assert d["metric"] == "tpe_suggestions_per_sec_20dim_mixed"
     assert d["value"] > 0 and d["vs_baseline"] > 0
     assert d["unit"] == "suggestions/s"
+    # the second headline metric (BASELINE.json): wall-clock to best @ 1k
+    assert d["seconds_to_best_at_1k"] > 0
+    assert d["best_loss_at_1k"] >= 0
+    assert d["n_trials_1k"] == 40
+    # device-loop variant is accelerator-only; key must exist either way
+    assert "device_loop_seconds_at_1k" in d

@@ -185,3 +185,25 @@ def test_atpe_jax_end_to_end():
     rand_best = np.median([run(rand.suggest, s) for s in (0, 1, 2)])
     assert atpe_best <= rand_best + 1e-9
     assert atpe_best < 1.0
+
+
+def test_mixed_space_fn_jax_matches_host():
+    """bench.py's device-loop 1k-trial metric evaluates the jnp twin of
+    mixed_space_fn -- the two must agree on real sampled configs."""
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.fmin import space_eval
+    from hyperopt_tpu.models.synthetic import (
+        mixed_space, mixed_space_fn, mixed_space_fn_jax,
+    )
+    from hyperopt_tpu.vectorize import sample_config
+
+    sp = mixed_space()
+    cfgs = [
+        space_eval(sp, sample_config(sp, np.random.default_rng(s)))
+        for s in range(32)
+    ]
+    host = np.array([mixed_space_fn(c) for c in cfgs])
+    batch = {k: jnp.array([float(c[k]) for c in cfgs]) for k in cfgs[0]}
+    dev = np.asarray(mixed_space_fn_jax(batch))
+    assert np.allclose(host, dev, atol=1e-4)
