@@ -100,7 +100,15 @@ def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
 
 
 def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
-    """Single-suggest (B=1) round-trip latency path."""
+    """Single-suggest (B=1) latency path.
+
+    Returns (pipelined_rate, sync_rate): pipelined enqueues all calls
+    then blocks once (device-compute bound; the SAME semantics as round
+    1's single_suggest_per_sec, kept for round-over-round comparison);
+    sync blocks on every call (what a sequential fmin pays per ask --
+    dispatch RTT + compute).  The gap between the two IS the
+    dispatch-vs-compute decomposition.
+    """
     import jax
 
     from hyperopt_tpu import tpe_jax
@@ -118,6 +126,27 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     for i in range(n_calls):
         out = fn(keys[i], *arrays, batch=1)
     jax.block_until_ready(out)
+    pipelined_rate = n_calls / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        out = fn(keys[i], *arrays, batch=1)
+        jax.block_until_ready(out)
+    sync_rate = n_calls / (time.perf_counter() - t0)
+    return pipelined_rate, sync_rate
+
+
+def bench_spec_latency(domain, trials, n_cand=128, k=32, n_calls=64):
+    """Per-ask rate of the speculative path through the REAL suggest API
+    (cache pops + one k-wide dispatch per k asks on a fixed history)."""
+    from functools import partial
+
+    from hyperopt_tpu import tpe_jax
+
+    algo = partial(tpe_jax.suggest, n_EI_candidates=n_cand, speculative=k)
+    algo(trials.new_trial_ids(1), domain, trials, seed=0)  # warm/compile
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        algo(trials.new_trial_ids(1), domain, trials, seed=1 + i)
     return n_calls / (time.perf_counter() - t0)
 
 
@@ -154,14 +183,17 @@ def bench_device_loop(n_evals=8192, batch=128):
         return None
 
 
-def bench_best_at_1k(n_trials=1000, seed=7):
+def bench_best_at_1k(n_trials=1000, seed=7, speculative=0):
     """BASELINE.json's second headline metric: wall-clock to best-loss @
     1k trials on the 20-dim mixed space -- a realistic suggest->evaluate
     fmin loop (``algo=tpe_jax.suggest``, per-trial sequential asks, the
-    path a migrating hyperopt user runs first).
+    path a migrating hyperopt user runs first).  ``speculative=k``
+    measures the same loop with k-ahead speculative dispatch.
 
     Returns (seconds, best_loss, n_trials).
     """
+    from functools import partial
+
     import numpy as np
 
     from hyperopt_tpu import fmin
@@ -169,12 +201,17 @@ def bench_best_at_1k(n_trials=1000, seed=7):
     from hyperopt_tpu.jax_trials import JaxTrials
     from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
 
+    algo = (
+        partial(tpe_jax.suggest, speculative=speculative)
+        if speculative
+        else tpe_jax.suggest
+    )
     trials = JaxTrials()
     t0 = time.perf_counter()
     fmin(
         mixed_space_fn,
         mixed_space(),
-        algo=tpe_jax.suggest,
+        algo=algo,
         max_evals=n_trials,
         trials=trials,
         rstate=np.random.default_rng(seed),
@@ -236,10 +273,16 @@ def main():
 
     platform = jax.devices()[0].platform
     jax_rate, _ = bench_jax_tpe(domain, trials, batch=batch, n_cand=n_cand)
-    latency_rate = bench_jax_latency(domain, trials, n_cand=n_cand)
+    latency_rate, latency_sync_rate = bench_jax_latency(
+        domain, trials, n_cand=n_cand
+    )
+    spec_rate = bench_spec_latency(domain, trials, n_cand=n_cand)
     loop_rate = bench_device_loop() if platform != "cpu" else None
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
+    spec_sec_1k, spec_best_1k, _ = bench_best_at_1k(
+        n_trials=n_trials_1k, speculative=8
+    )
     if platform != "cpu":
         dl_sec_1k, dl_best_1k, dl_n = bench_best_at_1k_device_loop(
             n_trials=n_trials_1k, n_cand=n_cand
@@ -259,11 +302,15 @@ def main():
                     round(native_rate, 1) if native_rate else None
                 ),
                 "single_suggest_per_sec": round(latency_rate, 1),
+                "single_suggest_sync_per_sec": round(latency_sync_rate, 1),
+                "speculative_suggest_per_sec": round(spec_rate, 1),
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),
                 "seconds_to_best_at_1k": round(sec_1k, 2),
                 "best_loss_at_1k": round(best_1k, 5),
+                "seconds_to_best_at_1k_spec8": round(spec_sec_1k, 2),
+                "best_loss_at_1k_spec8": round(spec_best_1k, 5),
                 "n_trials_1k": n_trials_1k,
                 "device_loop_seconds_at_1k": (
                     round(dl_sec_1k, 3) if dl_sec_1k is not None else None

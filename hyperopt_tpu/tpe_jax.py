@@ -208,6 +208,48 @@ def suggest_batch(
     return _cast_vals(ps, idxs, vals)
 
 
+def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
+    """Serve one [D, 1] column from a k-wide speculative draw.
+
+    One device dispatch draws ``k`` suggestion columns; follow-up calls
+    pop cached columns for free until either the cache drains or the
+    posterior has moved by more than ``max_stale`` completed-ok
+    observations since the draw (then a fresh k-wide dispatch).  With
+    ``max_stale = k - 1`` this is exactly the posterior-staleness profile
+    of the reference's ``fmin(max_queue_len=k)`` batching -- the accepted
+    ask-k-ahead trade -- served through the per-trial API.  Staleness is
+    measured in posterior-relevant observations (``ObsBuffer.count``), so
+    failed/NaN trials, which never enter the posterior, do not burn the
+    cache.
+    """
+    buf = obs_buffer_for(domain, trials)  # syncs completed trials
+    cache = getattr(domain, "_tpe_spec_draws", None)
+    if cache is None:
+        cache = {}
+        domain._tpe_spec_draws = cache
+    warm = buf.count >= kw["n_startup_jobs"]
+    entry = cache.get(params)
+    if entry is not None:
+        stale = buf.count - entry["count_at_draw"]
+        if (
+            0 <= stale <= max_stale
+            and entry["warm"] == warm  # startup<->TPE regime flip invalidates
+            and entry["next"] < entry["values"].shape[1]
+        ):
+            i = entry["next"]
+            entry["next"] = i + 1
+            return entry["values"][:, i: i + 1], entry["active"][:, i: i + 1]
+    values, active = suggest_dense(domain, trials, seed, k, **kw)
+    cache[params] = {
+        "count_at_draw": buf.count,
+        "warm": warm,
+        "next": 1,
+        "values": values,
+        "active": active,
+    }
+    return values[:, :1], active[:, :1]
+
+
 def suggest(
     new_ids,
     domain,
@@ -219,15 +261,25 @@ def suggest(
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
+    speculative=0,
+    max_stale=None,
 ):
     """The TPU plugin-boundary entry point: ``algo=tpe_jax.suggest``.
 
     ``partial(tpe_jax.suggest, joint_ei=True)`` switches from the
     reference's factorized per-dimension EI argmax to whole-configuration
     scoring (see :func:`build_suggest_fn`).
+
+    ``partial(tpe_jax.suggest, speculative=k)`` amortizes the per-trial
+    device dispatch for sequential (one-ask-at-a-time) drivers: each
+    dispatch draws ``k`` suggestions and serves the next ``k-1`` asks
+    from cache while the posterior is at most ``max_stale`` (default
+    ``k-1``) observations stale -- the quality profile of the reference's
+    ``max_queue_len=k`` with the latency profile of one dispatch per
+    ``k`` trials.  ``speculative=0`` (default) keeps exact one-dispatch-
+    per-ask parity behavior.
     """
-    idxs, vals = suggest_batch(
-        new_ids, domain, trials, seed,
+    kw = dict(
         prior_weight=prior_weight,
         n_startup_jobs=n_startup_jobs,
         n_EI_candidates=n_EI_candidates,
@@ -235,4 +287,23 @@ def suggest(
         linear_forgetting=linear_forgetting,
         joint_ei=joint_ei,
     )
+    if speculative and len(new_ids) == 1:
+        ps = packed_space_for(domain)
+        if max_stale is None:
+            max_stale = int(speculative) - 1
+        # key includes every regime-determining knob plus the trials-store
+        # identity: one Domain shared across stores or differently-
+        # configured partials must never serve each other's columns
+        params = (
+            int(n_EI_candidates), float(gamma), float(linear_forgetting),
+            float(prior_weight), bool(joint_ei), int(speculative),
+            int(n_startup_jobs), id(trials),
+        )
+        values, active = _speculative_cols(
+            domain, trials, seed, int(speculative), int(max_stale), params, kw
+        )
+        idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
+        idxs, vals = _cast_vals(ps, idxs, vals)
+    else:
+        idxs, vals = suggest_batch(new_ids, domain, trials, seed, **kw)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

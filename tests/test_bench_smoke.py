@@ -33,6 +33,9 @@ def test_bench_prints_one_json_line():
     # the second headline metric (BASELINE.json): wall-clock to best @ 1k
     assert d["seconds_to_best_at_1k"] > 0
     assert d["best_loss_at_1k"] >= 0
+    assert d["seconds_to_best_at_1k_spec8"] > 0
     assert d["n_trials_1k"] == 40
+    assert d["speculative_suggest_per_sec"] > 0
+    assert d["single_suggest_sync_per_sec"] > 0
     # device-loop variant is accelerator-only; key must exist either way
     assert "device_loop_seconds_at_1k" in d

@@ -291,3 +291,99 @@ def test_tpe_jax_wide_space_68_labels():
             arm = vals[f"nest{i}"][0]
             assert (len(vals[f"na{i}"]) == 1) == (arm == 0)
             assert (len(vals[f"nb{i}"]) == 1) == (arm == 1)
+
+
+# ---------------------------------------------------------------------------
+# speculative batching (one dispatch serves k sequential asks)
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_serves_follow_ups_from_cache(monkeypatch):
+    """k-wide speculation: 1 dense draw per k asks while history is
+    unchanged; a new completed observation beyond max_stale invalidates."""
+    from functools import partial
+
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    # seed history past startup so the TPE path runs
+    docs = rand.suggest(trials.new_trial_ids(25), domain, trials, seed=0)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(doc["tid"])}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+
+    calls = []
+    real_dense = tpe_jax.suggest_dense
+
+    def counting_dense(*a, **kw):
+        calls.append(a[3])  # batch arg
+        return real_dense(*a, **kw)
+
+    monkeypatch.setattr(tpe_jax, "suggest_dense", counting_dense)
+    algo = partial(tpe_jax.suggest, speculative=4)
+
+    out_docs = []
+    for i in range(4):
+        out_docs += algo(trials.new_trial_ids(1), domain, trials, seed=100 + i)
+    assert calls == [4]  # ONE dispatch for four asks
+    xs = [d["misc"]["vals"]["x"][0] for d in out_docs]
+    assert len(set(xs)) == 4  # four distinct suggestions, not one repeated
+
+    # fifth ask: cache drained -> fresh dispatch
+    algo(trials.new_trial_ids(1), domain, trials, seed=200)
+    assert calls == [4, 4]
+
+    # unchanged history: even max_stale=0 serves from the warm cache
+    strict = partial(tpe_jax.suggest, speculative=4, max_stale=0)
+    strict(trials.new_trial_ids(1), domain, trials, seed=300)
+    assert calls == [4, 4]
+    # one new completed observation > max_stale=0 -> invalidated, fresh
+    # dispatch even though the cache still holds unserved columns
+    new = rand.suggest(trials.new_trial_ids(1), domain, trials, seed=1)
+    new[0]["state"] = JOB_STATE_DONE
+    new[0]["result"] = {"status": "ok", "loss": 0.5}
+    trials.insert_trial_docs(new)
+    trials.refresh()
+    strict(trials.new_trial_ids(1), domain, trials, seed=301)
+    assert calls == [4, 4, 4]
+
+
+def test_speculative_fmin_quality_and_structure():
+    """End-to-end fmin with speculative asks: same quality profile as
+    max_queue_len batching, valid trial docs, beats random."""
+    from functools import partial
+
+    def run(algo, seed):
+        trials = Trials()
+        fmin(
+            quad, SPACE, algo=algo, max_evals=70, trials=trials,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+        )
+        assert len(trials) == 70
+        for t in trials.trials:
+            assert len(t["misc"]["vals"]["x"]) == 1
+        return trials.best_trial["result"]["loss"]
+
+    spec = partial(tpe_jax.suggest, speculative=8)
+    spec_losses = [run(spec, s) for s in (0, 1)]
+    rand_losses = [run(rand.suggest, s) for s in (0, 1)]
+    assert np.median(spec_losses) <= np.median(rand_losses)
+    assert min(spec_losses) < 0.35
+
+
+def test_speculative_reproducible():
+    from functools import partial
+
+    def run():
+        trials = Trials()
+        fmin(
+            quad, SPACE, algo=partial(tpe_jax.suggest, speculative=4),
+            max_evals=40, trials=trials,
+            rstate=np.random.default_rng(7), show_progressbar=False,
+        )
+        return trials.losses()
+
+    assert run() == run()
