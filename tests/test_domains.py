@@ -1,12 +1,24 @@
 """The domain battery: every algo runs fmin end-to-end on canonical
-synthetic objectives and must hit loose best-loss thresholds (reference
-pattern: tests/test_domains.py CasePerDomain, SURVEY.md SS4)."""
+synthetic objectives against best-loss thresholds (reference pattern:
+tests/test_domains.py CasePerDomain, SURVEY.md SS4).
+
+Statistics: thresholds are asserted on the MEDIAN over 5 fixed seeds
+(deterministic given fixed code; strictly stronger than the old
+best-of-2), and TPE-vs-random is additionally pinned by regression bars
+set INSIDE the measured TPE-advantage gap plus a pooled paired win-rate
+test -- calibration (10 seeds, 2026-07): hartmann6 tpe_med -2.54 /
+rand_med -2.16, many_dists 0.38 / 0.88, surrogate 0.060 / 0.082,
+gauss_wave2 -1.46 / -1.31; paired wins 39/40.  A TPE regression eating
+~half its advantage over random trips the bars; smaller ones flip
+paired wins."""
 
 import numpy as np
 import pytest
 
 from hyperopt_tpu import Trials, anneal, fmin, rand, tpe
 from hyperopt_tpu.models.synthetic import DOMAINS, battery
+
+SEEDS = (0, 1, 2, 3, 4)
 
 
 def run_domain(domain, algo, n_evals, seed=0):
@@ -24,6 +36,12 @@ def run_domain(domain, algo, n_evals, seed=0):
     return trials.best_trial["result"]["loss"]
 
 
+def median5(domain, algo, n_evals):
+    return float(
+        np.median([run_domain(domain, algo, n_evals, seed=s) for s in SEEDS])
+    )
+
+
 # battery subset for per-algo threshold tests (full battery in smoke test)
 THRESHOLD_DOMAINS = ["quadratic1", "q1_choice", "n_arms", "branin", "gauss_wave2"]
 
@@ -32,16 +50,62 @@ THRESHOLD_DOMAINS = ["quadratic1", "q1_choice", "n_arms", "branin", "gauss_wave2
 def test_tpe_hits_thresholds(name):
     domain = DOMAINS[name]
     n_evals, threshold = next(iter(domain.targets.items()))
-    best = min(run_domain(domain, tpe.suggest, n_evals, seed=s) for s in (0, 1))
-    assert best <= threshold, f"tpe on {name}: {best} > {threshold}"
+    med = median5(domain, tpe.suggest, n_evals)
+    assert med <= threshold, f"tpe on {name}: median5 {med} > {threshold}"
 
 
 @pytest.mark.parametrize("name", THRESHOLD_DOMAINS)
 def test_anneal_hits_thresholds(name):
     domain = DOMAINS[name]
     n_evals, threshold = next(iter(domain.targets.items()))
-    best = min(run_domain(domain, anneal.suggest, n_evals, seed=s) for s in (0, 1))
-    assert best <= threshold, f"anneal on {name}: {best} > {threshold}"
+    med = median5(domain, anneal.suggest, n_evals)
+    assert med <= threshold, f"anneal on {name}: median5 {med} > {threshold}"
+
+
+# -- TPE-advantage regression bars ------------------------------------------
+# (config, evals, median5 bar): bars sit between TPE's measured median and
+# random's, ~half the gap in -- any regression that costs TPE half its
+# advantage over random FAILS here, without being flaky at 5 fixed seeds.
+SIGNAL_CONFIGS = [
+    ("hartmann6", 150, -2.35),
+    ("many_dists", 100, 0.55),
+    ("gauss_wave2", 100, -1.40),
+]
+
+
+@pytest.mark.parametrize("name,n_evals,bar", SIGNAL_CONFIGS)
+def test_tpe_advantage_regression_bar(name, n_evals, bar):
+    med = median5(DOMAINS[name], tpe.suggest, n_evals)
+    assert med <= bar, (
+        f"tpe on {name}: median5 {med} > regression bar {bar} "
+        f"(TPE has lost a large fraction of its advantage over random)"
+    )
+
+
+def test_tpe_beats_random_paired_win_rate():
+    """Pooled paired comparison (same seed, same domain): the sensitive
+    statistic -- small TPE regressions flip close pairs long before the
+    median bars trip.  Measured 20/20 at calibration; 15 allows noise."""
+    configs = [("hartmann6", 150), ("many_dists", 100), ("gauss_wave2", 100),
+               ("surrogate", 100)]
+    from hyperopt_tpu.models import surrogate as surrogate_mod
+
+    wins = total = 0
+    for name, n_evals in configs:
+        if name == "surrogate":
+            class _D:  # surrogate is in models/, not DOMAINS
+                fn = staticmethod(surrogate_mod.objective)
+                make_space = staticmethod(surrogate_mod.space)
+            dom = _D()
+        else:
+            dom = DOMAINS[name]
+        for s in SEEDS:
+            t = run_domain(dom, tpe.suggest, n_evals, seed=s)
+            r = run_domain(dom, rand.suggest, n_evals, seed=s)
+            wins += t < r
+            total += 1
+    assert total == 20
+    assert wins >= 15, f"TPE won only {wins}/{total} paired runs vs random"
 
 
 @pytest.mark.parametrize("name", sorted(DOMAINS))
