@@ -25,6 +25,24 @@ terms in ~52-70 ms, while the XLA scorer in :mod:`.kernels`
 production suggest path stays on XLA and this kernel is kept as the
 verified VMEM-streaming alternative (useful as a template for ops XLA
 fuses poorly).
+
+VERDICT (round 2, measured -- the claim is retired): no op in this
+workload has a profile Pallas can win.  Stage decomposition of the
+B=4096 suggest program on chip: Parzen fits 5 ms, categorical sweep
+6 ms, continuous sweep 40 ms, of which the above-model scoring --
+the single hottest op, [4096 x 14 x 128 x 513] fused
+mul/sub/exp/sum/max terms -- runs at ~212 Gterm/s (~1.6+ TFLOP/s
+effective at ~8 VPU ops + exp per term), i.e. VPU-COMPUTE-bound.
+Its HBM traffic is negligible (inputs are [Dc, K] mixture constants
+and [B, S] latents; the term tensor never materializes thanks to XLA
+fusion), so Pallas's levers -- explicit VMEM streaming, layout
+control, HBM pipelining -- have nothing to buy: round 1's kernel
+lost 2x by re-deriving what the fusion already does.  Further
+speedup of this op is algorithmic (e.g. grid-tabulated above-model
+log-density shared across the batch), not kernel-level; see
+DESIGN.md.  This module stays as the working Pallas template +
+regression test for a future op with the right profile (gather-heavy
+or fusion-hostile), none of which this framework currently contains.
 """
 
 from __future__ import annotations
