@@ -53,6 +53,7 @@ def compile_fmin(
     algo="tpe",
     n_startup_jobs=20,
     n_EI_candidates=24,
+    n_EI_candidates_cat=None,
     gamma=0.25,
     prior_weight=1.0,
     linear_forgetting=25,
@@ -129,6 +130,9 @@ def compile_fmin(
     W = int(warm_capacity)
     cap = _round_up(W + N, 128)
     n_cand = int(n_EI_candidates)
+    n_cand_cat = (
+        None if n_EI_candidates_cat is None else int(n_EI_candidates_cat)
+    )
     gamma_f = float(gamma)
     lf_f = float(linear_forgetting)
     pw = float(prior_weight)
@@ -179,7 +183,8 @@ def compile_fmin(
         from .tpe_jax import build_suggest_fn
 
         # the returned fn is jitted; nested jit inlines under the scan trace
-        fn_ = build_suggest_fn(ps, n_cand, gamma_f, lf_f, pw, joint_ei=joint_ei)
+        fn_ = build_suggest_fn(ps, n_cand, gamma_f, lf_f, pw,
+                               joint_ei=joint_ei, n_cand_cat=n_cand_cat)
         return fn_(key, values, active, losses, valid, batch=B)
 
     def _anneal_step(key, values, active, losses, valid):

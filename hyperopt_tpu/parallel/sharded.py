@@ -39,13 +39,21 @@ def _shard_map():
 
 
 def build_sharded_suggest_fn(
-    ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS
+    ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS,
+    n_cand_cat_per_device=None,
 ):
     """Compile the mesh-sharded TPE step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch)`` like
     :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`, with the candidate sweep
     sharded over ``axis`` of ``mesh``.
+
+    ``n_cand_cat_per_device`` (None = follow ``n_cand_per_device``) caps
+    the per-device categorical draw: the union of per-device draws is
+    statistically one (n_per_device x n_devices)-draw sweep, and the
+    categorical EI argmax saturates into pure exploitation once that
+    total covers every option (measured -- BASELINE.md NAS table), so
+    callers keep the TOTAL categorical draw near the reference's 24.
     """
     import jax
     import jax.numpy as jnp
@@ -62,6 +70,11 @@ def build_sharded_suggest_fn(
     gamma = float(gamma)
     lf_f = float(lf)
     pw = float(prior_weight)
+    n_cat = (
+        int(n_cand_per_device)
+        if n_cand_cat_per_device is None
+        else max(1, int(n_cand_cat_per_device))
+    )
     smap = _shard_map()
 
     # Per-shard program: every input replicated; each device draws its own
@@ -83,7 +96,7 @@ def build_sharded_suggest_fn(
             out_scores.append(s)
         if Dk:
             cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            v, s = K.ei_sweep_cat(cat_keys, pb, pa, n_cand_per_device)  # [B, Dk]
+            v, s = K.ei_sweep_cat(cat_keys, pb, pa, n_cat)  # [B, Dk]
             out_vals.append(v)
             out_scores.append(s)
         vals = jnp.concatenate(out_vals, axis=1)  # [B, Dc+Dk]
@@ -125,6 +138,12 @@ def build_sharded_suggest_fn(
 # ---------------------------------------------------------------------------
 
 _default_n_EI_per_device = 64
+# TOTAL categorical draw across the mesh; the union of per-device draws is
+# statistically one (per_device x n_devices)-draw sweep, and the
+# categorical EI argmax saturates into pure exploitation once that total
+# covers every option (measured -- BASELINE.md NAS table), so the default
+# keeps the reference's 24 regardless of mesh size
+_default_n_EI_cat_total = 24
 _default_gamma = 0.25
 _default_n_startup_jobs = 20
 _default_linear_forgetting = 25
@@ -138,13 +157,16 @@ def sharded_suggest(
     seed,
     mesh=None,
     n_EI_per_device=_default_n_EI_per_device,
+    n_EI_cat_total=_default_n_EI_cat_total,
     prior_weight=_default_prior_weight,
     n_startup_jobs=_default_n_startup_jobs,
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
 ):
     """``algo=parallel.sharded_suggest``: TPE with the candidate sweep
-    sharded over every visible device."""
+    sharded over every visible device.  ``n_EI_cat_total`` caps the
+    TOTAL categorical draw (split across devices); None follows
+    ``n_EI_per_device`` on every device."""
     import jax
 
     ps = packed_space_for(domain)
@@ -160,12 +182,17 @@ def sharded_suggest(
             if mesh is None:
                 mesh = default_mesh()
                 domain._tpe_mesh = mesh
+        n_dev = int(mesh.shape[CAND_AXIS])
+        cat_per_dev = (
+            None if n_EI_cat_total is None
+            else max(1, -(-int(n_EI_cat_total) // n_dev))
+        )
         fn = cached_suggest_fn(
             domain, "_sharded_tpe_cache",
             (id(mesh), int(n_EI_per_device), float(gamma),
-             float(linear_forgetting), float(prior_weight)),
-            lambda ps_, _mid, *params: build_sharded_suggest_fn(
-                ps_, mesh, *params
+             float(linear_forgetting), float(prior_weight), cat_per_dev),
+            lambda ps_, _mid, n_pd, g, lf, pw, cpd: build_sharded_suggest_fn(
+                ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
             ),
         )
         values, active = fn(key, *buf.device_arrays(), batch=B)

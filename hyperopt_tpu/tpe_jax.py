@@ -10,11 +10,16 @@ log-likelihood-ratio scoring, factorized argmax, and conditional activity
 requested trials in parallel; there is no per-hyperparameter Python loop
 (contrast SURVEY.md SS3.2's interpreted ``rec_eval`` walk).
 
-Defaults match the parity path except ``n_EI_candidates``: with the
-candidate sweep vectorized on an accelerator, the default rises from the
-reference's 24 to 128 (SURVEY.md SS7 stance #2 -- 'thousands of EI
-candidates per step' are affordable; pass ``n_EI_candidates=24`` for
-reference-exact behavior).
+Defaults match the parity path except the candidate counts, which are
+per-FAMILY (measured, BASELINE.md 24-vs-128 study): continuous dims rise
+from the reference's 24 to ``n_EI_candidates=128`` (the vectorized sweep
+is free on an accelerator and the continuous llr landscape rewards more
+draws -- hartmann6/branin improve), while categorical dims keep
+``n_EI_candidates_cat=24`` (their EI argmax saturates once draws cover
+every option, so large counts are pure argmax exploitation; the
+reference's 24 preserves draw-randomness exploration and wins on every
+categorical-bearing config).  ``n_EI_candidates=24`` alone is therefore
+reference-exact behavior for every dim family.
 """
 
 from __future__ import annotations
@@ -32,18 +37,32 @@ __all__ = ["suggest", "suggest_batch", "suggest_dense", "build_suggest_fn"]
 
 _default_prior_weight = 1.0
 _default_n_EI_candidates = 128
+# categorical dims keep the reference's 24: their EI argmax saturates once
+# draws cover every option, so large counts are pure exploitation while 24
+# preserves draw-randomness exploration (measured -- BASELINE.md NAS table
+# and the 24-vs-128 study rows; continuous dims DO improve at 128)
+_default_n_EI_candidates_cat = 24
 _default_gamma = 0.25
 _default_n_startup_jobs = 20
 _default_linear_forgetting = 25
 
 
-def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
+def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
+                     n_cand_cat=None):
     """Compile the full TPE suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
     (new_values [D, B], new_active [D, B])`` with ``batch`` static.
     Buffer capacity is baked into the trace via the array shapes
     (power-of-2 bucketed by ObsBuffer -> bounded recompiles).
+
+    ``n_cand_cat`` sets a separate candidate count for categorical-family
+    dims (None = same as ``n_cand``).  Rationale (measured, BASELINE.md
+    NAS table): the categorical EI argmax saturates once draws cover all
+    K options, so large counts are pure exploitation there while the
+    reference's 24 preserves draw-randomness exploration; continuous
+    dims, whose llr landscape is continuous, do benefit from more.
+    Ignored under ``joint_ei`` (joint scoring needs one S across dims).
 
     ``joint_ei=False`` (default) keeps the reference's factorized
     posterior: each hyperparameter's EI argmax is taken independently
@@ -55,6 +74,17 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
     the trial takes the argmax configuration column.  Affordable only
     because the accelerator path draws hundreds of candidates per dim
     (SURVEY.md SS7 'hard parts': joint variant behind a flag).
+
+    VERDICT on when to enable joint_ei (measured, round-2 battery, 5
+    seeds -- see BASELINE.md): never for quality.  Candidates are drawn
+    from the same factorized marginals either way and the acquisition is
+    additive, so the factorized per-dim argmax dominates the single-
+    column joint argmax by construction; measured medians agree
+    (corr_sum ~tie; rosenbrock2/gauss_wave2 factorized wins).  The flag
+    stays for its structural property -- the returned configuration is a
+    single coherent draw (one column), which some analyses of
+    conditional spaces want -- not as an optimizer upgrade.  Default
+    OFF, matching reference parity.
     """
     import jax
     import jax.numpy as jnp
@@ -69,6 +99,7 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
     gamma = float(gamma)
     lf_f = float(lf)
     pw = float(prior_weight)
+    n_cat = int(n_cand) if n_cand_cat is None else max(1, int(n_cand_cat))
 
     def fn_factorized(key, values, active, losses, valid, batch):
         fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
@@ -87,7 +118,7 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False):
         if fits["cat"] is not None:
             pb, pa = fits["cat"]
             cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            cat_vals, _ = K.ei_sweep_cat(cat_keys, pb, pa, n_cand)
+            cat_vals, _ = K.ei_sweep_cat(cat_keys, pb, pa, n_cat)
             new_values = new_values.at[c["cat_idx"]].set(
                 cat_vals.T + c["int_low"][:, None]
             )
@@ -155,6 +186,7 @@ def suggest_dense(
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
+    n_EI_candidates_cat=_default_n_EI_candidates_cat,
 ):
     """Dense draws for a batch: (values [D, batch], active [D, batch]) as
     host numpy -- one device program (prior during startup, TPE after).
@@ -169,11 +201,16 @@ def suggest_dense(
     if buf.count < n_startup_jobs:
         values, active = ps.sample_prior(key, batch)
     else:
+        n_cat = (
+            None if n_EI_candidates_cat is None else int(n_EI_candidates_cat)
+        )
         fn = cached_suggest_fn(
             domain, "_tpe_jax_cache",
             (int(n_EI_candidates), float(gamma), float(linear_forgetting),
-             float(prior_weight), bool(joint_ei)),
-            build_suggest_fn,
+             float(prior_weight), bool(joint_ei), n_cat),
+            lambda ps_, nc, g, lf, pw, je, ncc: build_suggest_fn(
+                ps_, nc, g, lf, pw, joint_ei=je, n_cand_cat=ncc
+            ),
         )
         values, active = fn(key, *buf.device_arrays(), batch=batch)
 
@@ -191,6 +228,7 @@ def suggest_batch(
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
+    n_EI_candidates_cat=_default_n_EI_candidates_cat,
 ):
     """Sparse (idxs, vals) for a batch of ids -- one device program for the
     whole batch (B trials x D dims x n_EI_candidates candidates)."""
@@ -203,6 +241,7 @@ def suggest_batch(
         gamma=gamma,
         linear_forgetting=linear_forgetting,
         joint_ei=joint_ei,
+        n_EI_candidates_cat=n_EI_candidates_cat,
     )
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
@@ -261,6 +300,7 @@ def suggest(
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
+    n_EI_candidates_cat=_default_n_EI_candidates_cat,
     speculative=0,
     max_stale=None,
 ):
@@ -278,6 +318,15 @@ def suggest(
     ``max_queue_len=k`` with the latency profile of one dispatch per
     ``k`` trials.  ``speculative=0`` (default) keeps exact one-dispatch-
     per-ask parity behavior.
+
+    Caveat (measured, BASELINE.md): on SMALL pure-categorical spaces the
+    per-dim EI argmax saturates once ``n_EI_candidates`` covers every
+    option, so the k columns of a speculative draw are near-duplicates
+    evaluated k times (NAS-Bench median 8.11 vs 6.28 without).  Use
+    speculative batching on continuous/mixed spaces; on saturated
+    categorical spaces lower ``n_EI_candidates`` toward the reference's
+    24 (draw randomness is the exploration mechanism there) or keep
+    ``speculative=0``.
     """
     kw = dict(
         prior_weight=prior_weight,
@@ -286,6 +335,7 @@ def suggest(
         gamma=gamma,
         linear_forgetting=linear_forgetting,
         joint_ei=joint_ei,
+        n_EI_candidates_cat=n_EI_candidates_cat,
     )
     if speculative and len(new_ids) == 1:
         ps = packed_space_for(domain)
@@ -298,6 +348,7 @@ def suggest(
             int(n_EI_candidates), float(gamma), float(linear_forgetting),
             float(prior_weight), bool(joint_ei), int(speculative),
             int(n_startup_jobs), id(trials),
+            None if n_EI_candidates_cat is None else int(n_EI_candidates_cat),
         )
         values, active = _speculative_cols(
             domain, trials, seed, int(speculative), int(max_stale), params, kw

@@ -189,7 +189,6 @@ def test_sharded_suggest_10k_candidates_nasbench():
     domain = Domain(nasbench.objective, nasbench.space())
     trials = Trials()
     docs = rand.suggest(trials.new_trial_ids(40), domain, trials, seed=0)
-    rng = np.random.default_rng(0)
     for doc in docs:
         doc["state"] = JOB_STATE_DONE
         cfg = {k: v[0] for k, v in doc["misc"]["vals"].items()}

@@ -387,3 +387,49 @@ def test_speculative_reproducible():
         return trials.losses()
 
     assert run() == run()
+
+
+def test_joint_ei_battery_vs_factorized():
+    """The joint_ei verdict (measured, 5 seeds, round 2): whole-config
+    scoring NEVER materially beats factorized EI -- candidates come from
+    the same factorized marginals either way, and the factorized per-dim
+    argmax optimizes the additive acquisition at least as well (medians:
+    corr_sum 0.0017 joint vs 0.0019 fact; rosenbrock2 0.149 vs 0.049
+    fact wins; gauss_wave2 -1.468 vs -1.487 fact wins).  Default stays
+    OFF (reference parity).  This test pins the quality floor of the
+    joint path on two correlated-optimum configs: it must keep beating
+    random and stay within a modest margin of factorized."""
+    from functools import partial
+
+    from hyperopt_tpu.models.synthetic import DOMAINS
+
+    corr_space = {"x": hp.uniform("cx", -5, 5), "y": hp.uniform("cy", -5, 5)}
+
+    def corr_fn(cfg):
+        return (cfg["x"] + cfg["y"] - 1.0) ** 2
+
+    gw = DOMAINS["gauss_wave2"]
+
+    def med(algo, fn, mkspace, n):
+        outs = []
+        for seed in (0, 1, 2):
+            trials = Trials()
+            fmin(fn, mkspace() if callable(mkspace) else mkspace, algo=algo,
+                 max_evals=n, trials=trials,
+                 rstate=np.random.default_rng(seed), show_progressbar=False,
+                 return_argmin=False)
+            outs.append(min(trials.losses()))
+        return float(np.median(outs))
+
+    joint = partial(tpe_jax.suggest, joint_ei=True)
+
+    j = med(joint, corr_fn, lambda: corr_space, 80)
+    f = med(tpe_jax.suggest, corr_fn, lambda: corr_space, 80)
+    r = med(rand.suggest, corr_fn, lambda: corr_space, 80)
+    assert j < r, (j, r)
+    assert j <= max(2.0 * f, f + 0.01), (j, f)
+
+    j2 = med(joint, gw.fn, gw.make_space, 100)
+    f2 = med(tpe_jax.suggest, gw.fn, gw.make_space, 100)
+    assert j2 < -1.35, j2            # far below random's ~-1.27
+    assert j2 <= f2 + 0.08, (j2, f2)
