@@ -48,13 +48,36 @@ def _ok_trials(trials):
     ]
 
 
-class ATPEOptimizer:
-    """Derives per-step TPE settings and a lock set from the history."""
+# the categorical dim family as named by the domain helper's dist field
+CAT_DISTS = ("randint", "categorical", "randint_via_categorical")
 
-    def __init__(self, lock_fraction=0.5, elite_count=8, meta_model=None):
+
+def _pure_categorical(domain):
+    """True when every dim is categorical-family -- the regime where
+    ATPE's heuristics measured neutral-to-harmful (BASELINE.md).  Single
+    shared predicate (packed-space classification) so settings and
+    locking can never disagree about the regime."""
+    ps = packed_space_for(domain)
+    return ps.n_dims > 0 and len(ps.cat_idx) == ps.n_dims
+
+
+class ATPEOptimizer:
+    """Derives per-step TPE settings and a lock set from the history.
+
+    ``base_n_ei`` anchors the adaptive candidate count at the caller's
+    default (24 on the host parity path, 128 on the jitted TPU path) --
+    adaptation may only RAISE it.  Round-2 battery measurement: anchoring
+    at 24 on the TPU path silently weakened the sweep vs plain
+    ``tpe_jax`` (93 < 128 candidates on NAS-Bench) and cost ~1.1 loss
+    median there.
+    """
+
+    def __init__(self, lock_fraction=0.5, elite_count=8, meta_model=None,
+                 base_n_ei=24):
         self.lock_fraction = lock_fraction
         self.elite_count = elite_count
         self.meta_model = meta_model  # optional lightgbm-style scorer
+        self.base_n_ei = int(base_n_ei)
 
     # -- TPE hyperparameter adaptation ------------------------------------
     def tpe_settings(self, domain, trials):
@@ -64,23 +87,42 @@ class ATPEOptimizer:
         ok = _ok_trials(trials)
         n = len(ok)
 
-        # wider spaces need a bigger elite fraction; categorical-heavy
-        # spaces need more candidates to cover the grid
-        gamma = float(np.clip(0.20 + 0.01 * n_dims, 0.15, 0.35))
-        n_ei = int(np.clip(24 * (1 + 2 * frac_cat) * (1 + n_dims / 20), 24, 256))
-        prior_weight = 1.0
+        if _pure_categorical(domain):
+            # Pure-categorical spaces: every heuristic lever measured
+            # neutral-to-harmful there (BASELINE.md ATPE table -- the
+            # saturated categorical argmax means extra candidates are
+            # pure exploitation, a boosted prior flattens the posterior
+            # that IS the exploitation mechanism, and locking emits
+            # duplicates), so the heuristics emit plain TPE settings and
+            # let the posterior work.  A user meta_model still gets the
+            # final say below, as on every other space.
+            gamma, n_ei, prior_weight = 0.25, self.base_n_ei, 1.0
+        else:
+            # wider spaces need a bigger elite fraction.  Candidate
+            # counts adapt per FAMILY: more candidates sharpen
+            # continuous dims (the llr landscape is continuous) but
+            # saturate categorical dims into pure argmax exploitation
+            # once draws cover every option (measured -- BASELINE.md NAS
+            # table), so categorical dims pin the reference's 24 and
+            # only the continuous count scales.
+            gamma = float(np.clip(0.20 + 0.01 * n_dims, 0.15, 0.35))
+            n_ei = int(np.clip(
+                self.base_n_ei * (1 + n_dims / 20),
+                self.base_n_ei, max(256, 2 * self.base_n_ei),
+            ))
+            prior_weight = 1.0
 
-        # improvement trend: stalled experiments get a stronger prior
-        # (more exploration), improving ones sharpen (smaller gamma)
-        if n >= 20:
-            losses = [float(t["result"]["loss"]) for t in ok]
-            best_first = np.minimum.accumulate(losses)
-            recent_gain = best_first[-10] - best_first[-1]
-            scale = abs(best_first[-1]) + 1e-12
-            if recent_gain <= 1e-6 * scale:
-                prior_weight = 1.5
-            else:
-                gamma = max(0.15, gamma - 0.05)
+            # improvement trend: stalled experiments get a stronger
+            # prior (more exploration), improving ones sharpen
+            if n >= 20:
+                losses = [float(t["result"]["loss"]) for t in ok]
+                best_first = np.minimum.accumulate(losses)
+                recent_gain = best_first[-10] - best_first[-1]
+                scale = abs(best_first[-1]) + 1e-12
+                if recent_gain <= 1e-6 * scale:
+                    prior_weight = 1.5
+                else:
+                    gamma = max(0.15, gamma - 0.05)
 
         if self.meta_model is not None:
             try:  # optional learned override (reference-style meta-model)
@@ -94,6 +136,11 @@ class ATPEOptimizer:
             "gamma": gamma,
             "n_EI_candidates": n_ei,
             "prior_weight": prior_weight,
+            # consumed by the jax engine's per-family sweep; the host
+            # parity path reads the other fields explicitly and ignores
+            # this key (its single n_EI applies to every dim, anchored
+            # at the reference's 24)
+            "n_EI_candidates_cat": 24,
         }
 
     # -- parameter locking --------------------------------------------------
@@ -107,15 +154,30 @@ class ATPEOptimizer:
         """The gate-free half of :meth:`locked_values`: which labels have
         converged across the elite set, and to what value.  Invariant for
         a fixed history, so batched suggests compute it once and roll
-        only the per-suggestion gate."""
+        only the per-suggestion gate.
+
+        The lock set is CAPPED at half the space's labels, keeping the
+        most-converged: locking may concentrate search, never collapse it.
+        Round-2 battery measurement: uncapped locking on the small
+        all-categorical NAS-Bench space could freeze every arch edge to
+        the elite mode, emitting duplicate architectures and losing to
+        plain TPE; with the cap at least half the dims keep exploring.
+        """
         ok = _ok_trials(trials)
         if len(ok) < 20:
             return {}
         ok.sort(key=lambda t: float(t["result"]["loss"]))
         elite = ok[: self.elite_count]
 
+        if _pure_categorical(domain):
+            # locking there can only re-emit elite values the
+            # below-posterior already concentrates on, and a mostly-
+            # locked draw is a duplicate configuration burning an
+            # evaluation (measured on NAS-Bench -- BASELINE.md).  The
+            # TPE posterior is the right exploitation mechanism.
+            return {}
         helper = _domain_helper(domain)
-        locked = {}
+        locked = {}  # label -> (convergence score in (0, 1], value)
         for label, info in helper.hps.items():
             vals = [
                 t["misc"]["vals"][label][0]
@@ -124,11 +186,13 @@ class ATPEOptimizer:
             ]
             if len(vals) < max(3, len(elite) // 2):
                 continue
-            if info.dist in ("randint", "categorical", "randint_via_categorical"):
+            if info.dist in CAT_DISTS:
                 uniq, counts = np.unique(np.asarray(vals, dtype=int),
                                          return_counts=True)
-                if counts.max() / counts.sum() >= 0.8:
-                    locked[label] = int(uniq[np.argmax(counts)])
+                share = counts.max() / counts.sum()
+                if share >= 0.8:
+                    score = (share - 0.8) / 0.2
+                    locked[label] = (score, int(uniq[np.argmax(counts)]))
             else:
                 arr = np.asarray(vals, dtype=float)
                 p = info.params
@@ -140,15 +204,22 @@ class ATPEOptimizer:
                 else:
                     width = 2.0 * float(p.get("sigma", 1.0))
                 if width > 0 and arr.std() < 0.05 * width:
-                    locked[label] = float(np.median(arr))
+                    v = float(np.median(arr))
                     if info.dist.startswith("q") and isinstance(
                         p.get("q"), (int, float)
                     ):
                         q = float(p["q"])
-                        locked[label] = float(np.round(locked[label] / q) * q)
+                        v = float(np.round(v / q) * q)
                     if info.dist in ("loguniform", "qloguniform", "lognormal",
                                      "qlognormal"):
-                        locked[label] = float(np.exp(locked[label]))
+                        v = float(np.exp(v))
+                    score = 1.0 - float(arr.std()) / (0.05 * width)
+                    locked[label] = (score, v)
+        max_lock = max(1, len(helper.hps) // 2)
+        if len(locked) > max_lock:
+            keep = sorted(locked, key=lambda k: -locked[k][0])[:max_lock]
+            locked = {k: locked[k] for k in keep}
+        locked = {k: v for k, (_, v) in locked.items()}
         if locked:
             logger.debug("atpe locking %s", sorted(locked))
         return locked

@@ -42,8 +42,11 @@ def suggest(
     opt = getattr(domain, "_atpe_jax_optimizer", None)
     if (opt is None or opt.lock_fraction != lock_fraction
             or opt.elite_count != elite_count):
+        # anchor the adaptive candidate count at the TPU path's default:
+        # adaptation may only raise it
         opt = ATPEOptimizer(lock_fraction=lock_fraction,
-                            elite_count=elite_count)
+                            elite_count=elite_count,
+                            base_n_ei=tpe_jax._default_n_EI_candidates)
         domain._atpe_jax_optimizer = opt
 
     ps = packed_space_for(domain)
