@@ -37,10 +37,11 @@ Its HBM traffic is negligible (inputs are [Dc, K] mixture constants
 and [B, S] latents; the term tensor never materializes thanks to XLA
 fusion), so Pallas's levers -- explicit VMEM streaming, layout
 control, HBM pipelining -- have nothing to buy: round 1's kernel
-lost 2x by re-deriving what the fusion already does.  Further
-speedup of this op is algorithmic (e.g. grid-tabulated above-model
-log-density shared across the batch), not kernel-level; see
-DESIGN.md.  This module stays as the working Pallas template +
+lost 2x by re-deriving what the fusion already does.  The algorithmic
+alternative (grid-tabulated above-model log-density shared across the
+batch) was also built and measured 2x slower -- per-candidate table
+lookups are gathers, which serialize on TPU (DESIGN.md SS3 has both
+tables).  This module stays as the working Pallas template +
 regression test for a future op with the right profile (gather-heavy
 or fusion-hostile), none of which this framework currently contains.
 """
