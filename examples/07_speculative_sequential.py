@@ -1,0 +1,65 @@
+"""Low-latency sequential tuning with speculative batching.
+
+A sequential fmin asks for ONE suggestion, evaluates it, and repeats --
+the reference's default workflow.  On a remote-attached TPU every ask
+pays a synchronous dispatch round-trip (~100 ms over a tunnel; see
+BASELINE.md's dispatch/compute decomposition).  ``speculative=k`` keeps
+the per-trial API but draws k suggestions under one dispatch and serves
+the next k-1 asks from cache while the posterior is at most ``k-1``
+completed observations stale -- the same staleness the reference's
+``fmin(max_queue_len=k)`` accepts, at one dispatch per k trials.
+
+Avoid on small pure-categorical spaces (the saturated EI argmax makes
+the k columns near-duplicates; BASELINE.md has the measurement).
+
+    python examples/07_speculative_sequential.py
+"""
+
+import time
+from functools import partial
+
+import numpy as np
+
+from hyperopt_tpu import Trials, fmin, hp, tpe_jax
+from hyperopt_tpu.jax_trials import JaxTrials
+
+
+def objective(cfg):
+    # continuous/mixed space: the regime speculative batching is for
+    return (
+        (cfg["x"] - 1.0) ** 2 / 10.0
+        + (np.log(cfg["lr"]) + 6.0) ** 2 / 20.0
+        + abs(cfg["width"] - 48) / 100.0
+    )
+
+
+space = {
+    "x": hp.uniform("x", -5.0, 5.0),
+    "lr": hp.loguniform("lr", np.log(1e-5), np.log(1e-1)),
+    "width": hp.quniform("width", 8, 128, 8),
+}
+
+
+def run(algo, label, n=120):
+    trials = JaxTrials()
+    t0 = time.perf_counter()
+    fmin(
+        objective, space, algo=algo, max_evals=n, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        return_argmin=False,
+    )
+    dt = time.perf_counter() - t0
+    print(
+        f"{label:24s} {n} sequential trials in {dt:6.2f}s "
+        f"({n / dt:7.1f} trials/s), best loss {min(trials.losses()):.5f}"
+    )
+
+
+def main():
+    run(tpe_jax.suggest, "plain per-trial asks")
+    run(partial(tpe_jax.suggest, speculative=8), "speculative=8")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

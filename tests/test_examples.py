@@ -52,3 +52,9 @@ def test_example_device_loop():
     out = run_example("03_device_loop.py", timeout=900)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "trials/s" in out.stdout
+
+
+def test_example_speculative_sequential():
+    out = run_example("07_speculative_sequential.py")
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "speculative=8" in out.stdout and "done" in out.stdout

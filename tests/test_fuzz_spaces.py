@@ -26,7 +26,7 @@ from hyperopt_tpu.ops.compile import compile_space
 from hyperopt_tpu.vectorize import dense_to_idxs_vals
 
 
-def make_random_space(rng, max_labels=10, depth=0):
+def make_random_space(rng, max_labels=10):
     """A random space tree touching every constructor family."""
     counter = [0]
 
@@ -34,7 +34,7 @@ def make_random_space(rng, max_labels=10, depth=0):
         counter[0] += 1
         return f"{kind}{counter[0]}"
 
-    def leaf(d):
+    def leaf():
         k = rng.integers(0, 11)
         lbl = fresh("p")
         if k == 0:
@@ -68,7 +68,7 @@ def make_random_space(rng, max_labels=10, depth=0):
             return hp.choice(fresh("c"), [
                 {"which": i, "inner": node(d + 1)} for i in range(n_opts)
             ])
-        return leaf(d)
+        return leaf()
 
     n_top = int(rng.integers(2, max_labels // 2 + 1))
     return {f"top{i}": node(0) for i in range(n_top)}
