@@ -84,6 +84,35 @@ STATUS_OK = "ok"
 STATUS_FAIL = "fail"
 STATUS_STRINGS = (STATUS_NEW, STATUS_RUNNING, STATUS_SUSPENDED, STATUS_OK, STATUS_FAIL)
 
+
+def posterior_state(trial):
+    """Classify a trial doc for posterior ingestion -- THE shared
+    predicate of every observation mirror (host ``tpe._ObsIndex``, device
+    ``jax_trials.ObsBuffer``, and the reference-shaped filters):
+
+      * ``"ok"``      -- completed, status ok, finite loss: ingest.
+      * ``"pending"`` -- may still become ok: NEW/RUNNING state, or a
+        DONE state whose result still reads new/running (an async worker
+        writes ``state`` and ``result`` as two plain stores; a reader in
+        that window must keep waiting, not evict the trial).
+      * ``"dead"``    -- will never produce an observation (ERROR,
+        CANCEL, failed/suspended status, missing or non-finite loss).
+    """
+    state = trial["state"]
+    if state in (JOB_STATE_NEW, JOB_STATE_RUNNING):
+        return "pending"
+    if state == JOB_STATE_DONE:
+        status = trial["result"].get("status")
+        if status == STATUS_OK:
+            loss = trial["result"].get("loss")
+            if loss is not None and np.isfinite(float(loss)):
+                return "ok"
+            return "dead"
+        if status in (STATUS_NEW, STATUS_RUNNING):
+            return "pending"  # mid-write race window
+        return "dead"
+    return "dead"
+
 TRIAL_KEYS = frozenset(
     [
         "tid",

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .base import JOB_STATE_DONE, STATUS_OK, Trials
+from .base import Trials, posterior_state
 from .ops.compile import PackedSpace
 
 __all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY", "GROWTH_FACTOR"]
@@ -47,8 +47,10 @@ class ObsBuffer:
         self.active = np.zeros((D, self.capacity), dtype=bool)
         self.losses = np.zeros(self.capacity, dtype=np.float32)
         self.valid = np.zeros(self.capacity, dtype=bool)
+        self.tids = np.zeros(self.capacity, dtype=np.int64)
         self.count = 0
-        self._n_scanned = 0  # trials-list prefix already ingested
+        self._n_scanned = 0  # trials-list prefix already scanned
+        self._pending = []  # scanned-but-still-pending doc indices
         self._generation = 0  # bumped on every mutation
         self._device_cache = None  # (generation, arrays-on-device)
 
@@ -59,19 +61,35 @@ class ObsBuffer:
             new = np.zeros((old.shape[0], new_cap), dtype=old.dtype)
             new[:, : self.capacity] = old
             setattr(self, name, new)
-        for name in ("losses", "valid"):
+        for name in ("losses", "valid", "tids"):
             old = getattr(self, name)
             new = np.zeros(new_cap, dtype=old.dtype)
             new[: self.capacity] = old
             setattr(self, name, new)
         self.capacity = new_cap
 
-    def add(self, vals_dict, loss):
-        """Append one completed trial: {label: value} + loss."""
+    def add(self, vals_dict, loss, tid=None):
+        """Ingest one completed trial: {label: value} + loss.
+
+        Slots stay TID-ORDERED (forgetting weights are positional --
+        host-path parity): an in-order tid appends; a late completion
+        (async backends) inserts at its tid position with one vectorized
+        shift of the tail, keeping the sync path free of full rebuilds.
+        """
         if self.count == self.capacity:
             self._grow()
-        i = self.count
+        n = self.count
+        if tid is None:
+            tid = self.tids[n - 1] + 1 if n else 0
+        i = int(np.searchsorted(self.tids[:n], tid))
+        if i < n:  # late completion: shift the newer tail right by one
+            self.values[:, i + 1: n + 1] = self.values[:, i:n]
+            self.active[:, i + 1: n + 1] = self.active[:, i:n]
+            self.losses[i + 1: n + 1] = self.losses[i:n]
+            self.tids[i + 1: n + 1] = self.tids[i:n]
         label_pos = self._label_pos
+        self.values[:, i] = 0.0
+        self.active[:, i] = False
         for label, v in vals_dict.items():
             d = label_pos.get(label)
             if d is None:
@@ -79,8 +97,9 @@ class ObsBuffer:
             self.values[d, i] = v
             self.active[d, i] = True
         self.losses[i] = loss
-        self.valid[i] = True
-        self.count += 1
+        self.tids[i] = tid
+        self.valid[n] = True  # occupancy is a prefix mask
+        self.count = n + 1
         self._generation += 1
 
     @property
@@ -91,33 +110,52 @@ class ObsBuffer:
             self._label_pos_cache = pos
         return pos
 
-    def sync(self, trials: Trials):
-        """Ingest trials completed since the last sync (append-only scan).
+    def _add_doc(self, t):
+        vals = {
+            k: v[0] for k, v in t["misc"]["vals"].items() if len(v) == 1
+        }
+        self.add(vals, float(t["result"]["loss"]), tid=int(t["tid"]))
 
-        Returns the number of newly ingested observations.  Robust to the
-        trials list being extended in place (the fmin pattern); a shrunk
-        list (delete_all) triggers a full rebuild.
+    def sync(self, trials: Trials):
+        """Ingest trials completed since the last sync.
+
+        The scan is incremental (a cursor over the trials list) BUT docs
+        scanned while still pending are remembered and revisited: under
+        an async backend a trial is routinely observed in flight and
+        completes later -- dropping it would silently starve the
+        posterior (a real round-2 bug).  Late completions insert at
+        their tid position (``add``), so slot order keeps matching the
+        host path's tid-sorted observation lists without full rebuilds.
+        Classification is the shared :func:`hyperopt_tpu.base.
+        posterior_state` predicate (which also keeps a doc pending
+        through an async worker's state-then-result write window).
+        Returns the number of newly ingested observations; a shrunk
+        list (delete_all) rebuilds from scratch.
         """
         docs = trials.trials
         if len(docs) < self._n_scanned:
             self.__init__(self.space, MIN_CAPACITY)
-        added = 0
-        for t in docs[self._n_scanned:]:
-            if (
-                t["state"] == JOB_STATE_DONE
-                and t["result"].get("status") == STATUS_OK
-                and t["result"].get("loss") is not None
-                and np.isfinite(float(t["result"]["loss"]))
-            ):
-                vals = {
-                    k: v[0]
-                    for k, v in t["misc"]["vals"].items()
-                    if len(v) == 1
-                }
-                self.add(vals, float(t["result"]["loss"]))
-                added += 1
+
+        before = self.count
+        still_pending = []
+        for i in self._pending:
+            t = docs[i]
+            ps = posterior_state(t)
+            if ps == "ok":
+                self._add_doc(t)  # completed after an earlier scan
+            elif ps == "pending":
+                still_pending.append(i)
+        self._pending = still_pending
+
+        for i in range(self._n_scanned, len(docs)):
+            t = docs[i]
+            ps = posterior_state(t)
+            if ps == "ok":
+                self._add_doc(t)
+            elif ps == "pending":
+                self._pending.append(i)
         self._n_scanned = len(docs)
-        return added
+        return self.count - before
 
     def arrays(self):
         """The four dense arrays at current (bucketed) capacity."""
@@ -167,14 +205,25 @@ class JaxTrials(Trials):
 
 def obs_buffer_for(domain, trials) -> ObsBuffer:
     """The shared entry point used by the JAX algos: prefer the JaxTrials
-    resident buffer, else a buffer cached on the domain."""
+    resident buffer, else a buffer cached on the domain.
+
+    The domain-side cache keys on the trials-store identity (weakref): a
+    Domain reused across two stores must never serve one store's
+    observations for the other."""
+    import weakref
+
     space = packed_space_for(domain)
     if isinstance(trials, JaxTrials):
         return trials.obs_buffer(space)
-    buf = getattr(domain, "_obs_buffer", None)
-    if buf is None or buf.space is not space:
+    cached = getattr(domain, "_obs_buffer", None)
+    buf = None
+    if cached is not None:
+        ref, buf_cached = cached
+        if ref() is trials and buf_cached.space is space:
+            buf = buf_cached
+    if buf is None:
         buf = ObsBuffer(space)
-        domain._obs_buffer = buf
+        domain._obs_buffer = (weakref.ref(trials), buf)
     buf.sync(trials)
     return buf
 

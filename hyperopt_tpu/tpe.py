@@ -25,7 +25,7 @@ import logging
 import numpy as np
 from scipy.special import ndtri  # inverse normal CDF
 
-from .base import JOB_STATE_DONE, STATUS_OK
+from .base import JOB_STATE_DONE, STATUS_OK, posterior_state
 from .pyll.base import rec_eval, scope
 from .pyll.stochastic import ensure_rng
 from .rand import docs_from_idxs_vals, _domain_helper
@@ -494,6 +494,131 @@ def _obs_by_label(docs, labels):
     return obs
 
 
+class _ObsIndex:
+    """Incremental columnar mirror of completed-ok trials (host path).
+
+    Profiling showed ~40% of a host suggest in re-extracting per-label
+    observation lists from every trial doc (``ap_filter_trials`` +
+    ``_obs_by_label``); this index scans each doc once and answers the
+    (loss, tid)-sorted below/above split with numpy selections, with
+    EXACTLY the reference semantics (same split, per-side tid order).
+    Docs scanned while pending (the shared
+    :func:`hyperopt_tpu.base.posterior_state` classification) are
+    revisited -- a late completion (the async-backend pattern) is simply
+    appended, since :meth:`split_obs` derives every ordering from
+    (loss, tid) sorts and row order is irrelevant.
+    """
+
+    def __init__(self, labels):
+        self.labels = tuple(labels)
+        self.reset()
+
+    def reset(self):
+        self.n_scanned = 0
+        self.pending = []
+        self.tids = []
+        self.losses = []
+        self.label_pos = {lb: [] for lb in self.labels}
+        self.label_vals = {lb: [] for lb in self.labels}
+        self._frozen = None
+
+    def _add(self, t):
+        pos = len(self.tids)
+        self.tids.append(int(t["tid"]))
+        self.losses.append(float(t["result"]["loss"]))
+        vals = t["misc"]["vals"]
+        for lb in self.labels:
+            v = vals.get(lb, [])
+            if len(v) == 1:
+                self.label_pos[lb].append(pos)
+                self.label_vals[lb].append(v[0])
+
+    def sync(self, trials):
+        docs = trials.trials
+        if len(docs) < self.n_scanned:
+            self.reset()
+        grew = False
+        still = []
+        for i in self.pending:
+            t = docs[i]
+            ps = posterior_state(t)
+            if ps == "ok":
+                # late completion: APPEND is enough -- split_obs derives
+                # every ordering from (loss, tid) sorts, so row order in
+                # the columnar store is irrelevant
+                self._add(t)
+                grew = True
+            elif ps == "pending":
+                still.append(i)
+        self.pending = still
+        for i in range(self.n_scanned, len(docs)):
+            t = docs[i]
+            ps = posterior_state(t)
+            if ps == "ok":
+                self._add(t)
+                grew = True
+            elif ps == "pending":
+                self.pending.append(i)
+        self.n_scanned = len(docs)
+        if grew:
+            self._frozen = None
+        return self
+
+    def arrays(self):
+        if self._frozen is None:
+            self._frozen = (
+                np.asarray(self.tids, dtype=np.int64),
+                np.asarray(self.losses, dtype=np.float64),
+                {
+                    lb: np.asarray(p, dtype=np.int64)
+                    for lb, p in self.label_pos.items()
+                },
+            )
+        return self._frozen
+
+    def split_obs(self, gamma, LF):
+        """(obs_below, obs_above) per label -- reference-exact:
+        (loss, tid)-sorted split, each side's observations in tid order."""
+        tids, losses, label_pos = self.arrays()
+        n_ok = len(tids)
+        n_below = min(int(np.ceil(gamma * np.sqrt(n_ok))), int(LF))
+        order = np.lexsort((tids, losses))  # by loss, ties by tid
+        sides = []
+        for pos in (order[:n_below], order[n_below:]):
+            pos = pos[np.argsort(tids[pos], kind="stable")]  # tid order
+            rank = np.full(n_ok, -1, dtype=np.int64)
+            rank[pos] = np.arange(len(pos), dtype=np.int64)
+            side = {}
+            for lb in self.labels:
+                lp = label_pos[lb]
+                r = rank[lp] if len(lp) else np.empty(0, dtype=np.int64)
+                sel = np.flatnonzero(r >= 0)
+                sel = sel[np.argsort(r[sel], kind="stable")]
+                vals = self.label_vals[lb]
+                side[lb] = [vals[int(j)] for j in sel]
+            sides.append(side)
+        return sides[0], sides[1]
+
+
+def _obs_index_for(domain, trials, labels):
+    """Per-(domain, trials-store) cached index: a Domain reused across
+    two Trials stores must never serve one store's observations for the
+    other (the stateless pre-index host path was immune by construction,
+    so the cache keys on the store's identity via a weakref)."""
+    import weakref
+
+    cache = getattr(domain, "_host_obs_index", None)
+    idx = None
+    if cache is not None:
+        ref, idx_cached = cache
+        if ref() is trials and idx_cached.labels == tuple(labels):
+            idx = idx_cached
+    if idx is None:
+        idx = _ObsIndex(labels)
+        domain._host_obs_index = (weakref.ref(trials), idx)
+    return idx.sync(trials)
+
+
 # ---------------------------------------------------------------------------
 # suggest
 # ---------------------------------------------------------------------------
@@ -506,9 +631,9 @@ def _posterior_draws(domain, trials, rng, prior_weight, n_EI_candidates, gamma, 
     hps = helper.hps
     labels = sorted(hps)
 
-    below, above = ap_filter_trials(trials, gamma, LF)
-    obs_below = _obs_by_label(below, labels)
-    obs_above = _obs_by_label(above, labels)
+    obs_below, obs_above = _obs_index_for(domain, trials, labels).split_obs(
+        gamma, LF
+    )
 
     return {
         label: posterior_draw(

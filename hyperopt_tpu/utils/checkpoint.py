@@ -30,6 +30,7 @@ def save_obs_buffer(buf, path):
             active=buf.active,
             losses=buf.losses,
             valid=buf.valid,
+            tids=buf.tids,
             count=np.int64(buf.count),
             n_scanned=np.int64(buf._n_scanned),
             labels=np.asarray(buf.space.labels, dtype=object),
@@ -54,6 +55,10 @@ def load_obs_buffer(space, path):
         buf.active[:] = data["active"]
         buf.losses[:] = data["losses"]
         buf.valid[:] = data["valid"]
+        if "tids" in data:  # absent in pre-round-2 checkpoints
+            buf.tids[:] = data["tids"]
+        else:
+            buf.tids[: int(data["count"])] = np.arange(int(data["count"]))
         buf.count = int(data["count"])
         buf._n_scanned = int(data["n_scanned"])
     return buf

@@ -248,3 +248,92 @@ def test_tpe_handles_failed_and_nan_trials():
     )
     assert "x" in best
     assert trials.best_trial["result"]["loss"] >= 0
+
+
+def test_obs_index_matches_reference_split_and_handles_late_completions():
+    """The columnar _ObsIndex must reproduce ap_filter_trials +
+    _obs_by_label EXACTLY (same (loss, tid) split, per-side tid order)
+    on randomized stores with mixed states, and must ingest trials that
+    complete after being scanned (the async-backend pattern)."""
+    from hyperopt_tpu import Trials, rand
+    from hyperopt_tpu.base import (
+        Domain,
+        JOB_STATE_DONE,
+        JOB_STATE_ERROR,
+        JOB_STATE_RUNNING,
+    )
+    from hyperopt_tpu.models.synthetic import (
+        _many_dists_fn,
+        _space_many_dists,
+    )
+
+    rng = np.random.default_rng(0)
+    space = _space_many_dists()
+    dom = Domain(_many_dists_fn, space)
+    trials = Trials()
+    docs = rand.suggest(trials.new_trial_ids(80), dom, trials, seed=0)
+    for d in docs:
+        r = rng.uniform()
+        if r < 0.7:
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": float(rng.uniform(0, 10))}
+        elif r < 0.8:
+            d["state"] = JOB_STATE_RUNNING
+        elif r < 0.9:
+            d["state"] = JOB_STATE_ERROR
+        else:
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": float("nan")}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+
+    labels = sorted(tpe._domain_helper(dom).hps)
+    for gamma, LF in ((0.25, 25), (0.15, 10)):
+        below, above = tpe.ap_filter_trials(trials, gamma, LF)
+        ref_b = tpe._obs_by_label(below, labels)
+        ref_a = tpe._obs_by_label(above, labels)
+        new_b, new_a = tpe._obs_index_for(dom, trials, labels).split_obs(
+            gamma, LF
+        )
+        assert ref_b == new_b and ref_a == new_a
+
+    # async pattern: RUNNING trials complete AFTER the index scanned them
+    for d in trials._dynamic_trials:
+        if d["state"] == JOB_STATE_RUNNING:
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": float(rng.uniform(0, 10))}
+    trials.refresh()
+    below, above = tpe.ap_filter_trials(trials, 0.25, 25)
+    ref_b = tpe._obs_by_label(below, labels)
+    new_b, _ = tpe._obs_index_for(dom, trials, labels).split_obs(0.25, 25)
+    assert ref_b == new_b
+
+
+def test_obs_index_keyed_by_trials_store():
+    """Host-path twin of the device-buffer isolation contract: a Domain
+    reused across stores must not mix observations."""
+    from hyperopt_tpu import Trials, hp, rand
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+
+    dom = Domain(lambda x: x, hp.uniform("x", 0, 1))
+
+    def store(n, loss):
+        trials = Trials()
+        docs = rand.suggest(trials.new_trial_ids(n), dom, trials, seed=n)
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        for d in trials._dynamic_trials:
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": loss}
+        trials.refresh()
+        return trials
+
+    a = store(4, 1.0)
+    b = store(6, 2.0)
+    idx_a = tpe._obs_index_for(dom, a, ["x"])
+    assert len(idx_a.losses) == 4
+    idx_b = tpe._obs_index_for(dom, b, ["x"])
+    assert len(idx_b.losses) == 6 and set(idx_b.losses) == {2.0}
+    # switching back re-keys again (fresh index, correct content)
+    idx_a2 = tpe._obs_index_for(dom, a, ["x"])
+    assert len(idx_a2.losses) == 4 and set(idx_a2.losses) == {1.0}

@@ -433,3 +433,145 @@ def test_joint_ei_battery_vs_factorized():
     f2 = med(tpe_jax.suggest, gw.fn, gw.make_space, 100)
     assert j2 < -1.35, j2            # far below random's ~-1.27
     assert j2 <= f2 + 0.08, (j2, f2)
+
+
+# ---------------------------------------------------------------------------
+# async-mode observation ingestion (round-2 bug regression)
+# ---------------------------------------------------------------------------
+
+
+def _insert_new(trials, domain, n, seed):
+    from hyperopt_tpu import rand
+
+    docs = rand.suggest(trials.new_trial_ids(n), domain, trials, seed=seed)
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    # return the STORED docs (insert may copy) so completion mutates
+    # what refresh/sync actually see -- the async-backend pattern
+    tids = {d["tid"] for d in docs}
+    return [t for t in trials._dynamic_trials if t["tid"] in tids]
+
+
+def _complete(trials, docs, loss):
+    from hyperopt_tpu.base import JOB_STATE_DONE
+
+    for d in docs:
+        d["state"] = JOB_STATE_DONE
+        d["result"] = {"status": "ok", "loss": float(loss)}
+    trials.refresh()
+
+
+def test_obs_buffer_ingests_trials_completed_after_scan():
+    """Async backends routinely let a suggest scan see in-flight trials;
+    they must enter the posterior once they complete (the round-2 bug
+    dropped them forever and silently starved async TPE)."""
+    from hyperopt_tpu.base import Domain
+
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    docs = _insert_new(trials, domain, 5, seed=0)
+    buf = obs_buffer_for(domain, trials)  # scanned while NEW
+    assert buf.count == 0
+    _complete(trials, docs, 1.0)
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 5
+
+
+def test_obs_buffer_interleaved_async_completions_keep_tid_order():
+    """Trials completing out of order across syncs: every completion is
+    ingested exactly once and slots stay tid-ordered (the forgetting
+    weights are positional -- host-path parity)."""
+    from hyperopt_tpu.base import Domain, JOB_STATE_ERROR
+
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    batch1 = _insert_new(trials, domain, 3, seed=1)  # tids 0..2
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 0
+
+    batch2 = _insert_new(trials, domain, 3, seed=2)  # tids 3..5
+    _complete(trials, batch2, 2.0)  # NEWER trials complete FIRST
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 3
+
+    _complete(trials, batch1[1:], 1.0)  # older trials complete late
+    batch1[0]["state"] = JOB_STATE_ERROR  # one never produces a loss
+    trials.refresh()
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 5
+    # slots must be tid-ordered: tids 1,2 (loss 1.0) before 3,4,5 (2.0)
+    np.testing.assert_allclose(buf.losses[:5], [1, 1, 2, 2, 2])
+    assert not buf._pending  # error trial dropped from the revisit list
+
+    # further syncs are stable no-ops
+    assert buf.sync(trials) == 0
+    assert buf.count == 5
+
+
+def test_async_thread_trials_tpe_jax_posterior_not_starved():
+    """End-to-end: async evaluation + the jitted TPE path must still
+    feed the posterior (quality sanity: beats the all-prior regime)."""
+    import time as _time
+
+    from hyperopt_tpu.distributed import ThreadTrials
+
+    def slow_quad(x):
+        _time.sleep(0.01)
+        return (x - 3.0) ** 2
+
+    trials = ThreadTrials(parallelism=4)
+    fmin(
+        slow_quad, SPACE, algo=tpe_jax.suggest, max_evals=60,
+        trials=trials, rstate=np.random.default_rng(5),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert len(trials) == 60
+    # with the posterior working, late trials concentrate near x=3
+    xs = [t["misc"]["vals"]["x"][0] for t in trials.trials]
+    late_spread = float(np.median(np.abs(np.array(xs[40:]) - 3.0)))
+    early_spread = float(np.median(np.abs(np.array(xs[:20]) - 3.0)))
+    assert late_spread < early_spread
+    assert min(trials.losses()) < 1.0
+
+
+def test_obs_buffer_waits_out_worker_write_window():
+    """An async worker stores state=DONE then result as two writes; a
+    sync landing between them must keep the trial pending (not evict it
+    as terminal-but-unusable) and ingest it on the next sync."""
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    docs = _insert_new(trials, domain, 2, seed=0)
+    # simulate the torn write: state flipped, result not yet posted
+    docs[0]["state"] = JOB_STATE_DONE  # result still {"status": "new"}
+    trials.refresh()
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 0
+    docs[0]["result"] = {"status": "ok", "loss": 0.5}
+    docs[1]["state"] = JOB_STATE_DONE
+    docs[1]["result"] = {"status": "ok", "loss": 1.5}
+    trials.refresh()
+    buf = obs_buffer_for(domain, trials)
+    assert buf.count == 2
+    np.testing.assert_allclose(buf.losses[:2], [0.5, 1.5])
+
+
+def test_obs_buffer_domain_cache_keyed_by_trials_store():
+    """One Domain reused across two Trials stores must never serve the
+    first store's observations for the second."""
+    from hyperopt_tpu.base import Domain
+
+    domain = Domain(quad, SPACE)
+    trials_a = Trials()
+    docs = _insert_new(trials_a, domain, 4, seed=0)
+    _complete(trials_a, docs, 1.0)
+    buf_a = obs_buffer_for(domain, trials_a)
+    assert buf_a.count == 4
+
+    trials_b = Trials()
+    docs_b = _insert_new(trials_b, domain, 6, seed=1)
+    _complete(trials_b, docs_b, 2.0)
+    buf_b = obs_buffer_for(domain, trials_b)
+    assert buf_b.count == 6
+    np.testing.assert_allclose(buf_b.losses[:6], [2.0] * 6)  # no mixing
