@@ -27,7 +27,7 @@ import logging
 import numpy as np
 
 from . import tpe
-from .base import JOB_STATE_DONE, STATUS_OK
+from .base import posterior_state
 from .jax_trials import packed_space_for
 from .pyll.stochastic import ensure_rng
 from .rand import _domain_helper, docs_from_idxs_vals
@@ -38,14 +38,7 @@ __all__ = ["suggest", "ATPEOptimizer"]
 
 
 def _ok_trials(trials):
-    return [
-        t
-        for t in trials.trials
-        if t["state"] == JOB_STATE_DONE
-        and t["result"].get("status") == STATUS_OK
-        and t["result"].get("loss") is not None
-        and np.isfinite(float(t["result"]["loss"]))
-    ]
+    return [t for t in trials.trials if posterior_state(t) == "ok"]
 
 
 # the categorical dim family as named by the domain helper's dist field

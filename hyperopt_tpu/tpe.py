@@ -462,14 +462,7 @@ def ap_filter_trials(trials, gamma, LF):
 
     ``n_below = min(ceil(gamma * sqrt(n)), LF)`` (SURVEY.md SS3.2).
     """
-    ok = [
-        t
-        for t in trials.trials
-        if t["state"] == JOB_STATE_DONE
-        and t["result"].get("status") == STATUS_OK
-        and t["result"].get("loss") is not None
-        and np.isfinite(float(t["result"]["loss"]))
-    ]
+    ok = [t for t in trials.trials if posterior_state(t) == "ok"]
     ok.sort(key=lambda t: (float(t["result"]["loss"]), t["tid"]))
     n_below = min(int(np.ceil(gamma * np.sqrt(len(ok)))), LF)
     below = ok[:n_below]
