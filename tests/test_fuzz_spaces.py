@@ -190,3 +190,35 @@ def test_fuzzed_space_tpe_jax_end_to_end(seed):
     # TPE-suggested values (the EI sweep path, not just the prior) must
     # satisfy the same routing/bounds/quantization invariants
     check_batch(ps, dense, act)
+
+
+@pytest.mark.parametrize("seed,algo", [(1, "tpe"), (4, "tpe"), (6, "anneal")])
+def test_fuzzed_space_device_loop(seed, algo):
+    """The flagship on-device loop must run fuzzed conditional spaces end
+    to end: jnp objective over dense values + active masks, finite best,
+    history obeying the same structural invariants."""
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.device_loop import compile_fmin
+    from hyperopt_tpu.fmin import space_eval
+
+    rng = np.random.default_rng(seed)
+    space = make_random_space(rng)
+    ps = compile_space(space)
+
+    def obj(cfg, active):
+        t = 0.0
+        for k, v in cfg.items():
+            t = t + jnp.tanh(v) * active[k]
+        return t
+
+    runner = compile_fmin(
+        obj, space, max_evals=96, batch_size=8, algo=algo,
+        n_startup_jobs=16,
+    )
+    out = runner(seed=seed)
+    assert np.isfinite(out["best_loss"])
+    assert out["n_evals"] == 96
+    check_batch(ps, out["values"], out["active"])
+    cfg = space_eval(space, out["best"])  # index-form best resolves
+    assert isinstance(cfg, dict)
