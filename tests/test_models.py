@@ -259,3 +259,52 @@ def test_atpe_pure_categorical_falls_back_to_plain_tpe():
         "n_EI_candidates_cat": 24,
     }
     assert opt.lock_candidates(domain, trials) == {}
+
+
+def test_atpe_meta_model_hook_gets_final_say():
+    """The reference ATPE's pretrained meta-models are exposed here as
+    ATPEOptimizer(meta_model=...); the hook must be consulted on every
+    space -- including pure-categorical ones, where the built-in
+    heuristics fall back to plain TPE settings first."""
+    from hyperopt_tpu import rand
+    from hyperopt_tpu.atpe import ATPEOptimizer
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+    from hyperopt_tpu import hp
+
+    calls = []
+
+    def meta(n_dims, frac_cat, n, gamma, n_ei, prior_weight):
+        calls.append((n_dims, round(frac_cat, 3), n, gamma, n_ei))
+        return 0.19, 77, 1.25
+
+    def seeded_trials(domain, n=25):
+        trials = Trials()
+        docs = rand.suggest(trials.new_trial_ids(n), domain, trials, seed=0)
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        for d in trials._dynamic_trials:
+            d["state"] = JOB_STATE_DONE
+            d["result"] = {"status": "ok", "loss": 1.0}
+        trials.refresh()
+        return trials
+
+    opt = ATPEOptimizer(meta_model=meta, base_n_ei=128)
+
+    # mixed space: heuristics compute, meta overrides
+    dom_mixed = Domain(lambda c: 0.0, {
+        "x": hp.uniform("x", 0, 1), "k": hp.choice("k", [0, 1, 2]),
+    })
+    s = opt.tpe_settings(dom_mixed, seeded_trials(dom_mixed))
+    assert (s["gamma"], s["n_EI_candidates"], s["prior_weight"]) == (
+        0.19, 77, 1.25
+    )
+
+    # pure-categorical space: heuristic fallback, meta STILL consulted
+    dom_cat = Domain(nasbench.objective, nasbench.space())
+    s = opt.tpe_settings(dom_cat, seeded_trials(dom_cat))
+    assert (s["gamma"], s["n_EI_candidates"], s["prior_weight"]) == (
+        0.19, 77, 1.25
+    )
+    assert len(calls) == 2
+    # the heuristic inputs handed to the meta model reflect each space
+    assert calls[0][0] == 2 and calls[1][0] == 6
