@@ -52,7 +52,7 @@ class ObsBuffer:
         self._n_scanned = 0  # trials-list prefix already scanned
         self._pending = []  # scanned-but-still-pending doc indices
         self._generation = 0  # bumped on every mutation
-        self._device_cache = None  # (generation, arrays-on-device)
+        self._device_cache = None  # ((generation, bucket), arrays-on-device)
 
     def _grow(self):
         new_cap = self.capacity * GROWTH_FACTOR
@@ -161,16 +161,36 @@ class ObsBuffer:
         """The four dense arrays at current (bucketed) capacity."""
         return self.values, self.active, self.losses, self.valid
 
+    def _device_bucket(self):
+        """Static width handed to jit: the smallest power-of-2 >= count
+        (floored at MIN_CAPACITY, capped at capacity).
+
+        The suggest program's above-model scoring is proportional to the
+        buffer width it sees; with 4x capacity growth alone, a buffer
+        grown to 8192 for 2,500 observations pays >3x padded compute on
+        EVERY suggest (measured in the round-2 soak: trials/s dropped
+        ~40% after the 2048->8192 growth).  Slicing uploads to a pow2
+        bucket of the live count bounds padding at 2x while keeping
+        retraces logarithmic."""
+        b = MIN_CAPACITY
+        while b < self.count:
+            b <<= 1
+        return min(b, self.capacity)
+
     def device_arrays(self):
-        """The four arrays on the default device, cached by generation:
-        repeated suggest calls against unchanged history transfer nothing
-        (the 'on-device history' contract of the north star)."""
-        if self._device_cache is None or self._device_cache[0] != self._generation:
+        """The four arrays on the default device -- sliced to the pow2
+        bucket of the live count (see :meth:`_device_bucket`) and cached
+        by (generation, bucket): repeated suggest calls against
+        unchanged history transfer nothing (the 'on-device history'
+        contract of the north star)."""
+        b = self._device_bucket()
+        key = (self._generation, b)
+        if self._device_cache is None or self._device_cache[0] != key:
             import jax
 
             self._device_cache = (
-                self._generation,
-                tuple(jax.device_put(a) for a in self.arrays()),
+                key,
+                tuple(jax.device_put(a[..., :b]) for a in self.arrays()),
             )
         return self._device_cache[1]
 

@@ -575,3 +575,26 @@ def test_obs_buffer_domain_cache_keyed_by_trials_store():
     buf_b = obs_buffer_for(domain, trials_b)
     assert buf_b.count == 6
     np.testing.assert_allclose(buf_b.losses[:6], [2.0] * 6)  # no mixing
+
+
+def test_device_arrays_bucket_by_live_count():
+    """device_arrays slices uploads to the pow2 bucket of the live count
+    (padding bounded at 2x) instead of the 4x-grown capacity; the cache
+    keys on (generation, bucket)."""
+    ps = compile_space(SPACE)
+    buf = ObsBuffer(ps, capacity=4)
+    for i in range(300):
+        buf.add({"x": float(i)}, float(i))
+    assert buf.capacity == 1024  # 4 -> 16 -> 64 -> 256 -> 1024
+    arrs = buf.device_arrays()
+    assert arrs[0].shape == (1, 512)  # pow2 bucket of 300, not 1024
+    assert arrs[2].shape == (512,)
+    a0 = arrs[0]
+    assert buf.device_arrays()[0] is a0  # cached while unchanged
+    for i in range(300, 600):
+        buf.add({"x": float(i)}, float(i))
+    arrs = buf.device_arrays()
+    assert arrs[0].shape == (1, 1024)  # crossed the bucket boundary
+    np.testing.assert_allclose(
+        np.asarray(arrs[2])[:600], np.arange(600, dtype=np.float32)
+    )
