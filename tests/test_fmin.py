@@ -260,3 +260,38 @@ def test_max_queue_len_batching():
         rstate=np.random.default_rng(0), show_progressbar=False,
     )
     assert max(seen_batches) == 4
+
+
+def test_scope_wrapped_hp_nodes_in_space():
+    """The reference's ubiquitous idiom: pyll scope ops wrapping hp nodes
+    inside a space (scope.int(hp.quniform(...)), arithmetic on draws).
+    Every suggest path must evaluate the wrapping graph when building
+    the trial's config."""
+    from hyperopt_tpu import rand, tpe, tpe_jax
+    from hyperopt_tpu.pyll import scope
+
+    space = {
+        "n_layers": scope.int(hp.quniform("n_layers", 1, 8, 1)),
+        "lr_x2": hp.uniform("lr", 0.0, 1.0) * 2.0,
+        "plain": hp.uniform("plain", -1, 1),
+    }
+
+    seen_types = []
+
+    def obj(cfg):
+        seen_types.append(type(cfg["n_layers"]))
+        assert 0.0 <= cfg["lr_x2"] <= 2.0
+        return (
+            abs(cfg["n_layers"] - 4) * 0.1
+            + (cfg["lr_x2"] - 1.0) ** 2
+            + cfg["plain"] ** 2
+        )
+
+    for algo in (rand.suggest, tpe.suggest, tpe_jax.suggest):
+        trials = Trials()
+        fmin(obj, space, algo=algo, max_evals=25, trials=trials,
+             rstate=np.random.default_rng(0), show_progressbar=False,
+             return_argmin=False)
+        assert len(trials) == 25
+        assert np.isfinite(min(trials.losses()))
+    assert all(issubclass(t, (int, np.integer)) for t in seen_types)
