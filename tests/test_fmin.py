@@ -295,3 +295,25 @@ def test_scope_wrapped_hp_nodes_in_space():
         assert len(trials) == 25
         assert np.isfinite(min(trials.losses()))
     assert all(issubclass(t, (int, np.integer)) for t in seen_types)
+
+
+def test_container_shaped_spaces():
+    """Reference parity: spaces may be arbitrary pytrees -- lists, tuple
+    options inside hp.choice, bare scalars -- not just dicts."""
+    from hyperopt_tpu import tpe_jax
+
+    space_list = [hp.uniform("a", 0, 1), hp.uniform("b", -1, 0)]
+    trials = Trials()
+    fmin(lambda cfg: cfg[0] ** 2 + cfg[1] ** 2, space_list,
+         algo=tpe_jax.suggest, max_evals=25, trials=trials,
+         rstate=np.random.default_rng(0), show_progressbar=False,
+         return_argmin=False)
+    assert min(trials.losses()) < 0.5
+
+    space_tup = hp.choice("c", [("conv", hp.uniform("k", 0, 1)), ("pool",)])
+    trials = Trials()
+    fmin(lambda cfg: cfg[1] if len(cfg) == 2 else 0.5, space_tup,
+         algo=tpe_jax.suggest, max_evals=25, trials=trials,
+         rstate=np.random.default_rng(1), show_progressbar=False,
+         return_argmin=False)
+    assert min(trials.losses()) < 0.5
