@@ -261,6 +261,8 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
     failed/NaN trials, which never enter the posterior, do not burn the
     cache.
     """
+    import weakref
+
     buf = obs_buffer_for(domain, trials)  # syncs completed trials
     cache = getattr(domain, "_tpe_spec_draws", None)
     if cache is None:
@@ -271,7 +273,8 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
     if entry is not None:
         stale = buf.count - entry["count_at_draw"]
         if (
-            0 <= stale <= max_stale
+            entry["trials_ref"]() is trials  # id() may alias after GC
+            and 0 <= stale <= max_stale
             and entry["warm"] == warm  # startup<->TPE regime flip invalidates
             and entry["next"] < entry["values"].shape[1]
         ):
@@ -280,6 +283,7 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
             return entry["values"][:, i: i + 1], entry["active"][:, i: i + 1]
     values, active = suggest_dense(domain, trials, seed, k, **kw)
     cache[params] = {
+        "trials_ref": weakref.ref(trials),
         "count_at_draw": buf.count,
         "warm": warm,
         "next": 1,
