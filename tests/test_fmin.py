@@ -317,3 +317,36 @@ def test_container_shaped_spaces():
          rstate=np.random.default_rng(1), show_progressbar=False,
          return_argmin=False)
     assert min(trials.losses()) < 0.5
+
+
+def test_mix_suggest_end_to_end():
+    """SURVEY SS2 algo mixer: probabilistic mixture over suggest fns at
+    the plugin seam (reference hyperopt/mix.py shape)."""
+    from functools import partial
+
+    from hyperopt_tpu import mix, rand, tpe
+
+    calls = {"tpe": 0, "rand": 0}
+
+    def counting(name, inner):
+        def algo(new_ids, domain, trials, seed):
+            calls[name] += 1
+            return inner(new_ids, domain, trials, seed)
+        return algo
+
+    algo = partial(mix.suggest, p_suggest=[
+        (0.7, counting("tpe", tpe.suggest)),
+        (0.3, counting("rand", rand.suggest)),
+    ])
+    trials = Trials()
+    fmin(lambda x: (x - 3.0) ** 2, hp.uniform("x", -10, 10), algo=algo,
+         max_evals=40, trials=trials, rstate=np.random.default_rng(0),
+         show_progressbar=False, return_argmin=False)
+    assert len(trials) == 40
+    assert calls["tpe"] + calls["rand"] == 40
+    assert calls["tpe"] > calls["rand"] > 0  # both arms exercised, 70/30
+    assert min(trials.losses()) < 1.0
+
+    with pytest.raises(ValueError):
+        mix.suggest([0], None, trials, 0,
+                    p_suggest=[(0.5, rand.suggest), (0.2, tpe.suggest)])
