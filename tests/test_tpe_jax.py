@@ -598,3 +598,29 @@ def test_device_arrays_bucket_by_live_count():
     np.testing.assert_allclose(
         np.asarray(arrs[2])[:600], np.arange(600, dtype=np.float32)
     )
+
+
+def test_async_plus_speculative_combination():
+    """The production mode for remote-attached chips: async evaluation
+    (ThreadTrials) with speculative k-ahead suggests. Must complete,
+    ingest every observation, and still optimize."""
+    import time as _time
+    from functools import partial
+
+    from hyperopt_tpu.distributed import ThreadTrials
+
+    def slow_quad(x):
+        _time.sleep(0.005)
+        return (x - 3.0) ** 2
+
+    trials = ThreadTrials(parallelism=3)
+    fmin(
+        slow_quad, SPACE, algo=partial(tpe_jax.suggest, speculative=4),
+        max_evals=50, trials=trials, rstate=np.random.default_rng(9),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert len(trials) == 50
+    from hyperopt_tpu.base import JOB_STATE_DONE
+
+    assert sum(t["state"] == JOB_STATE_DONE for t in trials.trials) == 50
+    assert min(trials.losses()) < 2.0
