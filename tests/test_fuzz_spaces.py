@@ -222,3 +222,38 @@ def test_fuzzed_space_device_loop(seed, algo):
     check_batch(ps, out["values"], out["active"])
     cfg = space_eval(space, out["best"])  # index-form best resolves
     assert isinstance(cfg, dict)
+
+
+EXTREME_SPACES = {
+    "tiny_range": lambda: {"x": hp.uniform("x", 0.0, 1e-8)},
+    "huge_range": lambda: {"x": hp.uniform("x", -1e12, 1e12)},
+    "wide_log": lambda: {"x": hp.loguniform("x", -30.0, 30.0)},
+    "big_normal": lambda: {"x": hp.normal("x", 0.0, 1e9)},
+    "tiny_q": lambda: {"x": hp.quniform("x", 0.0, 1e-4, 1e-6)},
+    "huge_q": lambda: {"x": hp.quniform("x", 0.0, 1e12, 1e9)},
+}
+
+
+@pytest.mark.parametrize("name", sorted(EXTREME_SPACES))
+def test_extreme_bounds_stay_finite(name):
+    """f32 numerics at parameter extremes: both TPE paths must keep every
+    draw finite and inside the declared range (truncation masses, bin
+    masses, and the inverse-CDF sampler all stress-underflow here)."""
+    from hyperopt_tpu import tpe
+
+    space = EXTREME_SPACES[name]()
+    lo_hi = {
+        "tiny_range": (0.0, 1e-8), "huge_range": (-1e12, 1e12),
+        "wide_log": (0.0, np.exp(30.0) * 1.001), "big_normal": (-np.inf, np.inf),
+        "tiny_q": (-5e-7, 1e-4 + 5e-7), "huge_q": (-5e8, 1e12 + 5e8),
+    }[name]
+    for algo in (tpe.suggest, tpe_jax.suggest):
+        trials = Trials()
+        fmin(lambda cfg: float(np.tanh(cfg["x"] * 1e-6)), space, algo=algo,
+             max_evals=30, trials=trials, rstate=np.random.default_rng(0),
+             show_progressbar=False, return_argmin=False)
+        xs = np.array(
+            [t["misc"]["vals"]["x"][0] for t in trials.trials], dtype=float
+        )
+        assert np.isfinite(xs).all()
+        assert xs.min() >= lo_hi[0] and xs.max() <= lo_hi[1]
