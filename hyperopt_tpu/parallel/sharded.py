@@ -162,44 +162,66 @@ def sharded_suggest(
     n_startup_jobs=_default_n_startup_jobs,
     gamma=_default_gamma,
     linear_forgetting=_default_linear_forgetting,
+    speculative=0,
+    max_stale=None,
 ):
     """``algo=parallel.sharded_suggest``: TPE with the candidate sweep
     sharded over every visible device.  ``n_EI_cat_total`` caps the
     TOTAL categorical draw (split across devices); None follows
-    ``n_EI_per_device`` on every device."""
+    ``n_EI_per_device`` on every device.  ``speculative=k`` serves k
+    sequential asks from one mesh-wide dispatch (same cache semantics
+    as :func:`hyperopt_tpu.tpe_jax.suggest`)."""
     import jax
 
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     B = len(new_ids)
-    key = host_key(int(seed) % (2**31 - 1))
 
-    if buf.count < n_startup_jobs:
-        values, active = ps.sample_prior(key, B)
-    else:
+    if mesh is None:
+        mesh = getattr(domain, "_tpe_mesh", None)
         if mesh is None:
-            mesh = getattr(domain, "_tpe_mesh", None)
-            if mesh is None:
-                mesh = default_mesh()
-                domain._tpe_mesh = mesh
-        n_dev = int(mesh.shape[CAND_AXIS])
-        cat_per_dev = (
-            None if n_EI_cat_total is None
-            else max(1, -(-int(n_EI_cat_total) // n_dev))
+            mesh = default_mesh()
+            domain._tpe_mesh = mesh
+    n_dev = int(mesh.shape[CAND_AXIS])
+    cat_per_dev = (
+        None if n_EI_cat_total is None
+        else max(1, -(-int(n_EI_cat_total) // n_dev))
+    )
+
+    def draw(seed_, batch):
+        key = host_key(int(seed_) % (2**31 - 1))
+        if buf.count < n_startup_jobs:
+            out = ps.sample_prior(key, batch)
+        else:
+            fn = cached_suggest_fn(
+                domain, "_sharded_tpe_cache",
+                (id(mesh), int(n_EI_per_device), float(gamma),
+                 float(linear_forgetting), float(prior_weight), cat_per_dev),
+                lambda ps_, _mid, n_pd, g, lf, pw, cpd:
+                    build_sharded_suggest_fn(
+                        ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
+                    ),
+            )
+            out = fn(key, *buf.device_arrays(), batch=batch)
+        return jax.device_get(out)
+
+    if speculative and B == 1:
+        from ..tpe_jax import _speculative_cols
+
+        params = (
+            "sharded", id(mesh), int(n_EI_per_device), cat_per_dev,
+            float(gamma), float(linear_forgetting), float(prior_weight),
+            int(n_startup_jobs), id(trials), int(speculative),
         )
-        fn = cached_suggest_fn(
-            domain, "_sharded_tpe_cache",
-            (id(mesh), int(n_EI_per_device), float(gamma),
-             float(linear_forgetting), float(prior_weight), cat_per_dev),
-            lambda ps_, _mid, n_pd, g, lf, pw, cpd: build_sharded_suggest_fn(
-                ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
-            ),
+        values, active = _speculative_cols(
+            domain, trials, seed, int(speculative), max_stale, params,
+            n_startup_jobs, draw,
         )
-        values, active = fn(key, *buf.device_arrays(), batch=B)
+    else:
+        values, active = draw(seed, B)
 
     from ..tpe_jax import _cast_vals
 
-    values, active = jax.device_get((values, active))
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     idxs, vals = _cast_vals(ps, idxs, vals)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

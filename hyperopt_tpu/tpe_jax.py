@@ -247,28 +247,33 @@ def suggest_batch(
     return _cast_vals(ps, idxs, vals)
 
 
-def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
+def _speculative_cols(domain, trials, seed, k, max_stale, params,
+                      n_startup_jobs, draw_fn):
     """Serve one [D, 1] column from a k-wide speculative draw.
 
-    One device dispatch draws ``k`` suggestion columns; follow-up calls
-    pop cached columns for free until either the cache drains or the
-    posterior has moved by more than ``max_stale`` completed-ok
-    observations since the draw (then a fresh k-wide dispatch).  With
-    ``max_stale = k - 1`` this is exactly the posterior-staleness profile
-    of the reference's ``fmin(max_queue_len=k)`` batching -- the accepted
-    ask-k-ahead trade -- served through the per-trial API.  Staleness is
-    measured in posterior-relevant observations (``ObsBuffer.count``), so
-    failed/NaN trials, which never enter the posterior, do not burn the
-    cache.
+    One device dispatch (``draw_fn(seed, k) -> (values, active)`` host
+    numpy) draws ``k`` suggestion columns; follow-up calls pop cached
+    columns for free until either the cache drains or the posterior has
+    moved by more than ``max_stale`` completed-ok observations since the
+    draw (then a fresh k-wide dispatch).  With ``max_stale = k - 1``
+    this is exactly the posterior-staleness profile of the reference's
+    ``fmin(max_queue_len=k)`` batching -- the accepted ask-k-ahead trade
+    -- served through the per-trial API.  Staleness is measured in
+    posterior-relevant observations (``ObsBuffer.count``), so failed/NaN
+    trials, which never enter the posterior, do not burn the cache.
+    Shared by :func:`suggest` and the mesh-sharded
+    :func:`hyperopt_tpu.parallel.sharded.sharded_suggest`.
     """
     import weakref
 
+    if max_stale is None:
+        max_stale = int(k) - 1
     buf = obs_buffer_for(domain, trials)  # syncs completed trials
+    warm = buf.count >= n_startup_jobs  # regime decided HERE, once
     cache = getattr(domain, "_tpe_spec_draws", None)
     if cache is None:
         cache = {}
         domain._tpe_spec_draws = cache
-    warm = buf.count >= kw["n_startup_jobs"]
     entry = cache.get(params)
     if entry is not None:
         stale = buf.count - entry["count_at_draw"]
@@ -281,7 +286,7 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params, kw):
             i = entry["next"]
             entry["next"] = i + 1
             return entry["values"][:, i: i + 1], entry["active"][:, i: i + 1]
-    values, active = suggest_dense(domain, trials, seed, k, **kw)
+    values, active = draw_fn(seed, k)
     cache[params] = {
         "trials_ref": weakref.ref(trials),
         "count_at_draw": buf.count,
@@ -343,8 +348,6 @@ def suggest(
     )
     if speculative and len(new_ids) == 1:
         ps = packed_space_for(domain)
-        if max_stale is None:
-            max_stale = int(speculative) - 1
         # key includes every regime-determining knob plus the trials-store
         # identity: one Domain shared across stores or differently-
         # configured partials must never serve each other's columns
@@ -355,7 +358,9 @@ def suggest(
             None if n_EI_candidates_cat is None else int(n_EI_candidates_cat),
         )
         values, active = _speculative_cols(
-            domain, trials, seed, int(speculative), int(max_stale), params, kw
+            domain, trials, seed, int(speculative), max_stale, params,
+            n_startup_jobs,
+            lambda s, k: suggest_dense(domain, trials, s, k, **kw),
         )
         idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
         idxs, vals = _cast_vals(ps, idxs, vals)

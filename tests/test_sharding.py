@@ -217,3 +217,25 @@ def test_sharded_suggest_10k_candidates_nasbench():
     assert ((vals >= 0) & (vals < len(nasbench.OPS))).all()
     # non-degenerate: across 16 trials x 6 edges, more than one op drawn
     assert len(np.unique(vals)) > 1
+
+
+def test_sharded_suggest_speculative():
+    """speculative=k on the sharded path: one mesh-wide dispatch serves
+    k sequential asks (same cache/staleness semantics as tpe_jax)."""
+    from functools import partial
+
+    from hyperopt_tpu.parallel import sharded_suggest
+
+    trials = Trials()
+    best = fmin(
+        lambda x: (x - 3.0) ** 2,
+        hp.uniform("x", -10, 10),
+        algo=partial(sharded_suggest, speculative=4),
+        max_evals=45,
+        trials=trials,
+        rstate=np.random.default_rng(0),
+        show_progressbar=False,
+    )
+    assert len(trials) == 45
+    assert trials.best_trial["result"]["loss"] < 2.5
+    assert "x" in best
