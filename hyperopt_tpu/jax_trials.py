@@ -51,6 +51,7 @@ class ObsBuffer:
         self.count = 0
         self._n_scanned = 0  # trials-list prefix already scanned
         self._pending = []  # scanned-but-still-pending doc indices
+        self._legacy_tids = False  # loaded from a checkpoint without tids
         self._generation = 0  # bumped on every mutation
         self._device_cache = None  # ((generation, bucket), arrays-on-device)
 
@@ -133,7 +134,12 @@ class ObsBuffer:
         list (delete_all) rebuilds from scratch.
         """
         docs = trials.trials
-        if len(docs) < self._n_scanned:
+        if len(docs) < self._n_scanned or getattr(
+            self, "_legacy_tids", False
+        ):
+            # shrunk list (delete_all) OR a legacy checkpoint whose tids
+            # were synthesized as arange (only valid for contiguous-tid
+            # runs): rebuild from the doc list, the source of truth
             self.__init__(self.space, MIN_CAPACITY)
 
         before = self.count

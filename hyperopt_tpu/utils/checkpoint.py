@@ -33,6 +33,7 @@ def save_obs_buffer(buf, path):
             tids=buf.tids,
             count=np.int64(buf.count),
             n_scanned=np.int64(buf._n_scanned),
+            pending=np.asarray(buf._pending, dtype=np.int64),
             labels=np.asarray(buf.space.labels, dtype=object),
         )
     os.replace(tmp, path)
@@ -58,9 +59,20 @@ def load_obs_buffer(space, path):
         if "tids" in data:  # absent in pre-round-2 checkpoints
             buf.tids[:] = data["tids"]
         else:
+            # legacy checkpoint: synthesized contiguous tids are only an
+            # approximation (failed/NaN trials interleave tids in real
+            # runs) -- mark the buffer so its first sync() against a
+            # trials store rebuilds from the doc list (source of truth)
+            # instead of trusting this guess for late-completion inserts
             buf.tids[: int(data["count"])] = np.arange(int(data["count"]))
+            buf._legacy_tids = True
         buf.count = int(data["count"])
         buf._n_scanned = int(data["n_scanned"])
+        # docs scanned while in flight must survive resume, else the
+        # checkpoint path reintroduces async posterior starvation
+        buf._pending = (
+            [int(i) for i in data["pending"]] if "pending" in data else []
+        )
     return buf
 
 
