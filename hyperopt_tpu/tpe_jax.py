@@ -247,6 +247,37 @@ def suggest_batch(
     return _cast_vals(ps, idxs, vals)
 
 
+def _saturated_categorical(ps, n_cat_total):
+    """True when the k columns of a speculative draw would be near-
+    duplicates: every dim is categorical-family AND the candidate draw
+    covers every option (n >= k_max), so the per-dim EI argmax is
+    deterministic given one posterior (measured -- BASELINE.md NAS
+    speculative row: median 8.11 vs 6.28 without).  Machine-detectable
+    at build time; callers auto-degrade to ``speculative=0`` with a
+    warning instead of relying on users reading docstrings."""
+    return len(ps.cont_idx) == 0 and int(n_cat_total) >= int(ps.k_max)
+
+
+def _warn_saturated(domain, k):
+    import warnings
+
+    if getattr(domain, "_spec_saturation_warned", False):
+        return
+    domain._spec_saturation_warned = True
+    warnings.warn(
+        f"speculative={k} disabled: every dimension of this space is "
+        "categorical and the candidate draw covers every option, so the "
+        "EI argmax is deterministic and the k speculative columns would "
+        "be near-duplicate suggestions evaluated k times (measured "
+        "quality loss -- see BASELINE.md NAS speculative row). Falling "
+        "back to one dispatch per ask; to keep speculation here, lower "
+        "the categorical candidate count below the largest option count "
+        "(draw randomness is the exploration mechanism on saturated "
+        "categorical spaces).",
+        stacklevel=3,
+    )
+
+
 def _speculative_cols(domain, trials, seed, k, max_stale, params,
                       n_startup_jobs, draw_fn):
     """Serve one [D, 1] column from a k-wide speculative draw.
@@ -328,14 +359,16 @@ def suggest(
     ``k`` trials.  ``speculative=0`` (default) keeps exact one-dispatch-
     per-ask parity behavior.
 
-    Caveat (measured, BASELINE.md): on SMALL pure-categorical spaces the
-    per-dim EI argmax saturates once ``n_EI_candidates`` covers every
+    Guard (measured, BASELINE.md): on SMALL pure-categorical spaces the
+    per-dim EI argmax saturates once the candidate draw covers every
     option, so the k columns of a speculative draw are near-duplicates
-    evaluated k times (NAS-Bench median 8.11 vs 6.28 without).  Use
-    speculative batching on continuous/mixed spaces; on saturated
-    categorical spaces lower ``n_EI_candidates`` toward the reference's
-    24 (draw randomness is the exploration mechanism there) or keep
-    ``speculative=0``.
+    evaluated k times (NAS-Bench median 8.11 vs 6.28 without).  The
+    regime is detected at build time (every dim categorical-family and
+    the categorical candidate count >= the largest option count) and
+    speculation AUTO-DEGRADES to one dispatch per ask with a one-time
+    warning -- the trap cannot be hit silently.  To keep speculation on
+    such a space, lower the categorical candidate count below the
+    option count (draw randomness is the exploration mechanism there).
     """
     kw = dict(
         prior_weight=prior_weight,
@@ -348,6 +381,17 @@ def suggest(
     )
     if speculative and len(new_ids) == 1:
         ps = packed_space_for(domain)
+        n_cat_eff = (
+            n_EI_candidates
+            if n_EI_candidates_cat is None
+            else n_EI_candidates_cat
+        )
+        if _saturated_categorical(ps, n_cat_eff):
+            _warn_saturated(domain, speculative)
+            return docs_from_idxs_vals(
+                new_ids, domain, trials,
+                *suggest_batch(new_ids, domain, trials, seed, **kw),
+            )
         # key includes every regime-determining knob plus the trials-store
         # identity: one Domain shared across stores or differently-
         # configured partials must never serve each other's columns
@@ -356,6 +400,9 @@ def suggest(
             float(prior_weight), bool(joint_ei), int(speculative),
             int(n_startup_jobs), id(trials),
             None if n_EI_candidates_cat is None else int(n_EI_candidates_cat),
+            # the RESOLVED staleness budget: partials differing only in
+            # max_stale must not pop each other's cached columns
+            int(speculative) - 1 if max_stale is None else int(max_stale),
         )
         values, active = _speculative_cols(
             domain, trials, seed, int(speculative), max_stale, params,
