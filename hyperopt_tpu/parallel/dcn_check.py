@@ -1,0 +1,193 @@
+"""Two-process DCN execution check for the sharded suggest program.
+
+The multihost story (SURVEY.md SS5 'distributed communication backend')
+promises that :func:`hyperopt_tpu.parallel.sharded.sharded_suggest` spans
+hosts: all processes join one ``jax.distributed`` runtime, the candidate
+sweep shards over every device of every host, and the EI argmax-allgather
+rides DCN between processes.  This module EXECUTES that path the way the
+reference tests multi-node -- by running the real thing small: launched as
+one worker per process (``python -m hyperopt_tpu.parallel.dcn_check <pid>
+<port>``), each worker forces ``--n-local`` virtual CPU devices, joins a
+2-process runtime (2 x n-local global devices), runs the REAL
+``sharded_suggest`` API over the global mesh on an identical seeded
+history, and process 0 checks the winner distribution against the
+single-process unsharded path at equal total candidate count
+(two-sample KS per dim).
+
+Used by ``__graft_entry__.dryrun_multichip`` (stage 5) and
+``tests/test_sharding.py`` -- both spawn the two workers and assert on
+the ``DCN RESULT`` line this prints.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def _force_local_cpu_devices(n_local):
+    """CPU platform + n_local virtual devices, before backend init.
+
+    Any inherited ``xla_force_host_platform_device_count`` is replaced
+    (the parent may run under a different virtual-device count), and a
+    pre-latched TPU-tunnel plugin is scrubbed (see tests/conftest.py).
+    """
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={int(n_local)}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:  # pragma: no cover - environment dependent
+        from jax._src import xla_bridge as xb
+
+        xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _seeded_history(n_obs=40, seed=0):
+    """Identical completed-trial history on every process."""
+    import numpy as np
+
+    from ..base import Domain, JOB_STATE_DONE, Trials
+    from .. import hp, rand
+
+    space = {
+        "x": hp.uniform("x", -5.0, 5.0),
+        "y": hp.loguniform("y", float(np.log(1e-3)), float(np.log(10.0))),
+    }
+
+    def fn(cfg):
+        return (cfg["x"] - 1.0) ** 2 + (np.log(cfg["y"]) + 1.0) ** 2
+
+    domain = Domain(fn, space)
+    trials = Trials()
+    docs = rand.suggest(trials.new_trial_ids(n_obs), domain, trials, seed=seed)
+    for d in docs:
+        cfg = {k: v[0] for k, v in d["misc"]["vals"].items()}
+        d["state"] = JOB_STATE_DONE
+        d["result"] = {"status": "ok", "loss": float(fn(cfg))}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return domain, trials
+
+
+def _ks_distance(a, b):
+    import numpy as np
+
+    grid = np.sort(np.concatenate([a, b]))
+
+    def ecdf(x):
+        return np.searchsorted(np.sort(x), grid, side="right") / len(x)
+
+    return float(np.abs(ecdf(a) - ecdf(b)).max())
+
+
+def launch(n_local=4, timeout=300):
+    """Spawn the two workers and return process-0's output.
+
+    Raises ``RuntimeError`` (with both workers' tails) if either exits
+    nonzero.  The coordinator port is bound-then-released on loopback.
+    """
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "hyperopt_tpu.parallel.dcn_check",
+             str(pid), str(port), str(n_local)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=timeout)[0] for p in procs]
+    finally:  # never orphan a worker holding the coordinator port
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    if any(p.returncode != 0 for p in procs):
+        raise RuntimeError(
+            "dcn_check worker failed:\n"
+            + "\n---\n".join(out[-2000:] for out in outs)
+        )
+    return outs[0]
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    pid, port = int(argv[0]), argv[1]
+    n_local = int(argv[2]) if len(argv) > 2 else 4
+    _force_local_cpu_devices(n_local)
+
+    import numpy as np
+    import jax
+
+    from . import multihost
+    from .mesh import CAND_AXIS, default_mesh
+    from .sharded import sharded_suggest
+
+    multihost.initialize(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+    assert multihost.is_multihost(), "expected a 2-process runtime"
+    n_global = len(jax.devices())
+    assert n_global == 2 * n_local, (n_global, n_local)
+    mesh = default_mesh()  # 1-D cand mesh over BOTH processes' devices
+
+    domain, trials = _seeded_history()
+    B = 256
+    n_per_dev = 32
+    docs = sharded_suggest(
+        trials.new_trial_ids(B), domain, trials, seed=5,
+        mesh=mesh, n_EI_per_device=n_per_dev,
+    )
+    assert len(docs) == B
+    sh_vals = {
+        lab: np.array([d["misc"]["vals"][lab][0] for d in docs])
+        for lab in ("x", "y")
+    }
+
+    if pid == 0:
+        # agreement vs the single-process path at equal TOTAL candidates
+        # (local single-device jit -- no collectives, runs on pid 0 only)
+        from ..tpe_jax import suggest_batch
+
+        _, un_vals = suggest_batch(
+            trials.new_trial_ids(B), domain, trials, seed=6,
+            n_EI_candidates=n_per_dev * n_global,
+            n_EI_candidates_cat=None,
+        )
+        ks = {
+            lab: round(_ks_distance(sh_vals[lab], np.asarray(un_vals[lab])), 4)
+            for lab in ("x", "y")
+        }
+        # KS critical value at alpha=0.001 for n=m=256 is ~0.172; 0.2
+        # allows f32 jitter while failing any real divergence (wrong
+        # slab gather, biased per-device folds, broken DCN allgather)
+        for lab, v in ks.items():
+            assert v < 0.2, (lab, v)
+        print(
+            f"DCN RESULT procs=2 devices={n_global} "
+            f"mesh={{{CAND_AXIS}: {int(mesh.shape[CAND_AXIS])}}} ks={ks}",
+            flush=True,
+        )
+    else:
+        print(f"DCN RESULT pid=1 ok n={B}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -133,6 +133,29 @@ def build_sharded_suggest_fn(
     return jax.jit(fn, static_argnames=("batch",))
 
 
+def _history_inputs(buf):
+    """History buffers placed for the current process span.
+
+    Single-process (the common case): the ObsBuffer's cached default-
+    device upload is reused untouched.  Multi-process (a
+    ``jax.distributed`` mesh spanning hosts -- the DCN path): inputs
+    committed to one local device cannot feed a computation laid out
+    over the global mesh, so the buffers are handed to jit as host
+    numpy instead -- uncommitted inputs are placed by jit itself as
+    fully-replicated over the global mesh (each process uploads its
+    identical copy; an explicit device_put is impossible here, the
+    global sharding is not process-addressable).
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return buf.device_arrays()
+    import numpy as np
+
+    b = buf._device_bucket()
+    return tuple(np.ascontiguousarray(a[..., :b]) for a in buf.arrays())
+
+
 # ---------------------------------------------------------------------------
 # drop-in suggest using a default all-devices mesh
 # ---------------------------------------------------------------------------
@@ -202,8 +225,21 @@ def sharded_suggest(
                         ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
                     ),
             )
-            out = fn(key, *buf.device_arrays(), batch=batch)
+            out = fn(key, *_history_inputs(buf), batch=batch)
         return jax.device_get(out)
+
+    if speculative and B == 1:
+        from ..tpe_jax import _saturated_categorical, _warn_saturated
+
+        # the ACTUAL total categorical draw across the mesh decides
+        # saturation: per-device counts round up, so the executed total
+        # (cat_per_dev * n_dev) can exceed the requested n_EI_cat_total
+        n_cat_total = (
+            int(n_EI_per_device) if cat_per_dev is None else cat_per_dev
+        ) * n_dev
+        if _saturated_categorical(ps, n_cat_total):
+            _warn_saturated(domain, speculative)
+            speculative = 0
 
     if speculative and B == 1:
         from ..tpe_jax import _speculative_cols
@@ -212,6 +248,8 @@ def sharded_suggest(
             "sharded", id(mesh), int(n_EI_per_device), cat_per_dev,
             float(gamma), float(linear_forgetting), float(prior_weight),
             int(n_startup_jobs), id(trials), int(speculative),
+            # resolved staleness budget (see tpe_jax.suggest's key)
+            int(speculative) - 1 if max_stale is None else int(max_stale),
         )
         values, active = _speculative_cols(
             domain, trials, seed, int(speculative), max_stale, params,
