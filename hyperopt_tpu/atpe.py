@@ -80,6 +80,7 @@ class ATPEOptimizer:
         ok = _ok_trials(trials)
         n = len(ok)
 
+        explore_fraction = 0.0
         if _pure_categorical(domain):
             # Pure-categorical spaces: every heuristic lever measured
             # neutral-to-harmful there (BASELINE.md ATPE table -- the
@@ -105,15 +106,28 @@ class ATPEOptimizer:
             ))
             prior_weight = 1.0
 
-            # improvement trend: stalled experiments get a stronger
-            # prior (more exploration), improving ones sharpen
+            # improvement trend: stalled experiments re-explore,
+            # improving ones sharpen.  Stall = the best loss gained
+            # less than 2% of its total improvement over the last
+            # ~15 trials -- measured round 3 (BASELINE.md trap
+            # battery): the previous detector (gain <= 1e-6 relative)
+            # never fired on smooth objectives, where TPE inches
+            # forward forever, so the lever was dead in exactly the
+            # deceptive-basin regime it targets.  The response is
+            # two-sided: a stronger prior widens the Parzen models AND
+            # ``explore_fraction`` routes a quarter of suggestions to
+            # pure prior draws (restarts) -- on deceptive multi-basin
+            # spaces the posterior's own argmax cannot leave the basin
+            # it converged into, only off-posterior draws can.
             if n >= 20:
                 losses = [float(t["result"]["loss"]) for t in ok]
                 best_first = np.minimum.accumulate(losses)
-                recent_gain = best_first[-10] - best_first[-1]
-                scale = abs(best_first[-1]) + 1e-12
-                if recent_gain <= 1e-6 * scale:
+                w = min(15, max(2, n // 2))
+                recent_gain = best_first[-w] - best_first[-1]
+                total_gain = best_first[0] - best_first[-1]
+                if recent_gain <= 0.02 * (total_gain + 1e-12):
                     prior_weight = 1.5
+                    explore_fraction = 0.25
                 else:
                     gamma = max(0.15, gamma - 0.05)
 
@@ -134,6 +148,10 @@ class ATPEOptimizer:
             # this key (its single n_EI applies to every dim, anchored
             # at the reference's 24)
             "n_EI_candidates_cat": 24,
+            # probability a suggestion is a pure prior draw (stall-
+            # triggered restart; consumed by both suggest paths, never
+            # forwarded to the TPE engines)
+            "explore_fraction": explore_fraction,
         }
 
     # -- parameter locking --------------------------------------------------
@@ -208,7 +226,11 @@ class ATPEOptimizer:
                         v = float(np.exp(v))
                     score = 1.0 - float(arr.std()) / (0.05 * width)
                     locked[label] = (score, v)
-        max_lock = max(1, len(helper.hps) // 2)
+        # at least half the dims must keep exploring (locking may
+        # concentrate, never collapse) -- a 1-dim space gets no locking
+        max_lock = len(helper.hps) // 2
+        if max_lock == 0:
+            return {}
         if len(locked) > max_lock:
             keep = sorted(locked, key=lambda k: -locked[k][0])[:max_lock]
             locked = {k: locked[k] for k in keep}
@@ -225,6 +247,12 @@ class ATPEOptimizer:
             return helper.sample_one(rng)
 
         settings = self.tpe_settings(domain, trials)
+        if rng.uniform() < settings.get("explore_fraction", 0.0):
+            # stall-triggered restart: an off-posterior prior draw (the
+            # posterior's own argmax cannot leave the basin it converged
+            # into); locking is skipped too -- a restart that keeps the
+            # converged values is not a restart
+            return helper.sample_one(rng)
         locked = self.locked_values(domain, trials, rng)
 
         draws = tpe._posterior_draws(

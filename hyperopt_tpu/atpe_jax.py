@@ -19,7 +19,7 @@ import numpy as np
 from .atpe import ATPEOptimizer
 from .jax_trials import obs_buffer_for, packed_space_for
 from .pyll.stochastic import ensure_rng
-from .rand import docs_from_idxs_vals
+from .rand import _domain_helper, docs_from_idxs_vals
 from .vectorize import dense_to_idxs_vals
 
 __all__ = ["suggest"]
@@ -55,8 +55,11 @@ def suggest(
     warm = buf.count >= n_startup_jobs
 
     kw = {}
+    explore_fraction = 0.0
     if warm:
-        kw = opt.tpe_settings(domain, trials)
+        kw = dict(opt.tpe_settings(domain, trials))
+        # consumed here, never forwarded to the jitted engine
+        explore_fraction = kw.pop("explore_fraction", 0.0)
     values, active = tpe_jax.suggest_dense(
         domain, trials, int(rng.integers(0, 2**31 - 1)), B,
         n_startup_jobs=n_startup_jobs,
@@ -68,17 +71,27 @@ def suggest(
     if warm:
         pos = {label: d for d, label in enumerate(ps.labels)}
         cands = opt.lock_candidates(domain, trials)  # invariant per call
-        relock = False
-        for j in range(B):  # per-suggestion lock roll (host-path parity)
+        helper = _domain_helper(domain) if explore_fraction else None
+        rerouted = False
+        for j in range(B):  # per-suggestion rolls (host-path parity)
+            if explore_fraction and rng.uniform() < explore_fraction:
+                # stall-triggered restart: overwrite this column with a
+                # pure prior draw (host sampler, no device dispatch);
+                # locking is skipped -- a restart that keeps converged
+                # values is not a restart
+                for label, v in helper.sample_one(rng).items():
+                    values[pos[label], j] = float(v)
+                rerouted = True
+                continue
             if not cands or rng.uniform() > opt.lock_fraction:
                 continue
             for label, v in cands.items():
                 d = pos.get(label)
                 if d is not None:
                     values[d, j] = float(v)
-                    relock = True
-        if relock:
-            # locking may re-route choice subtrees: recompute activity
+                    rerouted = True
+        if rerouted:
+            # restarts/locks may re-route choice subtrees: recompute
             active = np.asarray(ps.active_fn(values))
 
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)

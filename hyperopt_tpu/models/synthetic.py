@@ -196,6 +196,28 @@ def _space_gauss_wave2():
     )
 
 
+def _trap15_fn(cfg):
+    """Deceptive multi-basin trap (round-3 ATPE stall battery).
+
+    Each of 15 dims has a BROAD gentle basin at x=-2 (floor 0.18) and a
+    NARROW basin reaching 0 at x=+3 (catchment ~1.7% of the range):
+    posterior exploitation converges into the broad basin; leaving it
+    requires continued wide-exploration draws.  Built to exercise the
+    stalled-experiment adaptation levers; the measured verdict
+    (BASELINE.md round 3) is that plain TPE's adaptive-Parzen PRIOR
+    COMPONENT -- weight ~1/(n_below+1) in every below-model -- already
+    supplies that exploration, so explicit stall levers add little.
+    """
+    xs = np.array([cfg[f"t{i}"] for i in range(15)])
+    broad = 0.18 + (xs + 2.0) ** 2 / 30.0
+    narrow = 25.0 * (xs - 3.0) ** 2
+    return float(np.mean(np.minimum(broad, narrow)))
+
+
+def _space_trap15():
+    return {f"t{i}": hp.uniform(f"t{i}", -5.0, 5.0) for i in range(15)}
+
+
 def _space_many_dists():
     return {
         "a_u": hp.uniform("a_u", -5, 5),
@@ -256,6 +278,10 @@ DOMAINS = {
         SyntheticDomain(
             "many_dists", _many_dists_fn, _space_many_dists, 0.0,
             {100: 1.5},
+        ),
+        SyntheticDomain(
+            "trap15", _trap15_fn, _space_trap15, 0.0,
+            {200: 0.30},
         ),
     ]
 }
