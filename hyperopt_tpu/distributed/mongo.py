@@ -10,11 +10,14 @@ the same role on a shared filesystem without extra dependencies and is the
 recommended backend on TPU pods.
 
 Executed coverage: ``tests/test_mongo_spark.py`` runs this module's real
-protocol code (reserve CAS under thread contention, reaping, GridFS
-domain shipping, full async fmin with worker threads, the CLI loop)
-against an in-memory pymongo/gridfs double implementing exactly the
-client surface used here -- the reference's real-mongod test strategy
-(SURVEY.md SS4) adapted to an image without mongod.
+protocol code (reserve CAS under thread contention AND across real
+worker PROCESSES, reaping, GridFS domain shipping, full async fmin with
+worker threads and with ``main_worker`` subprocesses, the CLI loop)
+against pymongo/gridfs doubles implementing exactly the client surface
+used here -- in-memory for thread-level tests, file-backed (O_EXCL lock
++ atomic replace) for cross-process contention -- plus an import-gated
+real-mongod test that activates wherever ``mongod`` exists: the
+reference's real-mongod strategy (SURVEY.md SS4) adapted to this image.
 """
 
 from __future__ import annotations

@@ -61,6 +61,17 @@ def _set_path(doc, key, value):
     cur[parts[-1]] = value
 
 
+def _unset_path(doc, key):
+    parts = key.split(".")
+    cur = doc
+    for p in parts[:-1]:
+        if not isinstance(cur, dict) or p not in cur:
+            return
+        cur = cur[p]
+    if isinstance(cur, dict):
+        cur.pop(parts[-1], None)
+
+
 def _match(doc, query):
     for k, cond in (query or {}).items():
         val, present = _get_path(doc, k)
@@ -68,11 +79,27 @@ def _match(doc, query):
             isinstance(op, str) and op.startswith("$") for op in cond
         ):
             for op, operand in cond.items():
-                if op == "$lt":
-                    if not present or val is None or not (val < operand):
+                if op == "$exists":
+                    if present != bool(operand):
                         return False
-                elif op == "$gt":
-                    if not present or val is None or not (val > operand):
+                elif op == "$ne":
+                    if (val if present else None) == operand:
+                        return False
+                elif op == "$in":
+                    if not present or val not in operand:
+                        return False
+                elif op in ("$lt", "$gt", "$lte", "$gte"):
+                    # mongo comparison semantics: a missing/None field
+                    # never satisfies a range operator
+                    if not present or val is None:
+                        return False
+                    ok = {
+                        "$lt": val < operand,
+                        "$gt": val > operand,
+                        "$lte": val <= operand,
+                        "$gte": val >= operand,
+                    }[op]
+                    if not ok:
                         return False
                 else:
                     raise NotImplementedError(f"query operator {op}")
@@ -106,10 +133,18 @@ class Collection:
     @staticmethod
     def _apply_update(doc, update):
         for op, fields in update.items():
-            if op != "$set":
+            if op == "$set":
+                for k, v in fields.items():
+                    _set_path(doc, k, copy.deepcopy(v))
+            elif op == "$unset":
+                for k in fields:
+                    _unset_path(doc, k)
+            elif op == "$inc":
+                for k, v in fields.items():
+                    cur, present = _get_path(doc, k)
+                    _set_path(doc, k, (cur if present and cur else 0) + v)
+            else:
                 raise NotImplementedError(f"update operator {op}")
-            for k, v in fields.items():
-                _set_path(doc, k, copy.deepcopy(v))
 
     def find_one_and_update(self, filter, update, sort=None,
                             return_document=False):
@@ -251,14 +286,279 @@ class GridFS:
 
 
 def install_fake_mongo(monkeypatch):
-    """sys.modules['pymongo'|'gridfs'] -> these doubles; registry reset."""
+    """sys.modules['pymongo'|'gridfs'] -> these doubles; registry reset.
+
+    The installed client dispatches: ``mongodb://file:/abs/dir``
+    connection strings get the cross-process file-backed server,
+    everything else the in-memory registry double."""
     pymongo_mod = types.ModuleType("pymongo")
-    pymongo_mod.MongoClient = MongoClient
+    pymongo_mod.MongoClient = _DispatchMongoClient
     gridfs_mod = types.ModuleType("gridfs")
-    gridfs_mod.GridFS = GridFS
+    gridfs_mod.GridFS = _DispatchGridFS
     monkeypatch.setitem(sys.modules, "pymongo", pymongo_mod)
     monkeypatch.setitem(sys.modules, "gridfs", gridfs_mod)
     MongoClient._registry.clear()
+    return pymongo_mod
+
+
+# ---------------------------------------------------------------------------
+# FILE-BACKED pymongo double: one "server" shared across PROCESSES
+# ---------------------------------------------------------------------------
+#
+# The in-memory double above proves CAS exclusivity only across threads
+# (its lock is a threading.RLock).  This variant persists each database
+# to a pickle file guarded by an O_EXCL lock file, so separate worker
+# PROCESSES -- spawned the way the reference spawns
+# ``hyperopt-mongo-worker`` subprocesses against a temp mongod -- contend
+# through the filesystem exactly like clients of one server.  Connection
+# strings of the form ``mongodb://file:/abs/dir`` select it.
+
+
+class _FileLock:
+    """O_CREAT|O_EXCL lock file: the only cross-process mutual exclusion
+    primitive that needs nothing but a shared filesystem."""
+
+    def __init__(self, path, timeout=30.0):
+        self.path = path + ".lock"
+        self.timeout = timeout
+
+    def __enter__(self):
+        import os
+        import time as _time
+
+        deadline = _time.monotonic() + self.timeout
+        while True:
+            try:
+                fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+                return self
+            except FileExistsError:
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"lock {self.path} not released")
+                _time.sleep(0.002)
+
+    def __exit__(self, *exc):
+        import os
+
+        try:
+            os.unlink(self.path)
+        except FileNotFoundError:
+            pass
+
+
+class FileCollection:
+    """Same surface as :class:`Collection`, state in ``<dir>/<name>.pkl``;
+    every operation is load -> mutate -> atomic-replace under the lock."""
+
+    def __init__(self, dirpath, name):
+        import os
+
+        os.makedirs(dirpath, exist_ok=True)
+        self.name = name
+        self._path = os.path.join(dirpath, name + ".pkl")
+
+    def _load(self):
+        import pickle
+
+        try:
+            with open(self._path, "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return {"docs": [], "next_id": 1}
+
+    def _store(self, state):
+        import os
+        import pickle
+
+        tmp = f"{self._path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._path)
+
+    # -- writes -------------------------------------------------------------
+    def insert_one(self, doc):
+        with _FileLock(self._path):
+            state = self._load()
+            stored = copy.deepcopy(doc)
+            if "_id" not in stored:
+                stored["_id"] = state["next_id"]
+                state["next_id"] += 1
+            doc["_id"] = stored["_id"]
+            state["docs"].append(stored)
+            self._store(state)
+            return InsertOneResult(stored["_id"])
+
+    def find_one_and_update(self, filter, update, sort=None,
+                            return_document=False):
+        with _FileLock(self._path):
+            state = self._load()
+            matches = Collection._sorted(
+                [d for d in state["docs"] if _match(d, filter)], sort
+            )
+            if not matches:
+                return None
+            target = matches[0]
+            before = copy.deepcopy(target)
+            Collection._apply_update(target, update)
+            self._store(state)
+            return copy.deepcopy(target) if return_document else before
+
+    def update_one(self, filter, update):
+        with _FileLock(self._path):
+            state = self._load()
+            for d in state["docs"]:
+                if _match(d, filter):
+                    Collection._apply_update(d, update)
+                    self._store(state)
+                    return UpdateResult(1, 1)
+            return UpdateResult(0, 0)
+
+    def update_many(self, filter, update):
+        with _FileLock(self._path):
+            state = self._load()
+            n = 0
+            for d in state["docs"]:
+                if _match(d, filter):
+                    Collection._apply_update(d, update)
+                    n += 1
+            if n:
+                self._store(state)
+            return UpdateResult(n, n)
+
+    def delete_many(self, filter):
+        with _FileLock(self._path):
+            state = self._load()
+            keep = [d for d in state["docs"] if not _match(d, filter)]
+            n = len(state["docs"]) - len(keep)
+            state["docs"] = keep
+            self._store(state)
+            return DeleteResult(n)
+
+    # -- reads --------------------------------------------------------------
+    def find(self, filter=None, sort=None):
+        with _FileLock(self._path):
+            docs = self._load()["docs"]
+        return [
+            copy.deepcopy(d)
+            for d in Collection._sorted(
+                (d for d in docs if _match(d, filter)), sort
+            )
+        ]
+
+    def find_one(self, filter=None, sort=None):
+        res = self.find(filter, sort=sort)
+        return res[0] if res else None
+
+
+class FileDatabase:
+    def __init__(self, dirpath, name):
+        import os
+
+        self.name = name
+        self._dir = os.path.join(dirpath, name)
+        self._gridfs_dir = os.path.join(self._dir, "_gridfs")
+
+    def __getitem__(self, name):
+        return FileCollection(self._dir, name)
+
+
+class FileGridFS:
+    """File-backed GridFS slice (put / find_one by filename / delete)."""
+
+    def __init__(self, db, collection="fs"):
+        import os
+
+        self._dir = os.path.join(db._gridfs_dir, collection)
+        os.makedirs(self._dir, exist_ok=True)
+        self._state = os.path.join(self._dir, "files.pkl")
+
+    def _load(self):
+        import pickle
+
+        try:
+            with open(self._state, "rb") as f:
+                return pickle.load(f)
+        except FileNotFoundError:
+            return {"files": {}, "next_id": 1}
+
+    def _store(self, state):
+        import os
+        import pickle
+
+        tmp = f"{self._state}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, self._state)
+
+    def put(self, data, filename=None, **kw):
+        if isinstance(data, str):
+            data = data.encode()
+        with _FileLock(self._state):
+            state = self._load()
+            file_id = state["next_id"]
+            state["next_id"] += 1
+            state["files"][file_id] = (filename, bytes(data))
+            self._store(state)
+            return file_id
+
+    def find_one(self, query):
+        filename = query["filename"]
+        with _FileLock(self._state):
+            files = self._load()["files"]
+        for file_id in sorted(files, reverse=True):
+            fn, data = files[file_id]
+            if fn == filename:
+                return _GridOut(file_id, data)
+        return None
+
+    def delete(self, file_id):
+        with _FileLock(self._state):
+            state = self._load()
+            state["files"].pop(file_id, None)
+            self._store(state)
+
+
+class FileMongoClient:
+    """``MongoClient('mongodb://file:/abs/dir')`` -> file-backed server."""
+
+    def __init__(self, conn_str):
+        path = conn_str
+        for prefix in ("mongodb://file:", "file:"):
+            if path.startswith(prefix):
+                path = path[len(prefix):]
+                break
+        self._dir = path
+
+    def __getitem__(self, dbname):
+        return FileDatabase(self._dir, dbname)
+
+
+class _DispatchMongoClient:
+    """Route ``file:`` connection strings to the file-backed server,
+    everything else to the in-memory registry double."""
+
+    def __new__(cls, conn_str="mongodb://localhost:27017"):
+        if "file:" in conn_str:
+            return FileMongoClient(conn_str)
+        return MongoClient(conn_str)
+
+
+class _DispatchGridFS:
+    def __new__(cls, db, collection="fs"):
+        if isinstance(db, FileDatabase):
+            return FileGridFS(db, collection)
+        return GridFS(db, collection)
+
+
+def install_fake_mongo_modules():
+    """monkeypatch-free installer (for subprocess bootstrap): drop the
+    dispatching doubles into ``sys.modules`` permanently."""
+    pymongo_mod = types.ModuleType("pymongo")
+    pymongo_mod.MongoClient = _DispatchMongoClient
+    gridfs_mod = types.ModuleType("gridfs")
+    gridfs_mod.GridFS = _DispatchGridFS
+    sys.modules["pymongo"] = pymongo_mod
+    sys.modules["gridfs"] = gridfs_mod
     return pymongo_mod
 
 
