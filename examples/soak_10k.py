@@ -1,0 +1,116 @@
+"""10k-observation soak of the observation path (VERDICT r2 item 8).
+
+The round-2 soak measured the bucketed-upload optimization at 2,500
+observations; this drives the SAME real ingestion path (completed trial
+docs -> ``Trials`` store -> ``ObsBuffer.sync`` -> pow2-bucketed device
+upload) to 10,000+ observations, recording at each checkpoint:
+
+  * capacity-bucket growth (128 -> 16384 by 4x capacity, pow2 upload),
+  * batched suggest throughput (B=1024) against the live bucket,
+  * host-mirror memory (buffer nbytes + process RSS delta).
+
+Run on the real TPU::
+
+    python examples/soak_10k.py [--max-obs 10000]
+
+Prints one JSON line per checkpoint plus a summary table.
+"""
+
+import argparse
+import json
+import resource
+import time
+
+import numpy as np
+
+
+def rss_mb():
+    return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-obs", type=int, default=10_000)
+    ap.add_argument("--batch", type=int, default=1024)
+    ap.add_argument("--n-cand", type=int, default=128)
+    ap.add_argument("--n-calls", type=int, default=8)
+    args = ap.parse_args()
+
+    import jax
+
+    from hyperopt_tpu import rand, tpe_jax
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+    from hyperopt_tpu.jax_trials import JaxTrials, obs_buffer_for
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+
+    platform = jax.devices()[0].platform
+    print(f"platform: {platform}")
+    domain = Domain(mixed_space_fn, mixed_space())
+    trials = JaxTrials()
+    rng = np.random.default_rng(0)
+    rss0 = rss_mb()
+
+    checkpoints = [500, 1000, 2500, 5000, 10_000]
+    checkpoints = [c for c in checkpoints if c <= args.max_obs]
+    fn_cache = {}
+    rows = []
+    n_have = 0
+    for target in checkpoints:
+        # ingest through the REAL doc path (suggest -> complete -> sync)
+        while n_have < target:
+            chunk = min(500, target - n_have)
+            ids = trials.new_trial_ids(chunk)
+            docs = rand.suggest(ids, domain, trials, seed=n_have)
+            for doc in docs:
+                doc["state"] = JOB_STATE_DONE
+                doc["result"] = {
+                    "status": "ok", "loss": float(rng.uniform(0, 10))
+                }
+            trials.insert_trial_docs(docs)
+            trials.refresh()
+            n_have += chunk
+        buf = obs_buffer_for(domain, trials)
+        assert buf.count == target, (buf.count, target)
+        bucket = buf._device_bucket()
+        arrays = buf.device_arrays()
+
+        fn = fn_cache.get(bucket)
+        if fn is None:
+            fn = fn_cache[bucket] = tpe_jax.build_suggest_fn(
+                buf.space, args.n_cand, 0.25, 25.0, 1.0, n_cand_cat=24
+            )
+        key = jax.random.key(target)
+        out = fn(key, *arrays, batch=args.batch)
+        _ = np.asarray(out[0][:1, :1])  # compile + force
+        keys = list(jax.random.split(key, args.n_calls))
+        _ = np.asarray(jax.random.key_data(keys[-1]))
+        t0 = time.perf_counter()
+        for i in range(args.n_calls):
+            out = fn(keys[i], *arrays, batch=args.batch)
+        _ = np.asarray(out[0][:1, :1])  # fetch forces completion
+        dt = time.perf_counter() - t0
+        sugg_rate = args.batch * args.n_calls / dt
+
+        buf_mb = sum(a.nbytes for a in buf.arrays()) / 1e6
+        row = {
+            "n_obs": target,
+            "capacity": buf.capacity,
+            "device_bucket": bucket,
+            "suggest_per_sec_B1024": round(sugg_rate, 1),
+            "buffer_mb": round(buf_mb, 2),
+            "rss_delta_mb": round(rss_mb() - rss0, 1),
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    print("\nn_obs  bucket  sugg/s   buf_MB  rss_dMB")
+    for r in rows:
+        print(
+            f"{r['n_obs']:<7}{r['device_bucket']:<8}"
+            f"{r['suggest_per_sec_B1024']:<9}{r['buffer_mb']:<8}"
+            f"{r['rss_delta_mb']}"
+        )
+
+
+if __name__ == "__main__":
+    main()
