@@ -7,6 +7,8 @@ gauss_wave2, many_dists, ...) mirroring the reference's
 ``nasbench`` -- NAS-Bench-201-style choice-heavy architecture search.
 ``resnet`` -- flax ResNet-20 with a vmapped population train step (the
 TPU flagship objective, BASELINE.json config #4).
+``transformer`` -- decoder-only LM on an in-context next-token task,
+same population-training shape (the MXU-native family).
 """
 
 from . import synthetic
@@ -15,7 +17,7 @@ __all__ = ["synthetic"]
 
 
 def __getattr__(name):
-    if name in ("surrogate", "nasbench", "resnet"):
+    if name in ("surrogate", "nasbench", "resnet", "transformer"):
         import importlib
 
         mod = importlib.import_module(f".{name}", __name__)

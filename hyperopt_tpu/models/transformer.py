@@ -1,0 +1,183 @@
+"""Tiny decoder-only transformer LM with a vmapped population train step.
+
+Extends the benchmark model families (SURVEY.md SS6 configs; resnet.py is
+config #4) with the workload TPUs are actually built for: causal-
+attention language modeling, where the MXU sees the attention and MLP
+matmuls of a whole *population* of models at once.  Same TPU-native
+population-training shape as :mod:`hyperopt_tpu.models.resnet` --
+hyperparameters become a batched leading axis via ``vmap``, the
+population shards over the ``trial`` mesh axis and each member's token
+batch over ``cand`` (reusing the suggest mesh), GSPMD inserts the
+collectives.
+
+The synthetic task is *in-context* next-token prediction: every sequence
+follows ``x[t+1] = (x[t] + delta) % vocab`` with a per-sequence delta, so
+the model must attend to earlier transitions to infer delta before it can
+predict -- learnable only through attention, hermetic in a zero-egress
+image (swap ``synthetic_token_batch`` for a real corpus in production).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "TinyLM",
+    "synthetic_token_batch",
+    "make_population_train_step",
+    "init_population",
+    "population_objective",
+    "hpo_space",
+]
+
+
+def TinyLM(vocab=64, d_model=32, n_heads=2, n_layers=2, max_len=64):
+    """Decoder-only pre-LN transformer LM (flax)."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class Block(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            h = nn.LayerNorm()(x)
+            h = nn.SelfAttention(
+                num_heads=n_heads, qkv_features=d_model,
+                deterministic=True,
+            )(h, mask=nn.make_causal_mask(jnp.zeros(x.shape[:-1])))
+            x = x + h
+            h = nn.LayerNorm()(x)
+            h = nn.Dense(4 * d_model)(h)
+            h = nn.gelu(h)
+            h = nn.Dense(d_model)(h)
+            return x + h
+
+    class _LM(nn.Module):
+        @nn.compact
+        def __call__(self, tokens):
+            # tokens [B, T] int32 -> logits [B, T, vocab]
+            pos = jnp.arange(tokens.shape[-1])
+            x = nn.Embed(vocab, d_model)(tokens)
+            x = x + nn.Embed(max_len, d_model)(pos)
+            for _ in range(n_layers):
+                x = Block()(x)
+            x = nn.LayerNorm()(x)
+            return nn.Dense(vocab)(x)
+
+    return _LM()
+
+
+def synthetic_token_batch(key, batch_size=64, seq_len=32, vocab=64,
+                          n_deltas=8):
+    """In-context modular-progression sequences.
+
+    Each sequence picks ``delta`` from ``n_deltas`` options and a random
+    start; tokens follow ``x[t+1] = (x[t] + delta) % vocab``.  Predicting
+    position t requires inferring delta from earlier transitions --
+    an attention-dependent task with loss floor ~log(n_deltas) at t=1
+    and ~0 later.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    k_delta, k_start = jax.random.split(key)
+    deltas = jax.random.randint(k_delta, (batch_size, 1), 1, n_deltas + 1)
+    starts = jax.random.randint(k_start, (batch_size, 1), 0, vocab)
+    t = jnp.arange(seq_len)[None, :]
+    return (starts + deltas * t) % vocab
+
+
+def make_population_train_step(model, mesh=None, trial_axis="trial",
+                               data_axis="cand"):
+    """Build ``train_step(pop_params, pop_opt, lr, wd, tokens)``.
+
+    vmaps a single-model SGD(+momentum, +weight-decay) next-token step
+    over the population leading axis; with ``mesh`` given, population
+    shards over ``trial_axis`` and the token batch over ``data_axis``
+    (sharding constraints; GSPMD inserts the collectives).
+    """
+    import jax
+    import optax
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:]
+        ).mean()
+
+    def one_member_step(params, momentum, lr, wd, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        new_momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+        new_params = jax.tree.map(
+            lambda p, m: p - lr * (m + wd * p), params, new_momentum
+        )
+        return new_params, new_momentum, loss
+
+    pop_step = jax.vmap(one_member_step, in_axes=(0, 0, 0, 0, None))
+
+    if mesh is None:
+        return jax.jit(pop_step)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def sharded_step(pop_params, pop_momentum, lr, wd, tokens):
+        constrain = jax.lax.with_sharding_constraint
+        pop_params = jax.tree.map(
+            lambda x: constrain(x, NamedSharding(mesh, P(trial_axis))),
+            pop_params,
+        )
+        tokens = constrain(tokens, NamedSharding(mesh, P(data_axis)))
+        return pop_step(pop_params, pop_momentum, lr, wd, tokens)
+
+    return jax.jit(sharded_step)
+
+
+def init_population(model, pop_size, key, seq_len=32):
+    """Per-member init (different seeds) stacked on a leading axis."""
+    import jax
+    import jax.numpy as jnp
+
+    def init_one(k):
+        dummy = jnp.zeros((1, seq_len - 1), jnp.int32)
+        return model.init(k, dummy)["params"]
+
+    return jax.vmap(init_one)(jax.random.split(key, pop_size))
+
+
+def hpo_space():
+    """lr + weight-decay sweep (the transformer twin of resnet config #4)."""
+    from .. import hp
+
+    return {
+        "lr": hp.loguniform("lr", np.log(1e-4), np.log(1.0)),
+        "wd": hp.loguniform("wd", np.log(1e-6), np.log(1e-2)),
+    }
+
+
+def population_objective(n_steps=4, batch_size=16, seq_len=16, vocab=16,
+                         d_model=16, n_heads=2, n_layers=1, seed=0,
+                         mesh=None):
+    """Factory: an fmin-compatible objective -- train a TinyLM with the
+    suggested lr/wd for ``n_steps`` and return final next-token loss."""
+    import jax
+    import jax.numpy as jnp
+
+    model = TinyLM(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                   n_layers=n_layers, max_len=seq_len)
+    step = make_population_train_step(model, mesh=mesh)
+    key = jax.random.key(seed)
+    init_key, data_key = jax.random.split(key)
+    tokens = synthetic_token_batch(
+        data_key, batch_size, seq_len, vocab, n_deltas=min(8, vocab - 1)
+    )
+
+    def objective(cfg):
+        params = init_population(model, 1, init_key, seq_len)
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        lr = jnp.asarray([cfg["lr"]], jnp.float32)
+        wd = jnp.asarray([cfg["wd"]], jnp.float32)
+        loss = None
+        for _ in range(n_steps):
+            params, momentum, loss = step(params, momentum, lr, wd, tokens)
+        return float(loss[0])
+
+    return objective

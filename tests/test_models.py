@@ -181,6 +181,50 @@ def test_resnet_tiny_objective_lr_sensitivity():
     assert good < bad  # a sane lr must beat a vanishing one after 2 steps
 
 
+def test_transformer_objective_lr_sensitivity():
+    from hyperopt_tpu.models import transformer
+
+    obj = transformer.population_objective(n_steps=6)
+    good = obj({"lr": 0.3, "wd": 1e-5})
+    bad = obj({"lr": 1e-4, "wd": 1e-5})
+    assert np.isfinite(good) and np.isfinite(bad)
+    assert good < bad  # a sane lr must beat a vanishing one after 6 steps
+
+
+def test_transformer_population_sharded_step():
+    """The transformer population trains with the population sharded over
+    'trial' and the token batch over 'cand' on the 8-device mesh --
+    the same GSPMD shape as the resnet family (config #4 twin)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.models import transformer
+    from hyperopt_tpu.parallel.mesh import mesh_from_spec
+
+    mesh = mesh_from_spec((2, 4), ("trial", "cand"))
+    model = transformer.TinyLM(vocab=16, d_model=16, n_heads=2,
+                               n_layers=1, max_len=16)
+    step = transformer.make_population_train_step(model, mesh=mesh)
+    pop = 4
+    params = transformer.init_population(
+        model, pop, jax.random.key(0), seq_len=16
+    )
+    momentum = jax.tree.map(jnp.zeros_like, params)
+    tokens = transformer.synthetic_token_batch(
+        jax.random.key(1), batch_size=16, seq_len=16, vocab=16, n_deltas=4
+    )
+    lr = jnp.asarray([0.3, 0.1, 0.03, 0.01], jnp.float32)
+    wd = jnp.full((pop,), 1e-5, jnp.float32)
+    losses = []
+    for _ in range(4):
+        params, momentum, loss = step(params, momentum, lr, wd, tokens)
+        losses.append(np.asarray(loss))
+    assert np.isfinite(losses).all()
+    # population members really differ (per-member lr) and training helps
+    assert len(np.unique(np.round(losses[-1], 6))) > 1
+    assert losses[-1].min() < losses[0].min()
+
+
 def test_atpe_jax_end_to_end():
     """Adaptive TPE over the device sweep: runs, beats random at median,
     locks respect conditional structure."""
