@@ -27,6 +27,7 @@ __all__ = [
     "make_population_train_step",
     "init_population",
     "population_objective",
+    "device_objective",
     "hpo_space",
 ]
 
@@ -86,6 +87,32 @@ def synthetic_token_batch(key, batch_size=64, seq_len=32, vocab=64,
     return (starts + deltas * t) % vocab
 
 
+def _next_token_loss_fn(model):
+    """Shared next-token loss: ONE definition for both execution modes
+    (host-driven population step and the fused device objective) so the
+    BASELINE comparisons between them stay apples-to-apples."""
+    import optax
+
+    def loss_fn(params, tokens):
+        logits = model.apply({"params": params}, tokens[:, :-1])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:]
+        ).mean()
+
+    return loss_fn
+
+
+def _sgd_update(params, momentum, grads, lr, wd):
+    """Shared SGD(momentum=0.9, coupled weight-decay) member update."""
+    import jax
+
+    new_momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
+    new_params = jax.tree.map(
+        lambda p, m: p - lr * (m + wd * p), params, new_momentum
+    )
+    return new_params, new_momentum
+
+
 def make_population_train_step(model, mesh=None, trial_axis="trial",
                                data_axis="cand"):
     """Build ``train_step(pop_params, pop_opt, lr, wd, tokens)``.
@@ -96,19 +123,13 @@ def make_population_train_step(model, mesh=None, trial_axis="trial",
     (sharding constraints; GSPMD inserts the collectives).
     """
     import jax
-    import optax
 
-    def loss_fn(params, tokens):
-        logits = model.apply({"params": params}, tokens[:, :-1])
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, tokens[:, 1:]
-        ).mean()
+    loss_fn = _next_token_loss_fn(model)
 
     def one_member_step(params, momentum, lr, wd, tokens):
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        new_momentum = jax.tree.map(lambda m, g: 0.9 * m + g, momentum, grads)
-        new_params = jax.tree.map(
-            lambda p, m: p - lr * (m + wd * p), params, new_momentum
+        new_params, new_momentum = _sgd_update(
+            params, momentum, grads, lr, wd
         )
         return new_params, new_momentum, loss
 
@@ -141,6 +162,60 @@ def init_population(model, pop_size, key, seq_len=32):
         return model.init(k, dummy)["params"]
 
     return jax.vmap(init_one)(jax.random.split(key, pop_size))
+
+
+def device_objective(n_steps=4, batch_size=16, seq_len=16, vocab=16,
+                     d_model=16, n_heads=2, n_layers=1, seed=0):
+    """A ``device_loop``-compatible objective: the whole HPO experiment --
+    suggest, *train a TinyLM per trial*, observe -- compiles to ONE XLA
+    program.
+
+    Returns a jittable ``objective(cfg) -> [B] losses`` over a dict of
+    ``[B]`` value arrays: each batch member initializes its own model
+    (shared key -- the hyperparameters are the only difference), trains
+    ``n_steps`` of SGD+momentum under ``lax.fori_loop``, and reports
+    final next-token loss.  Feed to
+    ``device_loop.compile_fmin(device_objective(...), hpo_space(), ...)``
+    for zero-host-round-trip HPO over actual model training.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    model = TinyLM(vocab=vocab, d_model=d_model, n_heads=n_heads,
+                   n_layers=n_layers, max_len=seq_len)
+    key = jax.random.key(seed)
+    init_key, data_key = jax.random.split(key)
+    tokens = synthetic_token_batch(
+        data_key, batch_size, seq_len, vocab, n_deltas=min(8, vocab - 1)
+    )
+    # init ONCE at factory time (hyperparameters are the only per-member
+    # difference); the vmapped trainer closes over the shared params
+    params0 = model.init(
+        init_key, jnp.zeros((1, seq_len - 1), jnp.int32)
+    )["params"]
+    base_loss_fn = _next_token_loss_fn(model)
+
+    def loss_fn(params):
+        return base_loss_fn(params, tokens)
+
+    def train_one(lr, wd):
+        momentum = jax.tree.map(jnp.zeros_like, params0)
+
+        def body(_, carry):
+            params, momentum = carry
+            grads = jax.grad(loss_fn)(params)
+            return _sgd_update(params, momentum, grads, lr, wd)
+
+        params, _ = jax.lax.fori_loop(0, n_steps, body, (params0, momentum))
+        return loss_fn(params)
+
+    def objective(cfg):
+        return jax.vmap(train_one)(
+            jnp.asarray(cfg["lr"], jnp.float32),
+            jnp.asarray(cfg["wd"], jnp.float32),
+        )
+
+    return objective
 
 
 def hpo_space():

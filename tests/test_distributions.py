@@ -141,3 +141,37 @@ def test_obs_buffer_checkpoint_roundtrip(tmp_path):
     ps_other = compile_space({"y": hp.uniform("y", 0, 1)})
     with pytest.raises(ValueError):
         load_obs_buffer(ps_other, path)
+
+
+def test_obs_buffer_orbax_roundtrip(tmp_path):
+    """The orbax-native checkpoint path: same contract as the npz
+    roundtrip (arrays, cursors, pending list, label validation)."""
+    pytest.importorskip("orbax.checkpoint")
+    from hyperopt_tpu.jax_trials import ObsBuffer
+    from hyperopt_tpu.utils.checkpoint import (
+        load_obs_buffer_orbax,
+        save_obs_buffer_orbax,
+    )
+
+    ps = compile_space({"x": hp.uniform("x", 0, 1)})
+    buf = ObsBuffer(ps)
+    for i in range(10):
+        buf.add({"x": i / 10}, float(i))
+    # empty-pending (the common case) must roundtrip: orbax rejects
+    # zero-size arrays, so the tree packs pending behind a sentinel
+    d0 = str(tmp_path / "obs_orbax_empty")
+    save_obs_buffer_orbax(buf, d0)
+    assert load_obs_buffer_orbax(ps, d0)._pending == []
+    buf._pending = [3, 7]
+    d = str(tmp_path / "obs_orbax")
+    save_obs_buffer_orbax(buf, d)
+    buf2 = load_obs_buffer_orbax(ps, d)
+    assert buf2.count == 10
+    assert buf2._pending == [3, 7]
+    np.testing.assert_array_equal(buf2.losses, buf.losses)
+    np.testing.assert_array_equal(buf2.values, buf.values)
+    np.testing.assert_array_equal(buf2.tids, buf.tids)
+
+    ps_other = compile_space({"y": hp.uniform("y", 0, 1)})
+    with pytest.raises(ValueError):
+        load_obs_buffer_orbax(ps_other, d)
