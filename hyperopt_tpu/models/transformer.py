@@ -25,6 +25,7 @@ __all__ = [
     "TinyLM",
     "synthetic_token_batch",
     "make_population_train_step",
+    "make_pbt_train_fn",
     "init_population",
     "population_objective",
     "device_objective",
@@ -150,6 +151,36 @@ def make_population_train_step(model, mesh=None, trial_axis="trial",
         return pop_step(pop_params, pop_momentum, lr, wd, tokens)
 
     return jax.jit(sharded_step)
+
+
+def make_pbt_train_fn(model, batch_size=16, seq_len=16, vocab=16):
+    """Adapter to :func:`hyperopt_tpu.pbt.compile_pbt`'s contract:
+    ``train_fn(state, hypers, key) -> (state, losses[P])`` with
+    ``state = (params, momentum)`` population pytrees and hypers
+    ``{"lr": [P], "wd": [P]}``.  A fresh token batch is drawn from
+    ``key`` every step (all members see the same data; hyperparameters
+    are the only member difference, as in population training)."""
+    import jax
+
+    loss_fn = _next_token_loss_fn(model)
+
+    def train_fn(state, hypers, key):
+        params, momentum = state
+        tokens = synthetic_token_batch(
+            key, batch_size, seq_len, vocab, n_deltas=min(8, vocab - 1)
+        )
+
+        def member(p, m, lr, wd):
+            loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
+            p, m = _sgd_update(p, m, grads, lr, wd)
+            return p, m, loss
+
+        params, momentum, losses = jax.vmap(member)(
+            params, momentum, hypers["lr"], hypers["wd"]
+        )
+        return (params, momentum), losses
+
+    return train_fn
 
 
 def init_population(model, pop_size, key, seq_len=32):

@@ -1,0 +1,177 @@
+"""Population-Based Training, fused on-device.
+
+PBT (Jaderberg et al., 2017) tunes hyperparameters *during* training:
+a population of P members trains in parallel; every ``exploit_every``
+steps the bottom quantile copies the parameters of a top-quantile member
+(exploit) and perturbs its hyperparameters (explore).  The reference
+cannot express this at all (its trials are independent black-box
+evaluations); here the whole schedule -- P models training, periodic
+rank/copy/perturb -- compiles to ONE XLA program over the population
+``vmap``, with the population axis optionally sharded over a mesh
+(the same GSPMD shape as :mod:`hyperopt_tpu.models.resnet` /
+``models.transformer`` population training).
+
+Contract: the user supplies a *vmapped* population train function
+``train_fn(state, hypers, key) -> (state, losses[P])`` (one gradient
+step for every member; ``state`` is any pytree with leading population
+axis P on every leaf; ``hypers`` a dict of ``[P]`` arrays) plus per-
+hyperparameter log-space bounds.  :func:`compile_pbt` returns a runner
+executing ``n_rounds x exploit_every`` total steps.
+
+    from hyperopt_tpu.pbt import compile_pbt
+
+    runner = compile_pbt(train_fn, init_state, {"lr": (1e-4, 1.0)},
+                         pop_size=8, exploit_every=5, n_rounds=20)
+    out = runner(seed=0)
+    out["best_loss"], out["hypers"], out["loss_history"]  # [rounds, P]
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["compile_pbt"]
+
+
+def compile_pbt(
+    train_fn,
+    init_state,
+    hyper_bounds,
+    pop_size,
+    exploit_every=5,
+    n_rounds=20,
+    exploit_quantile=0.25,
+    perturb_factors=(0.8, 1.25),
+    mesh=None,
+    trial_axis="trial",
+):
+    """Compile a PBT schedule into one reusable device program.
+
+    Args:
+      train_fn: ``(state, hypers, key) -> (state, losses[P])`` -- one
+        vmapped training step for the whole population.  ``losses`` is
+        the ranking signal (lower is better).
+      init_state: population state pytree (leading axis P on every leaf).
+      hyper_bounds: ``{name: (low, high)}`` -- positive bounds; hypers
+        live and perturb in log space (the PBT-natural scale for
+        lr/wd-like knobs) and are sampled log-uniformly at start.
+      pop_size: P.
+      exploit_every: training steps between exploit/explore events.
+      n_rounds: number of exploit/explore events; total steps =
+        ``n_rounds * exploit_every``.
+      exploit_quantile: fraction of the population replaced each event
+        (bottom q copies params from the top q).
+      perturb_factors: multiplicative explore range (log-uniform within).
+      mesh / trial_axis: optional population sharding, as in
+        :func:`hyperopt_tpu.device_loop.compile_fmin`.
+
+    Returns ``runner(seed=0) -> dict`` with ``best_loss``,
+    ``best_hypers`` ({name: float} of the best final member),
+    ``hypers`` ({name: [P]} final), ``loss_history`` [n_rounds, P]
+    (each round's last-step losses), and ``state`` (final population
+    pytree, device arrays).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    P = int(pop_size)
+    names = sorted(hyper_bounds)
+    lo = np.array([float(hyper_bounds[n][0]) for n in names])
+    hi = np.array([float(hyper_bounds[n][1]) for n in names])
+    if not (lo > 0).all() or not (hi > lo).all():
+        raise ValueError("hyper_bounds must satisfy 0 < low < high")
+    n_replace = max(1, int(round(P * float(exploit_quantile))))
+    if 2 * n_replace > P:
+        raise ValueError(
+            f"exploit_quantile={exploit_quantile} replaces {n_replace} of "
+            f"{P} members; top and bottom quantiles must not overlap"
+        )
+    log_lo = jnp.asarray(np.log(lo), jnp.float32)  # [H]
+    log_hi = jnp.asarray(np.log(hi), jnp.float32)
+    log_pf = (float(np.log(perturb_factors[0])),
+              float(np.log(perturb_factors[1])))
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+        pop_sharding = NamedSharding(mesh, Pspec(trial_axis))
+
+        def constrain(state):
+            return jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(x, pop_sharding),
+                state,
+            )
+    else:
+        def constrain(state):
+            return state
+
+    def hypers_dict(log_h):
+        return {n: jnp.exp(log_h[:, i]) for i, n in enumerate(names)}
+
+    def train_rounds(carry, key):
+        """exploit_every train steps, then one exploit/explore event."""
+        state, log_h = carry
+        k_steps, k_perturb = jax.random.split(key)
+
+        def step(state, k):
+            state, losses = train_fn(state, hypers_dict(log_h), k)
+            return constrain(state), losses
+
+        state, losses_seq = jax.lax.scan(
+            step, state, jax.random.split(k_steps, exploit_every)
+        )
+        losses = losses_seq[-1]  # rank on the window's final step
+
+        # exploit: bottom n_replace member i copies params of the
+        # rank-matched top member; explore: its (copied) hypers perturb
+        # by a log-uniform factor, clipped into bounds
+        order = jnp.argsort(losses)  # ascending: best first
+        top = order[:n_replace]
+        bottom = order[P - n_replace:]
+        src = jnp.arange(P).at[bottom].set(top)  # identity elsewhere
+        state = jax.tree.map(lambda x: x[src], state)
+        state = constrain(state)
+
+        factors = jax.random.uniform(
+            k_perturb, (n_replace, log_h.shape[1]),
+            minval=log_pf[0], maxval=log_pf[1],
+        )
+        new_rows = jnp.clip(log_h[top] + factors, log_lo, log_hi)
+        log_h = log_h.at[bottom].set(new_rows)
+        return (state, log_h), losses
+
+    @jax.jit
+    def run(seed_arr):
+        base = jax.random.key(seed_arr)
+        k_init, k_rounds = jax.random.split(base)
+        u = jax.random.uniform(k_init, (P, len(names)))
+        log_h0 = log_lo + u * (log_hi - log_lo)  # log-uniform start
+        (state, log_h), loss_hist = jax.lax.scan(
+            train_rounds,
+            (constrain(init_state), log_h0),
+            jax.random.split(k_rounds, n_rounds),
+        )
+        final = loss_hist[-1]
+        # NaN-safe: a member perturbed into divergence in the last round
+        # must not win the argmin (argsort during training already sends
+        # NaNs to the replaced bottom quantile)
+        best_i = jnp.argmin(jnp.where(jnp.isfinite(final), final, jnp.inf))
+        return state, log_h, loss_hist, best_i
+
+    def runner(seed=0):
+        state, log_h, loss_hist, best_i = run(jnp.uint32(int(seed) % 2**32))
+        loss_hist = np.asarray(loss_hist)
+        log_h = np.asarray(log_h)
+        bi = int(best_i)
+        hypers = {n: np.exp(log_h[:, i]) for i, n in enumerate(names)}
+        return {
+            "best_loss": float(loss_hist[-1, bi]),
+            "best_index": bi,
+            "best_hypers": {n: float(v[bi]) for n, v in hypers.items()},
+            "hypers": hypers,
+            "loss_history": loss_hist,
+            "state": state,
+            "n_steps": int(n_rounds * exploit_every),
+        }
+
+    return runner

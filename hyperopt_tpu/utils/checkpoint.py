@@ -122,13 +122,13 @@ def save_obs_buffer_orbax(buf, directory):
         )
     tmp = os.path.join(directory, f".labels.tmp.{os.getpid()}")
     with open(tmp, "w") as f:
-        # capacity + pending length let load build the abstract target
-        # tree orbax wants for a safe (sharding-aware) restore
-        json.dump({
-            "labels": list(buf.space.labels),
-            "capacity": int(buf.capacity),
-            "n_pending": len(buf._pending),
-        }, f)
+        # space-identity sidecar only: all SHAPE information lives in
+        # the orbax tree itself (restore builds its abstract target from
+        # orbax metadata), so a crash between the two writes cannot make
+        # the checkpoint unloadable -- a stale labels.json only matters
+        # if the same directory is reused for a different space, which
+        # load rejects either way
+        json.dump({"labels": list(buf.space.labels)}, f)
     os.replace(tmp, os.path.join(directory, "labels.json"))
     return directory
 
@@ -149,17 +149,21 @@ def load_obs_buffer_orbax(space, directory):
             f"checkpoint labels {meta['labels']} do not match space "
             f"{list(space.labels)}"
         )
-    buf = ObsBuffer(space, capacity=int(meta["capacity"]))
     # restore against an abstract target (restoring target-less is
     # documented as unsafe under shardings different from save time);
-    # scalar leaves must be 0-d arrays to be valid target types
-    target = {k: np.asarray(v) for k, v in _obs_buffer_tree(buf).items()}
-    target["pending"] = np.zeros(1 + int(meta["n_pending"]), dtype=np.int64)
+    # shapes/dtypes come from the orbax tree's own metadata, so the
+    # target always matches what was actually saved
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
+        arrays_dir = os.path.join(directory, "arrays")
+        tree_meta = ckptr.metadata(arrays_dir).item_metadata.tree
+        target = {
+            k: np.zeros(m.shape, np.dtype(m.dtype))
+            for k, m in tree_meta.items()
+        }
         data = ckptr.restore(
-            os.path.join(directory, "arrays"),
-            args=ocp.args.StandardRestore(target),
+            arrays_dir, args=ocp.args.StandardRestore(target)
         )
+    buf = ObsBuffer(space, capacity=int(np.asarray(data["values"]).shape[1]))
     buf.values[:] = data["values"]
     buf.active[:] = data["active"]
     buf.losses[:] = data["losses"]
