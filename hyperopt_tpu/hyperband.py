@@ -1,0 +1,311 @@
+"""Successive halving and Hyperband, host-driven and fused on-device.
+
+Budget-aware HPO schedulers (Li et al., 2018) absent from the reference:
+evaluate many configurations at a small budget, keep the top ``1/eta``
+at each rung, and spend the saved budget deepening the survivors.
+
+Two execution modes, matching the rest of the framework:
+
+* :func:`successive_halving` / :func:`hyperband` -- host drivers over an
+  arbitrary budget-aware objective ``fn(config, budget) -> loss`` (any
+  Python), suggesting rung-0 configurations through the standard algo
+  seam (``rand.suggest`` / ``tpe_jax.suggest`` / ...) and recording
+  every evaluation in a ``Trials`` store (``result["budget"]`` carries
+  the rung budget).
+* :func:`compile_sha` -- successive halving over TRAINING, fused: the
+  population trains ``steps_per_rung`` under a ``lax.scan``, survivors'
+  states/hypers are gathered on-device, and the next (smaller) rung is
+  its own jitted program -- compute really shrinks by ``eta`` per rung,
+  and partially-trained survivors CONTINUE from their state (learning-
+  curve halving, not re-evaluation).  Same train-fn contract as
+  :mod:`hyperopt_tpu.pbt`: ``train_fn(state, hypers, key) -> (state,
+  losses[P])`` with population-leading pytrees.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["successive_halving", "hyperband", "compile_sha"]
+
+
+def _int_log(ratio, eta):
+    """Largest integer k with eta**k <= ratio (float-tolerant: exact
+    eta-powers like 243/1 with eta=3 must count fully -- math.log gives
+    4.9999... there and floor silently drops the max-budget rung)."""
+    k = 0
+    b = 1.0
+    while b * eta <= ratio * (1 + 1e-9):
+        b *= eta
+        k += 1
+    return k
+
+
+def successive_halving(
+    fn,
+    space,
+    max_budget,
+    eta=3,
+    n_configs=None,
+    min_budget=1,
+    algo=None,
+    trials=None,
+    rstate=None,
+):
+    """One successive-halving bracket over a budget-aware objective.
+
+    Args:
+      fn: ``fn(config, budget) -> loss`` (or a dict with ``"loss"``).
+      space: an ``hp.*`` search space.
+      max_budget / min_budget: budget of the last / first rung; rung
+        budgets grow by ``eta`` (ints are kept integral).
+      eta: keep the top ``1/eta`` configurations per rung.
+      n_configs: rung-0 population (default: ``eta ** n_rungs`` so one
+        configuration survives to ``max_budget``).
+      algo: suggest function for rung-0 configs (default random search).
+      trials: optional ``Trials`` store; every evaluation is recorded as
+        a completed trial whose ``result["budget"]`` is its rung budget.
+      rstate: ``np.random.Generator`` (reproducibility contract).
+
+    Returns ``{"best": config, "best_loss": loss, "rungs": [...]}``.
+    """
+    from .base import Domain, Trials
+    from . import rand as rand_mod
+    from .fmin import space_eval
+
+    if rstate is None:
+        rstate = np.random.default_rng()
+    if algo is None:
+        algo = rand_mod.suggest
+    if trials is None:
+        trials = Trials()
+    n_rungs = _int_log(max_budget / min_budget, eta) + 1
+    if n_configs is None:
+        n_configs = eta ** (n_rungs - 1)
+    domain = Domain(fn, space, pass_expr_memo_ctrl=False)
+
+    seed = int(rstate.integers(0, 2**31 - 1))
+    ids = trials.new_trial_ids(n_configs)
+    docs = algo(ids, domain, trials, seed)
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    # mutate the STORED docs (insert may copy) so results land in the
+    # trials store, not in dead suggestion copies
+    tids = {d["tid"] for d in docs}
+    live = [t for t in trials._dynamic_trials if t["tid"] in tids]
+
+    def config_of(doc):
+        vals = {
+            k: v[0] for k, v in doc["misc"]["vals"].items() if len(v) == 1
+        }
+        return space_eval(space, vals)
+
+    import copy as _copy
+
+    rungs = []
+    budget = float(min_budget)
+    integral = isinstance(max_budget, int) and isinstance(min_budget, int)
+    for r in range(n_rungs):
+        b = int(round(budget)) if integral else budget
+        new_ids = trials.new_trial_ids(len(live)) if r > 0 else None
+        scored = []
+        appended = []
+        for j, doc in enumerate(live):
+            loss = fn(config_of(doc), b)
+            if isinstance(loss, dict):
+                loss = loss["loss"]
+            result = {"status": "ok", "loss": float(loss), "budget": b}
+            if r == 0:
+                # rung 0 completes the suggested trials themselves
+                doc["result"] = result
+                doc["state"] = 2  # JOB_STATE_DONE
+                rec = doc
+            else:
+                # promotions append a NEW trial per (config, budget):
+                # lower-rung results stay in the store (learning-curve
+                # history), never overwritten
+                tid = new_ids[j]
+                misc = _copy.deepcopy(doc["misc"])
+                misc["tid"] = tid
+                misc["idxs"] = {
+                    k: ([tid] if v else []) for k, v in misc["idxs"].items()
+                }
+                (rec,) = trials.new_trial_docs(
+                    [tid], [None], [result], [misc]
+                )
+                rec["state"] = 2
+                appended.append(rec)
+            scored.append((float(loss), rec))
+        if appended:
+            trials.insert_trial_docs(appended)
+        trials.refresh()
+        scored.sort(key=lambda t: (not np.isfinite(t[0]), t[0]))
+        rungs.append({
+            "budget": b,
+            "n": len(scored),
+            "best_loss": scored[0][0],
+        })
+        n_keep = max(1, len(scored) // eta)
+        live = [doc for _, doc in scored[:n_keep]]
+        budget *= eta
+    best_loss, best_doc = scored[0]
+    return {
+        "best": config_of(best_doc),
+        "best_loss": best_loss,
+        "rungs": rungs,
+        "trials": trials,
+    }
+
+
+def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
+              rstate=None, trials=None):
+    """Full Hyperband: every bracket of successive halving from the most
+    exploratory (many configs, tiny budget) to a single full-budget
+    bracket, sharing one ``Trials`` store.  Returns the overall best.
+    """
+    from .base import Trials
+
+    if rstate is None:
+        rstate = np.random.default_rng()
+    if trials is None:
+        trials = Trials()
+    s_max = _int_log(max_budget / min_budget, eta)
+    best = None
+    brackets = []
+    for s in range(s_max, -1, -1):
+        n = int(math.ceil((s_max + 1) * eta**s / (s + 1)))
+        out = successive_halving(
+            fn, space,
+            max_budget=max_budget,
+            min_budget=max_budget / eta**s,
+            eta=eta,
+            n_configs=n,
+            algo=algo,
+            trials=trials,
+            rstate=rstate,
+        )
+        brackets.append({"s": s, **{k: out[k] for k in ("rungs",)}})
+        if best is None or out["best_loss"] < best["best_loss"]:
+            best = out
+    return {
+        "best": best["best"],
+        "best_loss": best["best_loss"],
+        "brackets": brackets,
+        "trials": trials,
+    }
+
+
+def compile_sha(
+    train_fn,
+    init_state,
+    hyper_bounds,
+    n_configs,
+    eta=2,
+    steps_per_rung=5,
+    n_rungs=None,
+    mesh=None,
+    trial_axis="trial",
+):
+    """Successive halving over TRAINING, on-device.
+
+    Rung r trains its (shrinking) population ``steps_per_rung * eta**r``
+    steps under one jitted scan, then the top ``1/eta`` survivors'
+    states AND hyperparameters are gathered on-device into the next
+    rung's (statically smaller) program -- per-rung compute genuinely
+    shrinks, and survivors continue from their trained state rather
+    than restarting (learning-curve halving).  Hyperparameters sample
+    log-uniformly from ``hyper_bounds`` at rung 0, as in
+    :func:`hyperopt_tpu.pbt.compile_pbt` (same ``train_fn`` contract).
+
+    ``n_configs`` must be a power of ``eta`` (every rung's population
+    stays mesh-divisible); ``n_rungs`` defaults to halving down to one
+    survivor.  Returns ``runner(seed=0) -> {"best_loss", "best_hypers",
+    "rungs": [{"n", "steps", "best_loss"}...], "state"}``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .pbt import _hypers_dict, _log_bounds, _make_constrain
+
+    P0 = int(n_configs)
+    max_rungs = int(round(math.log(P0, eta)))
+    if eta**max_rungs != P0:
+        raise ValueError(f"n_configs={P0} must be a power of eta={eta}")
+    if n_rungs is None:
+        n_rungs = max_rungs + 1
+    if not 1 <= n_rungs <= max_rungs + 1:
+        raise ValueError(
+            f"n_rungs={n_rungs} must be in [1, {max_rungs + 1}] for "
+            f"n_configs={P0}, eta={eta}"
+        )
+    names, log_lo, log_hi = _log_bounds(hyper_bounds)
+    constrain = _make_constrain(mesh, trial_axis)
+
+    @jax.jit
+    def init_hypers(key):
+        u = jax.random.uniform(key, (P0, len(names)))
+        return log_lo + u * (log_hi - log_lo)
+
+    # one jitted program per rung, built ONCE (the schedule is static);
+    # rebuilding inside runner would re-jit every rung on every call
+    def make_rung(n_steps):
+        def rung(state, log_h, key):
+            keys = jax.random.split(key, n_steps)
+
+            def step(state, k):
+                state, losses = train_fn(state, _hypers_dict(log_h, names), k)
+                return constrain(state), losses
+
+            state, losses_seq = jax.lax.scan(step, state, keys)
+            losses = losses_seq[-1]
+            keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
+            order = jnp.argsort(keyed)
+            return state, losses, order
+
+        return jax.jit(rung)
+
+    rung_fns = [
+        make_rung(int(steps_per_rung) * eta**r) for r in range(n_rungs)
+    ]
+
+    def runner(seed=0):
+        base = jax.random.key(int(seed) % 2**32)
+        k_init, *rung_keys = jax.random.split(base, n_rungs + 1)
+        log_h = init_hypers(k_init)
+        state = constrain(init_state)
+        rungs = []
+        n_live = P0
+        steps = int(steps_per_rung)
+        for r in range(n_rungs):
+            state, losses, order = rung_fns[r](state, log_h, rung_keys[r])
+            losses_np = np.asarray(losses)
+            order_np = np.asarray(order)
+            rungs.append({
+                "n": n_live,
+                "steps": steps,
+                "best_loss": float(losses_np[order_np[0]]),
+            })
+            if r == n_rungs - 1:
+                best_i = int(order_np[0])
+                return {
+                    "best_loss": float(losses_np[best_i]),
+                    "best_hypers": {
+                        n: float(np.exp(np.asarray(log_h)[best_i, i]))
+                        for i, n in enumerate(names)
+                    },
+                    "rungs": rungs,
+                    "state": state,
+                    "best_index": best_i,
+                }
+            keep = order[: n_live // eta]  # device-side gather
+            state = jax.tree.map(lambda x: x[keep], state)
+            log_h = log_h[keep]
+            n_live //= eta
+            steps *= eta
+
+    return runner

@@ -114,6 +114,16 @@ def _sgd_update(params, momentum, grads, lr, wd):
     return new_params, new_momentum
 
 
+def _member_train_step(loss_fn, params, momentum, lr, wd, tokens):
+    """ONE member's gradient step -- the single definition shared by the
+    population step and the PBT/SHA train fn (loss reported pre-update)."""
+    import jax
+
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+    params, momentum = _sgd_update(params, momentum, grads, lr, wd)
+    return params, momentum, loss
+
+
 def make_population_train_step(model, mesh=None, trial_axis="trial",
                                data_axis="cand"):
     """Build ``train_step(pop_params, pop_opt, lr, wd, tokens)``.
@@ -123,18 +133,15 @@ def make_population_train_step(model, mesh=None, trial_axis="trial",
     shards over ``trial_axis`` and the token batch over ``data_axis``
     (sharding constraints; GSPMD inserts the collectives).
     """
+    import functools
+
     import jax
 
     loss_fn = _next_token_loss_fn(model)
-
-    def one_member_step(params, momentum, lr, wd, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
-        new_params, new_momentum = _sgd_update(
-            params, momentum, grads, lr, wd
-        )
-        return new_params, new_momentum, loss
-
-    pop_step = jax.vmap(one_member_step, in_axes=(0, 0, 0, 0, None))
+    pop_step = jax.vmap(
+        functools.partial(_member_train_step, loss_fn),
+        in_axes=(0, 0, 0, 0, None),
+    )
 
     if mesh is None:
         return jax.jit(pop_step)
@@ -169,15 +176,11 @@ def make_pbt_train_fn(model, batch_size=16, seq_len=16, vocab=16):
         tokens = synthetic_token_batch(
             key, batch_size, seq_len, vocab, n_deltas=min(8, vocab - 1)
         )
-
-        def member(p, m, lr, wd):
-            loss, grads = jax.value_and_grad(loss_fn)(p, tokens)
-            p, m = _sgd_update(p, m, grads, lr, wd)
-            return p, m, loss
-
-        params, momentum, losses = jax.vmap(member)(
-            params, momentum, hypers["lr"], hypers["wd"]
-        )
+        params, momentum, losses = jax.vmap(
+            lambda p, m, lr, wd: _member_train_step(
+                loss_fn, p, m, lr, wd, tokens
+            )
+        )(params, momentum, hypers["lr"], hypers["wd"])
         return (params, momentum), losses
 
     return train_fn

@@ -33,6 +33,48 @@ import numpy as np
 __all__ = ["compile_pbt"]
 
 
+def _log_bounds(hyper_bounds):
+    """Validate ``{name: (low, high)}`` and return (names, log_lo, log_hi)
+    as device arrays -- shared by every population-scheduler module
+    (:mod:`hyperopt_tpu.pbt`, :mod:`hyperopt_tpu.hyperband`)."""
+    import jax.numpy as jnp
+
+    names = sorted(hyper_bounds)
+    lo = np.array([float(hyper_bounds[n][0]) for n in names])
+    hi = np.array([float(hyper_bounds[n][1]) for n in names])
+    if not (lo > 0).all() or not (hi > lo).all():
+        raise ValueError("hyper_bounds must satisfy 0 < low < high")
+    return (
+        names,
+        jnp.asarray(np.log(lo), jnp.float32),
+        jnp.asarray(np.log(hi), jnp.float32),
+    )
+
+
+def _hypers_dict(log_h, names):
+    import jax.numpy as jnp
+
+    return {n: jnp.exp(log_h[:, i]) for i, n in enumerate(names)}
+
+
+def _make_constrain(mesh, trial_axis):
+    """Population-axis sharding constraint (identity without a mesh)."""
+    import jax
+
+    if mesh is None:
+        return lambda state: state
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    sharding = NamedSharding(mesh, Pspec(trial_axis))
+
+    def constrain(state):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, sharding), state
+        )
+
+    return constrain
+
+
 def compile_pbt(
     train_fn,
     init_state,
@@ -75,38 +117,19 @@ def compile_pbt(
     import jax.numpy as jnp
 
     P = int(pop_size)
-    names = sorted(hyper_bounds)
-    lo = np.array([float(hyper_bounds[n][0]) for n in names])
-    hi = np.array([float(hyper_bounds[n][1]) for n in names])
-    if not (lo > 0).all() or not (hi > lo).all():
-        raise ValueError("hyper_bounds must satisfy 0 < low < high")
+    names, log_lo, log_hi = _log_bounds(hyper_bounds)
     n_replace = max(1, int(round(P * float(exploit_quantile))))
     if 2 * n_replace > P:
         raise ValueError(
             f"exploit_quantile={exploit_quantile} replaces {n_replace} of "
             f"{P} members; top and bottom quantiles must not overlap"
         )
-    log_lo = jnp.asarray(np.log(lo), jnp.float32)  # [H]
-    log_hi = jnp.asarray(np.log(hi), jnp.float32)
     log_pf = (float(np.log(perturb_factors[0])),
               float(np.log(perturb_factors[1])))
-
-    if mesh is not None:
-        from jax.sharding import NamedSharding, PartitionSpec as Pspec
-
-        pop_sharding = NamedSharding(mesh, Pspec(trial_axis))
-
-        def constrain(state):
-            return jax.tree.map(
-                lambda x: jax.lax.with_sharding_constraint(x, pop_sharding),
-                state,
-            )
-    else:
-        def constrain(state):
-            return state
+    constrain = _make_constrain(mesh, trial_axis)
 
     def hypers_dict(log_h):
-        return {n: jnp.exp(log_h[:, i]) for i, n in enumerate(names)}
+        return _hypers_dict(log_h, names)
 
     def train_rounds(carry, key):
         """exploit_every train steps, then one exploit/explore event."""

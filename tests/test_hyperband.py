@@ -1,0 +1,192 @@
+"""Successive halving + Hyperband (hyperopt_tpu.hyperband)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hyperopt_tpu import Trials, hp
+from hyperopt_tpu.hyperband import compile_sha, hyperband, successive_halving
+
+
+def budgeted_quad(cfg, budget):
+    """Noisy-at-low-budget quadratic: the noise std shrinks with budget,
+    so halving must promote genuinely good configs despite rung-0 noise."""
+    rng = np.random.default_rng(int(1e6 * (cfg["x"] % 1)) % 2**31)
+    return (cfg["x"] - 3.0) ** 2 + rng.normal(0, 1.0 / budget)
+
+
+SPACE = {"x": hp.uniform("x", -10.0, 10.0)}
+
+
+def test_successive_halving_promotes_and_records():
+    trials = Trials()
+    out = successive_halving(
+        budgeted_quad, SPACE, max_budget=9, min_budget=1, eta=3,
+        trials=trials, rstate=np.random.default_rng(0),
+    )
+    assert [r["budget"] for r in out["rungs"]] == [1, 3, 9]
+    assert [r["n"] for r in out["rungs"]] == [9, 3, 1]
+    assert out["best_loss"] < 4.0  # beats a typical random draw (~30)
+    assert "x" in out["best"]
+    # EVERY evaluation is its own recorded trial (promotions append, the
+    # lower-rung learning-curve history survives): 9 + 3 + 1 = 13
+    assert len(trials) == 13
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert sorted(budgets) == [1] * 9 + [3] * 3 + [9]
+    # a promoted config's rung-0 loss is still in the store alongside
+    # its rung-1 loss (same x value, different budgets)
+    x_of = lambda t: t["misc"]["vals"]["x"][0]
+    promoted = [x_of(t) for t in trials.trials if t["result"]["budget"] == 3]
+    rung0_x = [x_of(t) for t in trials.trials if t["result"]["budget"] == 1]
+    assert all(any(np.isclose(p, x) for x in rung0_x) for p in promoted)
+
+
+def test_successive_halving_exact_eta_power_reaches_max_budget():
+    """Float-log regression: an exact eta-power budget span must count
+    every rung (math.log(8, 2) = 2.9999... floors to 2 and silently
+    drops the max-budget rung)."""
+    out = successive_halving(
+        lambda cfg, b: (cfg["x"] - 3.0) ** 2 / b, SPACE,
+        max_budget=8, min_budget=1, eta=2,
+        rstate=np.random.default_rng(0),
+    )
+    assert [r["budget"] for r in out["rungs"]] == [1, 2, 4, 8]
+    assert [r["n"] for r in out["rungs"]] == [8, 4, 2, 1]
+
+
+def test_successive_halving_reproducible():
+    def run():
+        out = successive_halving(
+            budgeted_quad, SPACE, max_budget=9, eta=3,
+            rstate=np.random.default_rng(5),
+        )
+        return out["best_loss"], out["best"]["x"]
+
+    assert run() == run()
+
+
+def test_hyperband_brackets_share_trials_and_find_optimum():
+    out = hyperband(
+        budgeted_quad, SPACE, max_budget=9, eta=3,
+        rstate=np.random.default_rng(1),
+    )
+    assert len(out["brackets"]) == 3  # s = 2, 1, 0
+    assert out["best_loss"] < 2.0
+    # the shared store saw every bracket's evaluations
+    assert len(out["trials"]) >= 9 + 5 + 3
+
+
+def test_hyperband_with_tpe_rung0():
+    """Rung-0 configurations can come from any suggest algo (the plugin
+    seam): TPE-seeded halving runs end-to-end."""
+    from hyperopt_tpu import tpe_jax
+
+    out = successive_halving(
+        budgeted_quad, SPACE, max_budget=4, eta=2, n_configs=8,
+        algo=tpe_jax.suggest, rstate=np.random.default_rng(2),
+    )
+    assert np.isfinite(out["best_loss"])
+    assert [r["n"] for r in out["rungs"]] == [8, 4, 2]
+
+
+# ---------------------------------------------------------------------------
+# fused on-device SHA
+# ---------------------------------------------------------------------------
+
+
+def linear_train_fn(state, hypers, key):
+    """theta' = theta - lr*grad on (theta-0.7)^2; divergent for lr > 1."""
+    theta = state["theta"] - hypers["lr"] * 2.0 * (state["theta"] - 0.7)
+    return {"theta": theta}, (theta - 0.7) ** 2
+
+
+def test_compile_sha_halves_and_continues_training():
+    P = 8
+    runner = compile_sha(
+        linear_train_fn,
+        {"theta": jnp.full((P,), 5.0)},
+        {"lr": (1e-3, 5.0)},  # includes divergent lrs
+        n_configs=P,
+        eta=2,
+        steps_per_rung=3,
+    )
+    out = runner(seed=0)
+    assert [r["n"] for r in out["rungs"]] == [8, 4, 2, 1]
+    assert [r["steps"] for r in out["rungs"]] == [3, 6, 12, 24]
+    # survivors carried their trained theta: the final member has seen
+    # 3+6+12+24 = 45 total steps; with a sane lr that converges
+    assert out["best_loss"] < 1e-3
+    assert np.isfinite(out["best_loss"])
+    assert 1e-3 <= out["best_hypers"]["lr"] <= 5.0
+
+
+def test_compile_sha_drops_divergent_members():
+    """inf/NaN losses must rank LAST at every rung: with a log-uniform
+    lr draw spanning stable (< 1) and violently divergent (up to 50)
+    members, a stable member must win every seed."""
+    P = 8
+
+    def explosive(state, hypers, key):
+        theta = state["theta"] - hypers["lr"] * 2.0 * (state["theta"] - 0.7)
+        # lr > 1 explodes to inf within a few steps from theta=1e4
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    runner = compile_sha(
+        explosive,
+        {"theta": jnp.full((P,), 1e4)},
+        {"lr": (0.01, 50.0)},
+        n_configs=P,
+        eta=2,
+        steps_per_rung=4,
+    )
+    for seed in range(3):
+        out = runner(seed=seed)
+        assert np.isfinite(out["best_loss"])
+        assert out["best_hypers"]["lr"] < 1.0  # a stable member won
+
+
+def test_compile_sha_reproducible():
+    runner = compile_sha(
+        linear_train_fn, {"theta": jnp.full((4,), 2.0)},
+        {"lr": (1e-3, 1.0)}, n_configs=4, eta=2, steps_per_rung=2,
+    )
+    a = runner(seed=7)
+    b = runner(seed=7)
+    assert a["best_loss"] == b["best_loss"]
+    assert a["best_hypers"] == b["best_hypers"]
+
+
+def test_compile_sha_validates():
+    with pytest.raises(ValueError, match="power of eta"):
+        compile_sha(linear_train_fn, {"theta": jnp.zeros((6,))},
+                    {"lr": (1e-3, 1.0)}, n_configs=6, eta=2)
+    with pytest.raises(ValueError, match="0 < low < high"):
+        compile_sha(linear_train_fn, {"theta": jnp.zeros((4,))},
+                    {"lr": (1.0, 0.5)}, n_configs=4)
+
+
+def test_compile_sha_transformer_rungs():
+    """SHA over real LM training: rung budgets deepen survivors and the
+    final loss improves on rung-0's best."""
+    from hyperopt_tpu.models import transformer
+
+    P = 8
+    model = transformer.TinyLM(vocab=16, d_model=16, n_heads=2,
+                               n_layers=1, max_len=16)
+    params = transformer.init_population(
+        model, P, jax.random.key(0), seq_len=16
+    )
+    momentum = jax.tree.map(jnp.zeros_like, params)
+    train_fn = transformer.make_pbt_train_fn(
+        model, batch_size=8, seq_len=16, vocab=16
+    )
+    runner = compile_sha(
+        train_fn, (params, momentum),
+        {"lr": (1e-3, 1.0), "wd": (1e-7, 1e-2)},
+        n_configs=P, eta=2, steps_per_rung=3,
+    )
+    out = runner(seed=0)
+    assert np.isfinite(out["best_loss"])
+    assert out["best_loss"] <= out["rungs"][0]["best_loss"]
