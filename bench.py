@@ -205,6 +205,48 @@ def bench_device_loop(n_evals=8192, batch=128):
         return None
 
 
+def bench_pbt(pop=32, exploit_every=5, n_rounds=10):
+    """Secondary metric: Population-Based Training member-steps/s on the
+    transformer family (the during-training scheduler the reference's
+    independent-trial model cannot express -- BASELINE.md round 3).
+    Returns (member_steps_per_sec, final_population_median_loss)."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from hyperopt_tpu.models import transformer
+        from hyperopt_tpu.pbt import compile_pbt
+
+        model = transformer.TinyLM(vocab=32, d_model=32, n_heads=2,
+                                   n_layers=2, max_len=32)
+        params = transformer.init_population(
+            model, pop, jax.random.key(0), seq_len=32
+        )
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        train_fn = transformer.make_pbt_train_fn(
+            model, batch_size=32, seq_len=32, vocab=32
+        )
+        runner = compile_pbt(
+            train_fn, (params, momentum),
+            {"lr": (1e-4, 1.0), "wd": (1e-7, 1e-2)},
+            pop_size=pop, exploit_every=exploit_every, n_rounds=n_rounds,
+        )
+        runner(seed=99)  # compile
+        t0 = time.perf_counter()
+        out = runner(seed=0)
+        dt = time.perf_counter() - t0
+        rate = pop * exploit_every * n_rounds / dt
+        # nanmedian: a member perturbed into divergence in the last
+        # window must not turn the JSON field into bare NaN
+        return rate, float(np.nanmedian(out["loss_history"][-1]))
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_pbt failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None, None
+
+
 def bench_best_at_1k(n_trials=1000, seed=7, speculative=0):
     """BASELINE.json's second headline metric: wall-clock to best-loss @
     1k trials on the 20-dim mixed space -- a realistic suggest->evaluate
@@ -324,9 +366,11 @@ def main():
         dls_sec_1k, dls_best_1k, dls_n = bench_best_at_1k_device_loop(
             n_trials=n_trials_1k, n_cand=n_cand, batch_size=1
         )
+        pbt_rate, pbt_median = bench_pbt()
     else:
         dl_sec_1k, dl_best_1k, dl_n = None, None, 0
         dls_sec_1k, dls_best_1k, dls_n = None, None, 0
+        pbt_rate, pbt_median = None, None
     rtt_ms = bench_rtt()
 
     print(
@@ -365,6 +409,12 @@ def main():
                     round(dls_best_1k, 5) if dls_best_1k is not None else None
                 ),
                 "device_loop_seq_n_trials": dls_n,
+                "pbt_member_steps_per_sec": (
+                    round(pbt_rate, 1) if pbt_rate else None
+                ),
+                "pbt_final_median_loss": (
+                    round(pbt_median, 4) if pbt_median is not None else None
+                ),
                 "rtt_ms": round(rtt_ms, 2),
                 "batch": batch,
                 "n_EI_candidates": n_cand,

@@ -31,7 +31,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["successive_halving", "hyperband", "compile_sha"]
+__all__ = ["successive_halving", "hyperband", "compile_sha", "budget_aware"]
 
 
 def _int_log(ratio, eta):
@@ -198,6 +198,54 @@ def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
         "brackets": brackets,
         "trials": trials,
     }
+
+
+def budget_aware(base_algo=None, min_obs=8):
+    """BOHB-style model fitting for rung-0 suggestions.
+
+    Losses evaluated at different budgets are not comparable (a cheap
+    noisy rung's losses would pollute the posterior), so the wrapped
+    algo fits its model ONLY on observations from the highest budget
+    with at least ``min_obs`` completed trials (falling back to the
+    most-populated budget, then to everything, while data is scarce) --
+    the model-fitting rule of BOHB (Falkner et al., 2018) on top of any
+    suggest algo at the standard plugin seam.
+
+        hyperband(fn, space, max_budget=81,
+                  algo=budget_aware(tpe_jax.suggest))
+    """
+    from collections import Counter
+
+    from .base import trials_from_docs
+
+    def algo(new_ids, domain, trials, seed, **kw):
+        nonlocal base_algo
+        if base_algo is None:
+            from . import tpe_jax
+
+            base_algo = tpe_jax.suggest
+        counts = Counter(
+            t["result"]["budget"]
+            for t in trials.trials
+            if t.get("result")
+            and t["result"].get("loss") is not None
+            and t["result"].get("budget") is not None
+        )
+        if counts:
+            eligible = [b for b, c in counts.items() if c >= min_obs]
+            target = max(eligible) if eligible else max(
+                counts, key=lambda b: (counts[b], b)
+            )
+            docs = [
+                t for t in trials.trials
+                if t.get("result") is not None
+                and t["result"].get("budget") == target
+            ]
+            filtered = trials_from_docs(docs, validate=False)
+            return base_algo(new_ids, domain, filtered, seed, **kw)
+        return base_algo(new_ids, domain, trials, seed, **kw)
+
+    return algo
 
 
 def compile_sha(

@@ -91,6 +91,67 @@ def test_hyperband_with_tpe_rung0():
     assert [r["n"] for r in out["rungs"]] == [8, 4, 2]
 
 
+def test_budget_aware_filters_to_deepest_informative_rung():
+    """BOHB model-fitting rule: the wrapped algo must see ONLY the
+    highest budget with >= min_obs observations (cross-budget losses
+    are not comparable), falling back to the most-populated budget
+    while data is scarce."""
+    from hyperopt_tpu import rand
+    from hyperopt_tpu.base import Domain
+    from hyperopt_tpu.hyperband import budget_aware
+
+    seen = []
+
+    def recording_algo(new_ids, domain, trials, seed, **kw):
+        seen.append(sorted(
+            t["result"]["budget"] for t in trials.trials if t.get("result")
+        ))
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    domain = Domain(lambda cfg: 0.0, SPACE)
+    trials = Trials()
+    docs = rand.suggest(trials.new_trial_ids(12), domain, trials, seed=0)
+    for i, d in enumerate(docs):
+        d["state"] = 2
+        # 9 obs at budget 1, 3 at budget 3
+        d["result"] = {"status": "ok", "loss": float(i),
+                       "budget": 1 if i < 9 else 3}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+
+    algo = budget_aware(recording_algo, min_obs=8)
+    algo(trials.new_trial_ids(1), domain, trials, seed=1)
+    assert seen[-1] == [1] * 9  # budget 3 has only 3 obs -> use budget 1
+
+    # once the deeper rung accumulates min_obs, it takes over
+    more = rand.suggest(trials.new_trial_ids(6), domain, trials, seed=2)
+    for d in more:
+        d["state"] = 2
+        d["result"] = {"status": "ok", "loss": 1.0, "budget": 3}
+    trials.insert_trial_docs(more)
+    trials.refresh()
+    algo(trials.new_trial_ids(1), domain, trials, seed=3)
+    assert seen[-1] == [3] * 9
+
+    # budget-free stores pass through untouched
+    plain = Trials()
+    algo(plain.new_trial_ids(1), domain, plain, seed=4)
+    assert seen[-1] == []
+
+
+def test_budget_aware_tpe_end_to_end():
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.hyperband import budget_aware
+
+    out = hyperband(
+        budgeted_quad, SPACE, max_budget=9, eta=3,
+        algo=budget_aware(tpe_jax.suggest, min_obs=4),
+        rstate=np.random.default_rng(3),
+    )
+    assert np.isfinite(out["best_loss"])
+    assert out["best_loss"] < 2.0
+
+
 # ---------------------------------------------------------------------------
 # fused on-device SHA
 # ---------------------------------------------------------------------------
