@@ -228,6 +228,27 @@ def test_compile_sha_validates():
                     {"lr": (1.0, 0.5)}, n_configs=4)
 
 
+def test_compile_sha_mesh_sharded_rungs():
+    """SHA under a population mesh: rung populations shrink below the
+    axis size (8 -> 4 -> 2 -> 1 on an 8-device mesh) and GSPMD handles
+    the uneven shards; results stay correct."""
+    from hyperopt_tpu.parallel.mesh import mesh_from_spec
+
+    mesh = mesh_from_spec((8,), ("trial",))
+    runner = compile_sha(
+        linear_train_fn,
+        {"theta": jnp.full((8,), 5.0)},
+        {"lr": (1e-3, 1.0)},
+        n_configs=8,
+        eta=2,
+        steps_per_rung=3,
+        mesh=mesh,
+    )
+    out = runner(seed=0)
+    assert [r["n"] for r in out["rungs"]] == [8, 4, 2, 1]
+    assert out["best_loss"] < 1e-3
+
+
 def test_compile_sha_transformer_rungs():
     """SHA over real LM training: rung budgets deepen survivors and the
     final loss improves on rung-0's best."""
