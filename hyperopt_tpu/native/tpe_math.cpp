@@ -49,7 +49,14 @@ void ht_gmm_lpdf(const double* x, int64_t S, const double* w,
                  const double* mu, const double* sigma, int64_t K,
                  double low, double high, double q, int32_t logspace,
                  double* out) {
-  std::vector<double> logw(K), log_mass(K), inv_sig(K);
+  // Per-component constants hoisted out of the S*K loops; the former
+  // running pairwise log-sum-exp paid 2 exp + 1 log PER TERM, which is
+  // why numpy's vectorized single-max pass overtook this path at large
+  // K (measured: 0.83x at 2,500 obs).  Continuous: c1 folds every
+  // additive term, inner loop is one fused z^2 (pass 1) + one exp
+  // (pass 2).  Quantized: weights/mass accumulate in LINEAR space
+  // (masses are non-negative), one log per sample.
+  std::vector<double> logw(K), log_mass(K), inv_sig(K), c1(K), wmass(K);
   double wsum = 0.0;
   for (int64_t k = 0; k < K; ++k) wsum += w[k];
   if (wsum <= 0.0) wsum = 1.0;
@@ -58,35 +65,47 @@ void ht_gmm_lpdf(const double* x, int64_t S, const double* w,
     logw[k] = std::log(std::max(wk, kTiny));
     double a = std::isinf(low) ? 0.0 : normal_cdf(low, mu[k], sigma[k]);
     double b = std::isinf(high) ? 1.0 : normal_cdf(high, mu[k], sigma[k]);
-    log_mass[k] = std::log(std::max(b - a, kEps));
+    double mass_k = std::max(b - a, kEps);
+    log_mass[k] = std::log(mass_k);
     inv_sig[k] = 1.0 / std::max(sigma[k], kEps);
+    c1[k] = logw[k] + std::log(inv_sig[k]) - kLogSqrt2Pi - log_mass[k];
+    wmass[k] = wk / mass_k;
   }
 
+  std::vector<double> t(K);
   for (int64_t s = 0; s < S; ++s) {
-    double acc = -INFINITY;
     if (q <= 0.0) {
       double lat = logspace ? std::log(std::max(x[s], kTiny)) : x[s];
       double jac = logspace ? lat : 0.0;
-      for (int64_t k = 0; k < K; ++k) {
+      double m = -INFINITY;
+      for (int64_t k = 0; k < K; ++k) {  // pass 1: terms + max (no exp)
         double z = (lat - mu[k]) * inv_sig[k];
-        double t = logw[k] - 0.5 * z * z + std::log(inv_sig[k]) -
-                   kLogSqrt2Pi - log_mass[k];
-        acc = log_sum_exp_pair(acc, t);
+        double tk = c1[k] - 0.5 * z * z;
+        t[k] = tk;
+        if (tk > m) m = tk;
       }
-      out[s] = acc - jac;
+      if (m == -INFINITY) {
+        out[s] = -INFINITY;
+        continue;
+      }
+      double sum = 0.0;
+      for (int64_t k = 0; k < K; ++k) sum += std::exp(t[k] - m);
+      out[s] = m + std::log(sum) - jac;
     } else {
       double ub = x[s] + q / 2.0, lb = x[s] - q / 2.0;
       double ub_lat = logspace ? std::log(std::max(ub, kEps)) : ub;
       double lb_lat = logspace ? std::log(std::max(lb, kEps)) : lb;
       if (!std::isinf(high)) ub_lat = std::min(ub_lat, high);
       if (!std::isinf(low)) lb_lat = std::max(lb_lat, low);
+      double p = 0.0;
       for (int64_t k = 0; k < K; ++k) {
         double mass = normal_cdf(ub_lat, mu[k], sigma[k]) -
                       normal_cdf(lb_lat, mu[k], sigma[k]);
-        double t = logw[k] + std::log(std::max(mass, kEps)) - log_mass[k];
-        acc = log_sum_exp_pair(acc, t);
+        // per-component kEps floor: exact numpy-oracle parity
+        // (GMM1_lpdf_numpy clamps each bin mass at EPS before the log)
+        p += wmass[k] * std::max(mass, kEps);
       }
-      out[s] = acc;
+      out[s] = std::log(std::max(p, kTiny));
     }
   }
 }
