@@ -38,7 +38,40 @@ import numpy as np
 
 from .ops.compile import compile_space
 
-__all__ = ["fmin_on_device", "compile_fmin"]
+__all__ = ["fmin_on_device", "compile_fmin", "history_from_trials"]
+
+
+def history_from_trials(space, trials):
+    """Convert a host ``Trials`` store into a ``runner(init=...)`` dict.
+
+    The bridge from the host-driven world to the on-device loop: run (or
+    resume) an experiment through ``fmin`` / an async backend, then
+    continue it on-device --
+
+        hist = history_from_trials(space, trials)
+        runner = compile_fmin(fn, space, max_evals=1000,
+                              warm_capacity=hist["losses"].shape[0])
+        out = runner(init=hist)
+
+    Ingestion IS the suggest paths' dense mirror
+    (:class:`hyperopt_tpu.jax_trials.ObsBuffer`): only posterior-
+    eligible trials enter (completed, status-ok, finite loss), in tid
+    order -- one implementation, so warm-started device runs can never
+    see a different posterior than the suggest paths.  ``space`` may be
+    an ``hp.*`` space or a ``PackedSpace``.
+    """
+    from .jax_trials import ObsBuffer
+    from .ops.compile import PackedSpace
+
+    ps = space if isinstance(space, PackedSpace) else compile_space(space)
+    buf = ObsBuffer(ps)
+    buf.sync(trials)
+    n = buf.count
+    return {
+        "values": buf.values[:, :n].copy(),
+        "active": buf.active[:, :n].copy(),
+        "losses": buf.losses[:n].copy(),
+    }
 
 
 def _round_up(n, m):

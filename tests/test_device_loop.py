@@ -62,6 +62,42 @@ def test_device_loop_sequential_beats_population_at_equal_budget():
     assert np.mean(seq_bests) < np.mean(pop_bests), (seq_bests, pop_bests)
 
 
+def test_history_from_trials_warm_starts_device_loop():
+    """A host-driven fmin history continues ON-DEVICE: the bridge keeps
+    only posterior-eligible trials in tid order, the warm trials count
+    toward startup and feed the posterior, and the resumed run improves
+    on (or matches) the warm best."""
+    from hyperopt_tpu import Trials, fmin, rand
+    from hyperopt_tpu.base import JOB_STATE_ERROR
+    from hyperopt_tpu.device_loop import history_from_trials
+
+    trials = Trials()
+    fmin(
+        lambda cfg: (cfg["x"] - 1.0) ** 2
+        + (np.log(cfg["y"]) - np.log(0.1)) ** 2,
+        quad_space(), algo=rand.suggest, max_evals=40, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        return_argmin=False,
+    )
+    # poison two docs: one errored, one NaN -- neither may enter
+    trials._dynamic_trials[3]["state"] = JOB_STATE_ERROR
+    trials._dynamic_trials[7]["result"]["loss"] = float("nan")
+    trials.refresh()
+
+    hist = history_from_trials(quad_space(), trials)
+    assert hist["losses"].shape == (38,)
+    assert np.isfinite(hist["losses"]).all()
+    host_best = float(hist["losses"].min())
+
+    runner = compile_fmin(
+        quad_obj, quad_space(), max_evals=64, batch_size=1,
+        warm_capacity=64,
+    )
+    out = runner(seed=0, init=hist)
+    assert out["n_total"] == 38 + 64
+    assert out["best_loss"] <= host_best + 1e-6
+
+
 def test_device_loop_hpo_over_lm_training():
     """The whole experiment INCLUDING per-trial model training as one
     XLA program: each trial trains its own TinyLM (lax.fori_loop SGD

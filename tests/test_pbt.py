@@ -108,6 +108,54 @@ def test_pbt_validates_quantile_and_bounds():
         )
 
 
+def test_pbt_sha_config_fuzz():
+    """Randomized scheduler configs: every valid (pop, quantile, rounds,
+    bounds) combination must produce finite, shape-correct, in-bounds
+    results -- no silent NaN/shape corruption at odd sizes."""
+    from hyperopt_tpu.hyperband import compile_sha
+
+    rng = np.random.default_rng(0)
+    for trial in range(8):
+        P = int(rng.choice([2, 3, 4, 6, 8]))
+        lo = float(10 ** rng.uniform(-4, -1))
+        hi = lo * float(10 ** rng.uniform(0.5, 2))
+        q = float(rng.uniform(0.1, 0.49))
+        runner = compile_pbt(
+            quadratic_train_fn(),
+            {"theta": jnp.full((P,), float(rng.uniform(-5, 5)))},
+            {"lr": (lo, hi)},
+            pop_size=P,
+            exploit_every=int(rng.integers(1, 5)),
+            n_rounds=int(rng.integers(1, 6)),
+            exploit_quantile=q,
+        )
+        out = runner(seed=trial)
+        assert out["loss_history"].shape[1] == P
+        lr = out["hypers"]["lr"]
+        # relative tolerance: hypers clip in float32 LOG space, so the
+        # exp roundtrip misses the bound by up to ~1e-6 relative
+        assert (lr >= lo * (1 - 1e-5)).all() and (lr <= hi * (1 + 1e-5)).all()
+        assert np.isfinite(list(out["best_hypers"].values())).all()
+
+    for trial in range(6):
+        eta = int(rng.choice([2, 3]))
+        k = int(rng.integers(1, 3 if eta == 3 else 4))
+        P = eta**k
+        runner = compile_sha(
+            quadratic_train_fn(),
+            {"theta": jnp.full((P,), 3.0)},
+            {"lr": (1e-3, 1.0)},
+            n_configs=P,
+            eta=eta,
+            steps_per_rung=int(rng.integers(1, 4)),
+        )
+        out = runner(seed=trial)
+        ns = [r["n"] for r in out["rungs"]]
+        assert ns[0] == P and ns[-1] == 1
+        assert all(a // eta == b for a, b in zip(ns, ns[1:]))
+        assert np.isfinite(out["best_loss"])
+
+
 def test_pbt_transformer_population():
     """PBT over real model training: a TinyLM population's next-token
     loss improves and the schedule stays finite end-to-end."""
