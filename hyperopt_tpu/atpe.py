@@ -45,6 +45,12 @@ def _ok_trials(trials):
 CAT_DISTS = ("randint", "categorical", "randint_via_categorical")
 
 
+def _tpe_jax_cat_default():
+    from . import tpe_jax
+
+    return tpe_jax._default_n_EI_candidates_cat
+
+
 def _pure_categorical(domain):
     """True when every dim is categorical-family -- the regime where
     ATPE's heuristics measured neutral-to-harmful (BASELINE.md).  Single
@@ -146,8 +152,9 @@ class ATPEOptimizer:
             # consumed by the jax engine's per-family sweep; the host
             # parity path reads the other fields explicitly and ignores
             # this key (its single n_EI applies to every dim, anchored
-            # at the reference's 24)
-            "n_EI_candidates_cat": 24,
+            # at the reference's 24).  Shared constant: the speculation
+            # saturation guard judges against this same value.
+            "n_EI_candidates_cat": _tpe_jax_cat_default(),
             # probability a suggestion is a pure prior draw (stall-
             # triggered restart; consumed by both suggest paths, never
             # forwarded to the TPE engines)

@@ -25,20 +25,9 @@ from .vectorize import dense_to_idxs_vals
 __all__ = ["suggest"]
 
 
-def suggest(
-    new_ids,
-    domain,
-    trials,
-    seed,
-    n_startup_jobs=20,
-    linear_forgetting=25,
-    lock_fraction=0.5,
-    elite_count=8,
-):
-    """``algo=atpe_jax.suggest``: adaptive TPE with the device sweep."""
+def _optimizer_for(domain, lock_fraction, elite_count):
     from . import tpe_jax
 
-    rng = ensure_rng(seed)
     opt = getattr(domain, "_atpe_jax_optimizer", None)
     if (opt is None or opt.lock_fraction != lock_fraction
             or opt.elite_count != elite_count):
@@ -48,10 +37,17 @@ def suggest(
                             elite_count=elite_count,
                             base_n_ei=tpe_jax._default_n_EI_candidates)
         domain._atpe_jax_optimizer = opt
+    return opt
+
+
+def _dense_draw(domain, trials, opt, rng, batch, n_startup_jobs,
+                linear_forgetting):
+    """The adaptive draw for a batch: device sweep under the optimizer's
+    per-step settings, then per-column restart/lock rolls."""
+    from . import tpe_jax
 
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
-    B = len(new_ids)
     warm = buf.count >= n_startup_jobs
 
     kw = {}
@@ -61,19 +57,20 @@ def suggest(
         # consumed here, never forwarded to the jitted engine
         explore_fraction = kw.pop("explore_fraction", 0.0)
     values, active = tpe_jax.suggest_dense(
-        domain, trials, int(rng.integers(0, 2**31 - 1)), B,
+        domain, trials, int(rng.integers(0, 2**31 - 1)), batch,
         n_startup_jobs=n_startup_jobs,
         linear_forgetting=linear_forgetting,
         **kw,
     )
     values = np.array(values)
+    active = np.asarray(active)
 
     if warm:
         pos = {label: d for d, label in enumerate(ps.labels)}
         cands = opt.lock_candidates(domain, trials)  # invariant per call
         helper = _domain_helper(domain) if explore_fraction else None
         rerouted = False
-        for j in range(B):  # per-suggestion rolls (host-path parity)
+        for j in range(batch):  # per-suggestion rolls (host-path parity)
             if explore_fraction and rng.uniform() < explore_fraction:
                 # stall-triggered restart: overwrite this column with a
                 # pure prior draw (host sampler, no device dispatch);
@@ -93,6 +90,71 @@ def suggest(
         if rerouted:
             # restarts/locks may re-route choice subtrees: recompute
             active = np.asarray(ps.active_fn(values))
+    return values, active
+
+
+def suggest(
+    new_ids,
+    domain,
+    trials,
+    seed,
+    n_startup_jobs=20,
+    linear_forgetting=25,
+    lock_fraction=0.5,
+    elite_count=8,
+    speculative=0,
+    max_stale=None,
+):
+    """``algo=atpe_jax.suggest``: adaptive TPE with the device sweep.
+
+    ``speculative=k`` serves k sequential asks from one k-wide draw
+    (same cache/staleness semantics as :func:`tpe_jax.suggest`; the
+    adaptive settings and lock set refresh on every redraw, matching
+    the accepted ``max_queue_len=k`` staleness profile).  The
+    saturated-pure-categorical auto-guard applies, judged at the
+    adaptive layer's fixed categorical candidate count.
+    """
+    from . import tpe_jax
+
+    rng = ensure_rng(seed)
+    opt = _optimizer_for(domain, lock_fraction, elite_count)
+    ps = packed_space_for(domain)
+    B = len(new_ids)
+
+    if speculative and B == 1:
+        # pure-categorical saturation: same trap as tpe_jax, judged at
+        # the adaptive layer's pinned categorical candidate count
+        if tpe_jax._saturated_categorical(
+            ps, tpe_jax._default_n_EI_candidates_cat
+        ):
+            tpe_jax._warn_saturated(
+                domain, speculative,
+                advice="the adaptive layer pins the categorical "
+                "candidate count, so speculation stays off on this "
+                "space; use plain tpe_jax.suggest with a lowered "
+                "n_EI_candidates_cat to re-enable it.",
+            )
+            speculative = 0
+
+    if speculative and B == 1:
+        params = (
+            "atpe", float(lock_fraction), int(elite_count),
+            int(n_startup_jobs), int(linear_forgetting), id(trials),
+            int(speculative),
+            int(speculative) - 1 if max_stale is None else int(max_stale),
+        )
+        values, active = tpe_jax._speculative_cols(
+            domain, trials, seed, int(speculative), max_stale, params,
+            n_startup_jobs,
+            lambda s, k: _dense_draw(
+                domain, trials, opt, ensure_rng(s), k, n_startup_jobs,
+                linear_forgetting,
+            ),
+        )
+    else:
+        values, active = _dense_draw(
+            domain, trials, opt, rng, B, n_startup_jobs, linear_forgetting
+        )
 
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     idxs, vals = tpe_jax._cast_vals(ps, idxs, vals)

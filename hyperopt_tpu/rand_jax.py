@@ -3,6 +3,11 @@
 TPU equivalent of :mod:`hyperopt_tpu.rand`: one XLA program draws the whole
 batch (dense values + active masks) instead of interpreting the pyll graph
 per trial (SURVEY.md SS3.3 -> SS7 stance #1).
+
+``partial(rand_jax.suggest, speculative=k)`` serves k sequential asks
+from one k-wide dispatch.  Unlike TPE, the prior never goes stale, so
+the cached columns are exact (not an accepted staleness profile) -- the
+only invalidation is cache drain or a different trials store.
 """
 
 from __future__ import annotations
@@ -15,17 +20,37 @@ from .vectorize import dense_to_idxs_vals
 __all__ = ["suggest", "suggest_batch"]
 
 
-def suggest_batch(new_ids, domain, trials, seed):
+def _dense_draw(domain, seed, batch):
     import jax
 
     ps = packed_space_for(domain)
     key = host_key(int(seed) % (2**31 - 1))
-    values, active = ps.sample_prior(key, len(new_ids))
-    values, active = jax.device_get((values, active))
+    values, active = ps.sample_prior(key, batch)
+    return jax.device_get((values, active))
+
+
+def suggest_batch(new_ids, domain, trials, seed):
+    ps = packed_space_for(domain)
+    values, active = _dense_draw(domain, seed, len(new_ids))
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
 
 
-def suggest(new_ids, domain, trials, seed):
-    idxs, vals = suggest_batch(new_ids, domain, trials, seed)
+def suggest(new_ids, domain, trials, seed, speculative=0):
+    ps = packed_space_for(domain)
+    if speculative and len(new_ids) == 1:
+        from .tpe_jax import _speculative_cols
+
+        params = ("rand", int(speculative), id(trials))
+        values, active = _speculative_cols(
+            domain, trials, seed, int(speculative),
+            2**62,  # prior draws never go stale
+            params,
+            0,  # no startup regime: always 'warm'
+            lambda s, k: _dense_draw(domain, s, k),
+        )
+        idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
+        idxs, vals = _cast_vals(ps, idxs, vals)
+    else:
+        idxs, vals = suggest_batch(new_ids, domain, trials, seed)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

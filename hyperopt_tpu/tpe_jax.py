@@ -258,22 +258,25 @@ def _saturated_categorical(ps, n_cat_total):
     return len(ps.cont_idx) == 0 and int(n_cat_total) >= int(ps.k_max)
 
 
-def _warn_saturated(domain, k):
+def _warn_saturated(domain, k, advice=None):
     import warnings
 
     if getattr(domain, "_spec_saturation_warned", False):
         return
     domain._spec_saturation_warned = True
+    if advice is None:
+        advice = (
+            "to keep speculation here, lower the categorical candidate "
+            "count below the largest option count (draw randomness is "
+            "the exploration mechanism on saturated categorical spaces)."
+        )
     warnings.warn(
         f"speculative={k} disabled: every dimension of this space is "
         "categorical and the candidate draw covers every option, so the "
         "EI argmax is deterministic and the k speculative columns would "
         "be near-duplicate suggestions evaluated k times (measured "
         "quality loss -- see BASELINE.md NAS speculative row). Falling "
-        "back to one dispatch per ask; to keep speculation here, lower "
-        "the categorical candidate count below the largest option count "
-        "(draw randomness is the exploration mechanism on saturated "
-        "categorical spaces).",
+        "back to one dispatch per ask; " + advice,
         stacklevel=3,
     )
 
@@ -299,15 +302,22 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params,
 
     if max_stale is None:
         max_stale = int(k) - 1
-    buf = obs_buffer_for(domain, trials)  # syncs completed trials
-    warm = buf.count >= n_startup_jobs  # regime decided HERE, once
+    if max_stale < 2**61:
+        buf_count = obs_buffer_for(domain, trials).count  # syncs trials
+        warm = buf_count >= n_startup_jobs  # regime decided HERE, once
+    else:
+        # prior-only callers (rand_jax) pass an effectively infinite
+        # staleness budget: their draws never depend on observations,
+        # so skip the per-ask posterior-mirror maintenance entirely
+        buf_count = 0
+        warm = True
     cache = getattr(domain, "_tpe_spec_draws", None)
     if cache is None:
         cache = {}
         domain._tpe_spec_draws = cache
     entry = cache.get(params)
     if entry is not None:
-        stale = buf.count - entry["count_at_draw"]
+        stale = buf_count - entry["count_at_draw"]
         if (
             entry["trials_ref"]() is trials  # id() may alias after GC
             and 0 <= stale <= max_stale
@@ -320,7 +330,7 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params,
     values, active = draw_fn(seed, k)
     cache[params] = {
         "trials_ref": weakref.ref(trials),
-        "count_at_draw": buf.count,
+        "count_at_draw": buf_count,
         "warm": warm,
         "next": 1,
         "values": values,

@@ -466,6 +466,67 @@ def test_speculative_auto_degrades_on_saturated_categorical(monkeypatch):
     assert calls == [8]  # one 8-wide dispatch serves all four asks
 
 
+def test_speculative_rand_and_atpe_paths(monkeypatch):
+    """Every per-trial JAX algo shares the speculation story: rand_jax
+    serves k asks per prior dispatch (never stale), and atpe_jax serves
+    k asks per adaptive draw with the tpe staleness semantics."""
+    from functools import partial
+
+    from hyperopt_tpu import atpe_jax
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+
+    # rand_jax: count prior dispatches via its dense-draw helper
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    calls = []
+    real_draw = rand_jax._dense_draw
+
+    def counting_draw(domain_, seed_, batch):
+        calls.append(batch)
+        return real_draw(domain_, seed_, batch)
+
+    monkeypatch.setattr(rand_jax, "_dense_draw", counting_draw)
+    algo = partial(rand_jax.suggest, speculative=4)
+    out = []
+    for i in range(4):
+        (d,) = algo(trials.new_trial_ids(1), domain, trials, seed=10 + i)
+        out.append(d["misc"]["vals"]["x"][0])
+    assert calls == [4]  # ONE prior dispatch for four asks
+    assert len(set(out)) == 4  # distinct draws, not one repeated
+    # prior never goes stale: new completed trials don't invalidate
+    docs = rand.suggest(trials.new_trial_ids(2), domain, trials, seed=0)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": 1.0}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    algo(trials.new_trial_ids(1), domain, trials, seed=20)
+    assert calls == [4, 4]  # drained cache -> fresh dispatch, same width
+    monkeypatch.setattr(rand_jax, "_dense_draw", real_draw)
+
+    # atpe_jax: count device draws via suggest_dense (warm history)
+    domain2 = Domain(quad, SPACE)
+    trials2 = Trials()
+    docs = rand.suggest(trials2.new_trial_ids(25), domain2, trials2, seed=0)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(doc["tid"])}
+    trials2.insert_trial_docs(docs)
+    trials2.refresh()
+    dense_calls = []
+    real_dense = tpe_jax.suggest_dense
+
+    def counting_dense(*a, **kw):
+        dense_calls.append(a[3])
+        return real_dense(*a, **kw)
+
+    monkeypatch.setattr(tpe_jax, "suggest_dense", counting_dense)
+    aalgo = partial(atpe_jax.suggest, speculative=4)
+    for i in range(4):
+        aalgo(trials2.new_trial_ids(1), domain2, trials2, seed=30 + i)
+    assert dense_calls == [4]  # one adaptive draw serves four asks
+
+
 def test_speculative_fmin_quality_and_structure():
     """End-to-end fmin with speculative asks: same quality profile as
     max_queue_len batching, valid trial docs, beats random."""
