@@ -120,6 +120,24 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef):
     return jax.jit(fn, static_argnames=("batch",))
 
 
+def _dense_draw(domain, trials, seed, batch, avg_best_idx, shrink_coef):
+    import jax
+
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    key = host_key(int(seed) % (2**31 - 1))
+
+    if buf.count == 0:
+        values, active = ps.sample_prior(key, batch)
+    else:
+        fn = cached_suggest_fn(
+            domain, "_anneal_jax_cache",
+            (float(avg_best_idx), float(shrink_coef)), build_anneal_fn,
+        )
+        values, active = fn(key, *buf.device_arrays(), batch=batch)
+    return jax.device_get((values, active))
+
+
 def suggest_batch(
     new_ids,
     domain,
@@ -129,25 +147,12 @@ def suggest_batch(
     shrink_coef=_default_shrink_coef,
 ):
     """Sparse (idxs, vals) for a batch of ids -- one device program."""
-    import jax
-
     from .tpe_jax import _cast_vals
 
     ps = packed_space_for(domain)
-    buf = obs_buffer_for(domain, trials)
-    B = len(new_ids)
-    key = host_key(int(seed) % (2**31 - 1))
-
-    if buf.count == 0:
-        values, active = ps.sample_prior(key, B)
-    else:
-        fn = cached_suggest_fn(
-            domain, "_anneal_jax_cache",
-            (float(avg_best_idx), float(shrink_coef)), build_anneal_fn,
-        )
-        values, active = fn(key, *buf.device_arrays(), batch=B)
-
-    values, active = jax.device_get((values, active))
+    values, active = _dense_draw(
+        domain, trials, seed, len(new_ids), avg_best_idx, shrink_coef
+    )
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
 
@@ -159,10 +164,37 @@ def suggest(
     seed,
     avg_best_idx=_default_avg_best_idx,
     shrink_coef=_default_shrink_coef,
+    speculative=0,
+    max_stale=None,
 ):
-    """The TPU plugin-boundary entry point: ``algo=anneal_jax.suggest``."""
-    idxs, vals = suggest_batch(
-        new_ids, domain, trials, seed,
-        avg_best_idx=avg_best_idx, shrink_coef=shrink_coef,
-    )
+    """The TPU plugin-boundary entry point: ``algo=anneal_jax.suggest``.
+
+    ``speculative=k`` serves k sequential asks from one k-wide draw
+    (same cache/staleness semantics as :func:`tpe_jax.suggest`: the
+    anchor distribution refreshes on every redraw, and the cache
+    invalidates once the history moves past ``max_stale``).
+    """
+    ps = packed_space_for(domain)
+    if speculative and len(new_ids) == 1:
+        from .tpe_jax import _cast_vals, _speculative_cols
+
+        params = (
+            "anneal", float(avg_best_idx), float(shrink_coef),
+            id(trials), int(speculative),
+            int(speculative) - 1 if max_stale is None else int(max_stale),
+        )
+        values, active = _speculative_cols(
+            domain, trials, seed, int(speculative), max_stale, params,
+            1,  # 'warm' flips once any history exists (prior -> anneal)
+            lambda s, k: _dense_draw(
+                domain, trials, s, k, avg_best_idx, shrink_coef
+            ),
+        )
+        idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
+        idxs, vals = _cast_vals(ps, idxs, vals)
+    else:
+        idxs, vals = suggest_batch(
+            new_ids, domain, trials, seed,
+            avg_best_idx=avg_best_idx, shrink_coef=shrink_coef,
+        )
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)

@@ -82,6 +82,53 @@ def test_anneal_jax_draw_validity():
             assert vals["depth"] == []
 
 
+def test_anneal_jax_speculative(monkeypatch):
+    """speculative=k: one dense draw serves k sequential asks; a new
+    completed observation past max_stale invalidates (the anchor
+    distribution depends on the history, unlike rand's prior)."""
+    from functools import partial
+
+    from hyperopt_tpu import anneal_jax, rand
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE, Trials
+    from hyperopt_tpu import hp
+
+    space = {"x": hp.uniform("x", -5.0, 5.0)}
+    domain = Domain(lambda x: (x - 1.0) ** 2, space)
+    trials = Trials()
+    docs = rand.suggest(trials.new_trial_ids(10), domain, trials, seed=0)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(doc["tid"])}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+
+    calls = []
+    real_draw = anneal_jax._dense_draw
+
+    def counting(*a):
+        calls.append(a[3])
+        return real_draw(*a)
+
+    monkeypatch.setattr(anneal_jax, "_dense_draw", counting)
+    algo = partial(anneal_jax.suggest, speculative=4, max_stale=0)
+    out = []
+    for i in range(2):  # consume only HALF the cache...
+        (d,) = algo(trials.new_trial_ids(1), domain, trials, seed=50 + i)
+        out.append(d["misc"]["vals"]["x"][0])
+    assert calls == [4]  # one draw serves the follow-up ask
+    assert len(set(out)) == 2
+    # ...then a new completed observation > max_stale=0 invalidates the
+    # cache EVEN THOUGH two unserved columns remain (the anchor
+    # distribution depends on the history)
+    new = rand.suggest(trials.new_trial_ids(1), domain, trials, seed=1)
+    new[0]["state"] = JOB_STATE_DONE
+    new[0]["result"] = {"status": "ok", "loss": 0.5}
+    trials.insert_trial_docs(new)
+    trials.refresh()
+    algo(trials.new_trial_ids(1), domain, trials, seed=60)
+    assert calls == [4, 4]
+
+
 def test_anneal_jax_deterministic():
     def fn(cfg):
         return cfg["x"] ** 2
