@@ -63,10 +63,13 @@ def successive_halving(
       fn: ``fn(config, budget) -> loss`` (or a dict with ``"loss"``).
       space: an ``hp.*`` search space.
       max_budget / min_budget: budget of the last / first rung; rung
-        budgets grow by ``eta`` (ints are kept integral).
+        budgets grow by ``eta`` (kept integral -- fn sees ints -- when
+        ``max_budget`` is an int and ``min_budget`` is a whole number,
+        so epoch-count objectives work through :func:`hyperband` too,
+        whose bracket min-budgets arrive as whole floats).
       eta: keep the top ``1/eta`` configurations per rung.
-      n_configs: rung-0 population (default: ``eta ** n_rungs`` so one
-        configuration survives to ``max_budget``).
+      n_configs: rung-0 population (default: ``eta ** (n_rungs - 1)`` so
+        one configuration survives to ``max_budget``).
       algo: suggest function for rung-0 configs (default random search).
       trials: optional ``Trials`` store; every evaluation is recorded as
         a completed trial whose ``result["budget"]`` is its rung budget.
@@ -109,7 +112,10 @@ def successive_halving(
 
     rungs = []
     budget = float(min_budget)
-    integral = isinstance(max_budget, int) and isinstance(min_budget, int)
+    integral = (
+        isinstance(max_budget, int)
+        and float(min_budget) == round(float(min_budget))
+    )
     for r in range(n_rungs):
         b = int(round(budget)) if integral else budget
         new_ids = trials.new_trial_ids(len(live)) if r > 0 else None

@@ -28,7 +28,7 @@ __all__ = ["available", "gmm_lpdf", "adaptive_parzen", "lib_path", "build"]
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "tpe_math.cpp")
 _LOCK = threading.Lock()
-_STATE = {"lib": None, "tried": False}
+_STATE = {"lib": None, "tried": False, "strict_error": None}
 
 
 def _cpu_tag():
@@ -74,6 +74,21 @@ def build(force=False):
     return out
 
 
+def _raise_strict():
+    """Strict mode (=1) must fail EVERY caller, not just the first --
+    silently returning None would degrade later calls to the numpy
+    fallback strict mode exists to forbid.  A FRESH wrapper is raised per
+    call (re-raising one shared exception object would grow its
+    __traceback__ forever), and the env var is re-read so flipping to
+    =0/auto after a strict failure restores the graceful fallback."""
+    if os.environ.get("HYPEROPT_TPU_NATIVE", "auto") != "1":
+        return None
+    err = _STATE["strict_error"]
+    raise RuntimeError(
+        f"native tpe_math build failed under HYPEROPT_TPU_NATIVE=1: {err}"
+    ) from err
+
+
 def _load():
     # lock-free fast path: after the first resolution this runs on every
     # hot-path call (28x per host suggest), and a mutex acquisition per
@@ -82,9 +97,13 @@ def _load():
     # concurrent caller during the (seconds-long) first build blocks on
     # the lock instead of observing a half-initialized None.
     if _STATE["tried"]:
+        if _STATE["strict_error"] is not None:
+            return _raise_strict()
         return _STATE["lib"]
     with _LOCK:
         if _STATE["tried"]:
+            if _STATE["strict_error"] is not None:
+                return _raise_strict()
             return _STATE["lib"]
         mode = os.environ.get("HYPEROPT_TPU_NATIVE", "auto")
         if mode == "0":
@@ -93,6 +112,8 @@ def _load():
         try:
             lib = ctypes.CDLL(build())
         except Exception as e:
+            if mode == "1":
+                _STATE["strict_error"] = e  # cached: re-raised per call
             _STATE["tried"] = True  # don't rebuild-loop on a broken env
             if mode == "1":
                 raise

@@ -91,6 +91,27 @@ def test_hyperband_with_tpe_rung0():
     assert [r["n"] for r in out["rungs"]] == [8, 4, 2]
 
 
+def test_hyperband_keeps_integral_budgets():
+    """Integral-budget contract through hyperband: an int max_budget
+    divisible by eta**s must reach fn as ints at every rung of every
+    bracket (true division handed the objective 9.0 for epoch-count
+    budgets; advisor finding r3)."""
+    seen = []
+
+    def int_checking(cfg, budget):
+        seen.append(budget)
+        assert isinstance(budget, int), budget
+        return (cfg["x"] - 3.0) ** 2 / budget
+
+    out = hyperband(
+        int_checking, SPACE, max_budget=9, eta=3,
+        rstate=np.random.default_rng(4),
+    )
+    assert np.isfinite(out["best_loss"])
+    assert seen and all(isinstance(b, int) for b in seen)
+    assert set(seen) == {1, 3, 9}
+
+
 def test_budget_aware_filters_to_deepest_informative_rung():
     """BOHB model-fitting rule: the wrapped algo must see ONLY the
     highest budget with >= min_obs observations (cross-budget losses

@@ -13,16 +13,21 @@ from hyperopt_tpu.tpe import (
     adaptive_parzen_normal_numpy,
 )
 
-pytestmark = pytest.mark.skipif(
+# per-test (not module-level) skip: the strict-mode regression test
+# below monkeypatches the build and must run EXACTLY on the
+# no-toolchain machines a module-level skip would exclude
+needs_native = pytest.mark.skipif(
     not native.available(), reason="no C++ toolchain / native build failed"
 )
 
 
+@needs_native
 def test_build_produces_loadable_lib():
     assert os.path.exists(native.lib_path())
     assert native.available()
 
 
+@needs_native
 @pytest.mark.parametrize("n_obs", [0, 1, 2, 7, 40])
 def test_adaptive_parzen_parity(n_obs):
     rng = np.random.default_rng(n_obs)
@@ -33,6 +38,7 @@ def test_adaptive_parzen_parity(n_obs):
         np.testing.assert_allclose(g, w, rtol=1e-12, atol=1e-12)
 
 
+@needs_native
 def test_adaptive_parzen_parity_no_forgetting():
     rng = np.random.default_rng(9)
     obs = rng.normal(0, 1, 30)
@@ -42,6 +48,7 @@ def test_adaptive_parzen_parity_no_forgetting():
         np.testing.assert_allclose(g, w, rtol=1e-12, atol=1e-12)
 
 
+@needs_native
 @pytest.mark.parametrize(
     "low,high,q,logspace",
     [
@@ -76,6 +83,7 @@ def test_gmm_lpdf_parity(low, high, q, logspace):
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
 
 
+@needs_native
 def test_dispatch_used_by_public_api():
     """The public GMM1_lpdf must agree with the numpy oracle regardless of
     which backend actually served it."""
@@ -93,6 +101,36 @@ def test_dispatch_used_by_public_api():
     )
 
 
+def test_strict_mode_raises_on_every_call(monkeypatch):
+    """HYPEROPT_TPU_NATIVE=1 with a broken build must fail EVERY caller:
+    the first failure used to latch tried=True and silently hand later
+    callers the numpy fallback strict mode forbids (advisor finding r3)."""
+    saved = dict(native._STATE)
+    try:
+        native._STATE.update(lib=None, tried=False, strict_error=None)
+        monkeypatch.setenv("HYPEROPT_TPU_NATIVE", "1")
+        boom = RuntimeError("no compiler")
+        monkeypatch.setattr(
+            native, "build", lambda force=False: (_ for _ in ()).throw(boom)
+        )
+        with pytest.raises(RuntimeError, match="no compiler"):
+            native._load()
+        # second call takes the lock-free tried fast path -- must still raise
+        with pytest.raises(RuntimeError, match="no compiler"):
+            native._load()
+        with pytest.raises(RuntimeError, match="no compiler"):
+            native.available()
+        # flipping OFF strict mode after a strict failure must restore
+        # the graceful numpy fallback (the cached error is strict-only)
+        monkeypatch.setenv("HYPEROPT_TPU_NATIVE", "0")
+        assert native._load() is None
+        assert native.available() is False
+    finally:
+        native._STATE.clear()
+        native._STATE.update(saved)
+
+
+@needs_native
 def test_native_speedup_sane():
     import time
 
