@@ -205,11 +205,27 @@ def bench_device_loop(n_evals=8192, batch=128):
         return None
 
 
-def bench_pbt(pop=32, exploit_every=5, n_rounds=10):
+# THE BASELINE.md PBT study config (32 members x 200 steps, exploit/
+# explore every 10): the single source for both the executed run and the
+# JSON comparability stamp, so the stamp can never drift from what ran
+PBT_STUDY_CONFIG = {"pop": 32, "exploit_every": 10, "n_rounds": 20}
+
+
+def bench_pbt(pop=None, exploit_every=None, n_rounds=None):
     """Secondary metric: Population-Based Training member-steps/s on the
     transformer family (the during-training scheduler the reference's
     independent-trial model cannot express -- BASELINE.md round 3).
+
+    Defaults ARE ``PBT_STUDY_CONFIG`` (the BASELINE.md study config), so
+    the JSON quality field is directly comparable to the study's
+    0.103-0.115 population-median envelope.
     Returns (member_steps_per_sec, final_population_median_loss)."""
+    pop = PBT_STUDY_CONFIG["pop"] if pop is None else pop
+    exploit_every = (
+        PBT_STUDY_CONFIG["exploit_every"]
+        if exploit_every is None else exploit_every
+    )
+    n_rounds = PBT_STUDY_CONFIG["n_rounds"] if n_rounds is None else n_rounds
     try:
         import jax
         import jax.numpy as jnp
@@ -371,6 +387,14 @@ def main():
         dl_sec_1k, dl_best_1k, dl_n = None, None, 0
         dls_sec_1k, dls_best_1k, dls_n = None, None, 0
         pbt_rate, pbt_median = None, None
+    # comparability contract: the stamped config IS the dict bench_pbt
+    # defaulted from, so the JSON cannot misreport what ran
+    pbt_config = dict(
+        PBT_STUDY_CONFIG,
+        total_steps=(
+            PBT_STUDY_CONFIG["exploit_every"] * PBT_STUDY_CONFIG["n_rounds"]
+        ),
+    )
     rtt_ms = bench_rtt()
 
     print(
@@ -415,6 +439,7 @@ def main():
                 "pbt_final_median_loss": (
                     round(pbt_median, 4) if pbt_median is not None else None
                 ),
+                "pbt_config": pbt_config if pbt_rate else None,
                 "rtt_ms": round(rtt_ms, 2),
                 "batch": batch,
                 "n_EI_candidates": n_cand,

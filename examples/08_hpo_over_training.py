@@ -6,9 +6,12 @@ all the training, and the history live under one ``lax.scan`` -- zero
 host round-trips until the result. On one TPU v5e chip, 512 trials x 8
 SGD steps run in ~1 s steady-state (BASELINE.md round 3).
 
-    python examples/08_hpo_over_training.py
+    python examples/08_hpo_over_training.py [--evals 512] [--steps 8]
+
+(``--evals 64 --steps 2`` is the CI smoke configuration.)
 """
 
+import argparse
 import time
 
 import numpy as np
@@ -16,24 +19,38 @@ import numpy as np
 from hyperopt_tpu.device_loop import compile_fmin
 from hyperopt_tpu.models import transformer
 
-obj = transformer.device_objective(
-    n_steps=8, batch_size=32, seq_len=32, vocab=32, d_model=32, n_layers=2
-)
-runner = compile_fmin(
-    obj, transformer.hpo_space(), max_evals=512, batch_size=8,
-    n_EI_candidates=128,
-)
 
-t0 = time.perf_counter()
-out = runner(seed=0)  # includes compile
-print(f"compile+run: {time.perf_counter() - t0:.1f}s")
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--evals", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=8)
+    args = ap.parse_args()
 
-t0 = time.perf_counter()
-out = runner(seed=1)  # compiled program is reusable across seeds
-dt = time.perf_counter() - t0
-print(
-    f"steady-state: {out['n_evals']} trials x 8 SGD steps in {dt:.2f}s\n"
-    f"best next-token loss {out['best_loss']:.4f} at "
-    f"lr={out['best']['lr']:.4g} wd={out['best']['wd']:.4g} "
-    f"(worst evaluated: {np.max(out['losses']):.3f})"
-)
+    obj = transformer.device_objective(
+        n_steps=args.steps, batch_size=32, seq_len=32, vocab=32,
+        d_model=32, n_layers=2,
+    )
+    runner = compile_fmin(
+        obj, transformer.hpo_space(), max_evals=args.evals,
+        batch_size=args.batch_size, n_EI_candidates=128,
+    )
+
+    t0 = time.perf_counter()
+    out = runner(seed=0)  # includes compile
+    print(f"compile+run: {time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    out = runner(seed=1)  # compiled program is reusable across seeds
+    dt = time.perf_counter() - t0
+    print(
+        f"steady-state: {out['n_evals']} trials x {args.steps} SGD steps "
+        f"in {dt:.2f}s\n"
+        f"best next-token loss {out['best_loss']:.4f} at "
+        f"lr={out['best']['lr']:.4g} wd={out['best']['wd']:.4g} "
+        f"(worst evaluated: {np.max(out['losses']):.3f})"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,12 @@ Two ways to spend a training budget smarter than independent trials:
 Both share the same train-fn contract and run here over a tiny
 transformer LM population (models/transformer.py).
 
-    python examples/09_pbt_and_sha.py
+    python examples/09_pbt_and_sha.py [--pop 16] [--rounds 10]
+
+(``--pop 4 --rounds 2`` is the CI smoke configuration.)
 """
+
+import argparse
 
 import numpy as np
 
@@ -24,35 +28,48 @@ from hyperopt_tpu.hyperband import compile_sha
 from hyperopt_tpu.models import transformer
 from hyperopt_tpu.pbt import compile_pbt
 
-P = 16
-model = transformer.TinyLM(vocab=32, d_model=32, n_heads=2, n_layers=2,
-                           max_len=32)
-params = transformer.init_population(model, P, jax.random.key(0), seq_len=32)
-momentum = jax.tree.map(jnp.zeros_like, params)
-train_fn = transformer.make_pbt_train_fn(
-    model, batch_size=32, seq_len=32, vocab=32
-)
-bounds = {"lr": (1e-4, 1.0), "wd": (1e-7, 1e-2)}
 
-pbt_runner = compile_pbt(
-    train_fn, (params, momentum), bounds,
-    pop_size=P, exploit_every=5, n_rounds=10,
-)
-out = pbt_runner(seed=0)
-print(
-    f"PBT: {P} members x {out['n_steps']} steps -> "
-    f"best {out['best_loss']:.4f}, population median "
-    f"{np.nanmedian(out['loss_history'][-1]):.4f} "
-    f"(best lr {out['best_hypers']['lr']:.3g})"
-)
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pop", type=int, default=16)
+    ap.add_argument("--rounds", type=int, default=10)
+    args = ap.parse_args()
 
-sha_runner = compile_sha(
-    train_fn, (params, momentum), bounds,
-    n_configs=P, eta=2, steps_per_rung=5,
-)
-out = sha_runner(seed=0)
-sched = " -> ".join(f"{r['n']}x{r['steps']}" for r in out["rungs"])
-print(
-    f"SHA: rungs {sched} (survivors continue training) -> "
-    f"best {out['best_loss']:.4f} (lr {out['best_hypers']['lr']:.3g})"
-)
+    P = args.pop
+    model = transformer.TinyLM(vocab=32, d_model=32, n_heads=2, n_layers=2,
+                               max_len=32)
+    params = transformer.init_population(
+        model, P, jax.random.key(0), seq_len=32
+    )
+    momentum = jax.tree.map(jnp.zeros_like, params)
+    train_fn = transformer.make_pbt_train_fn(
+        model, batch_size=32, seq_len=32, vocab=32
+    )
+    bounds = {"lr": (1e-4, 1.0), "wd": (1e-7, 1e-2)}
+
+    pbt_runner = compile_pbt(
+        train_fn, (params, momentum), bounds,
+        pop_size=P, exploit_every=5, n_rounds=args.rounds,
+    )
+    out = pbt_runner(seed=0)
+    print(
+        f"PBT: {P} members x {out['n_steps']} steps -> "
+        f"best {out['best_loss']:.4f}, population median "
+        f"{np.nanmedian(out['loss_history'][-1]):.4f} "
+        f"(best lr {out['best_hypers']['lr']:.3g})"
+    )
+
+    sha_runner = compile_sha(
+        train_fn, (params, momentum), bounds,
+        n_configs=P, eta=2, steps_per_rung=5,
+    )
+    out = sha_runner(seed=0)
+    sched = " -> ".join(f"{r['n']}x{r['steps']}" for r in out["rungs"])
+    print(
+        f"SHA: rungs {sched} (survivors continue training) -> "
+        f"best {out['best_loss']:.4f} (lr {out['best_hypers']['lr']:.3g})"
+    )
+
+
+if __name__ == "__main__":
+    main()
