@@ -112,7 +112,14 @@ def compile_fmin(
       max_evals: total evaluations (rounded up to a batch multiple).
       batch_size: trials suggested + evaluated per step (population mode
         when > 1 -- all members of a step share the same posterior).
-      algo: 'tpe' | 'anneal' | 'rand'.
+      algo: 'tpe' | 'anneal' | 'rand' | 'atpe' (adaptive TPE: per-step
+        gamma/prior-weight/restart decisions + converged-parameter
+        locking as traced functions of the history carry -- see
+        :func:`hyperopt_tpu.atpe_jax.build_atpe_device_fn`.  The
+        adaptive layer DERIVES gamma and prior-weight per step, so the
+        ``gamma`` argument is ignored under atpe; ``prior_weight`` is
+        its base, ``n_EI_candidates`` its anchor (adaptation only
+        raises it); ``joint_ei`` raises).
       joint_ei: TPE only -- whole-configuration scoring (see tpe_jax).
       mesh: optional ``jax.sharding.Mesh``; the population axis of every
         step (suggest batch + objective evaluation) is sharded over
@@ -156,8 +163,16 @@ def compile_fmin(
     import jax
     import jax.numpy as jnp
 
-    if algo not in ("tpe", "anneal", "rand"):
-        raise ValueError(f"unknown algo {algo!r}: expected tpe|anneal|rand")
+    if algo not in ("tpe", "anneal", "rand", "atpe"):
+        raise ValueError(
+            f"unknown algo {algo!r}: expected tpe|anneal|rand|atpe"
+        )
+    if algo == "atpe" and joint_ei:
+        raise ValueError(
+            "algo='atpe' supports only the factorized EI argmax "
+            "(the adaptive layer has no joint-scoring path); drop "
+            "joint_ei or use algo='tpe'"
+        )
     from .fmin import validate_loss_threshold
 
     validate_loss_threshold(loss_threshold)
@@ -254,6 +269,8 @@ def compile_fmin(
         def model(_):
             if algo == "anneal":
                 return _anneal_step(key, values, active, losses, valid)
+            if algo == "atpe":
+                return _atpe_step(key, values, active, losses, valid)
             return _tpe_step(key, values, active, losses, valid)
 
         # startup on history size; every evaluated trial counts, failed
@@ -289,6 +306,18 @@ def compile_fmin(
         from .anneal_jax import build_anneal_fn
 
         fn_ = build_anneal_fn(ps, avg_best_idx, shrink_coef)
+        return fn_(key, values, active, losses, valid, batch=B)
+
+    def _atpe_step(key, values, active, losses, valid):
+        from .atpe_jax import build_atpe_device_fn
+
+        # adaptive settings are traced scalars of the history carry; the
+        # candidate counts derive from n_EI_candidates as the base (the
+        # host adaptive layer's anchor semantics: adaptation only raises)
+        fn_ = build_atpe_device_fn(
+            ps, lf_f, prior_weight=pw, base_n_ei=n_cand,
+            n_cand_cat=n_cand_cat,
+        )
         return fn_(key, values, active, losses, valid, batch=B)
 
     def _shard_batch(x, spec_tail):

