@@ -64,6 +64,124 @@ def term_counts(ps, cap, batch, n_cand, n_cand_cat, lf_pad):
     }
 
 
+def _timed(fn, args_, n_calls, fetch):
+    """Sustained per-call seconds with completion forced by a scalar
+    fetch (block_until_ready is a no-op on the axon tunnel)."""
+    out = fn(*args_)
+    _ = np.asarray(fetch(out))
+    t0 = time.perf_counter()
+    for _i in range(n_calls):
+        out = fn(*args_)
+    _ = np.asarray(fetch(out))
+    return (time.perf_counter() - t0) / n_calls
+
+
+def run_experiments(args):
+    """The three ROOFLINE.md suspects, one experiment each (VERDICT r3
+    weak #3).  Prints one JSON line with a win or a measured negative
+    per suspect:
+
+    (a) the good/bad-split argsort's share of a suggest call -- timed as
+        its own jitted program at the real [cap] shape;
+    (b) [S, K] lane alignment -- the above-model grid has K = cap + 1
+        components (513 for the 500-obs headline), which XLA pads to
+        the next lane multiple (640: ~25% dead lanes); measured by
+        scoring at K = 512 vs 513 at equal work;
+    (c) bf16 term grids with f32 reduction -- the VPU is a 32-bit-lane
+        unit, so the hypothesis is 'no win' (bf16 buys MXU flops and
+        HBM bandwidth, not VPU ALU throughput); measured on the
+        dominant scoring op standalone.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import bench
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import obs_buffer_for, packed_space_for
+    from hyperopt_tpu.models.synthetic import mixed_space
+    from hyperopt_tpu.ops import kernels as K
+
+    platform = jax.devices()[0].platform
+    domain, trials = bench.build_history(args.n_obs, mixed_space())
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    arrays = buf.device_arrays()
+    cap = int(arrays[2].shape[0])
+    B = args.batch
+    S = args.n_cand
+    n_calls = args.n_calls
+    results = {"platform": platform, "batch": B, "n_cand": S, "cap": cap}
+
+    # -- baseline: the full suggest call ---------------------------------
+    fn = tpe_jax.build_suggest_fn(ps, S, 0.25, 25.0, 1.0, n_cand_cat=24)
+    full_s = _timed(
+        lambda: fn(jax.random.key(0), *arrays, batch=B), (), n_calls,
+        lambda o: o[0][:1, :1],
+    )
+    results["full_call_ms"] = round(full_s * 1000, 3)
+
+    # -- (a) argsort share -----------------------------------------------
+    split = jax.jit(
+        lambda losses, valid: K.split_below_above(losses, valid, 0.25, 25.0)
+    )
+    split_s = _timed(
+        lambda: split(arrays[2], arrays[3]), (), n_calls * 4,
+        lambda o: o[2],
+    )
+    results["split_argsort_ms"] = round(split_s * 1000, 4)
+    results["split_share_pct"] = round(100 * split_s / full_s, 2)
+
+    # -- (b) K lane alignment --------------------------------------------
+    # the dominant op standalone at the real shapes: [B, D, S] candidates
+    # scored against [D, K] component grids, logsumexp over K
+    D_nq = 12  # non-quantized continuous dims of the 20-dim space
+
+    def scorer(x, c1, inv_s, mu_inv_s):
+        z = x[..., None] * inv_s[None, :, None, :] - mu_inv_s[None, :, None, :]
+        terms = c1[None, :, None, :] - 0.5 * z * z
+        return jnp.sum(jnp.exp(terms), axis=-1)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(0, 1, (B, D_nq, S)).astype(np.float32))
+    for k_width in (cap + 1, cap, cap - 8):
+        c1 = jnp.asarray(rng.normal(-1, 0.3, (D_nq, k_width)).astype(np.float32))
+        inv_s = jnp.asarray(
+            rng.uniform(0.5, 2.0, (D_nq, k_width)).astype(np.float32)
+        )
+        mu = jnp.asarray(rng.normal(0, 1, (D_nq, k_width)).astype(np.float32))
+        f = jax.jit(scorer)
+        sec = _timed(
+            lambda: f(x, c1, inv_s, mu), (), n_calls, lambda o: o[:1, :1, :1]
+        )
+        results[f"grid_K{k_width}_ms"] = round(sec * 1000, 3)
+
+    # -- (c) bf16 term grid, f32 reduction -------------------------------
+    def scorer_bf16(x, c1, inv_s, mu_inv_s):
+        xb = x.astype(jnp.bfloat16)
+        z = (
+            xb[..., None] * inv_s[None, :, None, :].astype(jnp.bfloat16)
+            - mu_inv_s[None, :, None, :].astype(jnp.bfloat16)
+        )
+        terms = c1[None, :, None, :] - 0.5 * (z * z).astype(jnp.float32)
+        return jnp.sum(jnp.exp(terms), axis=-1)
+
+    k_width = cap + 1
+    c1 = jnp.asarray(rng.normal(-1, 0.3, (D_nq, k_width)).astype(np.float32))
+    inv_s = jnp.asarray(
+        rng.uniform(0.5, 2.0, (D_nq, k_width)).astype(np.float32)
+    )
+    mu = jnp.asarray(rng.normal(0, 1, (D_nq, k_width)).astype(np.float32))
+    f16 = jax.jit(scorer_bf16)
+    sec16 = _timed(
+        lambda: f16(x, c1, inv_s, mu), (), n_calls, lambda o: o[:1, :1, :1]
+    )
+    results["grid_bf16_ms"] = round(sec16 * 1000, 3)
+
+    print(json.dumps(results))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=4096)
@@ -71,7 +189,13 @@ def main():
     ap.add_argument("--n-obs", type=int, default=500)
     ap.add_argument("--n-calls", type=int, default=30)
     ap.add_argument("--profile", action="store_true")
+    ap.add_argument("--experiments", action="store_true",
+                    help="run the round-4 roofline-suspect experiments "
+                    "instead of the headline arithmetic")
     args = ap.parse_args()
+    if args.experiments:
+        run_experiments(args)
+        return
 
     import jax
 
