@@ -30,7 +30,8 @@ _MAX_ADAPTIVE_GAMMA = 0.35
 
 
 def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
-                         lock_fraction=0.5, base_n_ei=None, n_cand_cat=None):
+                         lock_fraction=0.5, base_n_ei=None, n_cand_cat=None,
+                         mesh=None, cand_axis=None):
     """Compile the ADAPTIVE TPE suggest step for a PackedSpace -- the
     on-device counterpart of :class:`hyperopt_tpu.atpe.ATPEOptimizer`,
     traceable under ``device_loop.compile_fmin``'s scan (VERDICT r3
@@ -60,6 +61,14 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
       probability ``lock_fraction`` (restart columns skip locks), then
       conditional activity is re-derived so locked choice arms re-route
       their subtrees -- exactly the host path's semantics.
+
+    ``mesh``/``cand_axis`` shard the EI candidate sweep over the mesh
+    (per-device slabs + argmax-allgather via
+    :func:`hyperopt_tpu.parallel.sharded.build_sharded_sweep`); the
+    adapted candidate count stays the TOTAL sweep width (per-device
+    counts round up).  The traced settings and lock logic are
+    device-count-independent, so the sharded and unsharded programs
+    differ only in the sweep's key folding.
     """
     import jax
     import jax.numpy as jnp
@@ -103,6 +112,18 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
         ).astype(np.float32)
     m_min = max(3, E // 2)  # min elite observations per dim to judge
     max_lock = D // 2
+
+    sharded_sweep = None
+    if cand_axis is not None:
+        if mesh is None:
+            raise ValueError("cand_axis requires a mesh")
+        from .parallel.sharded import build_sharded_sweep, per_device_count
+
+        n_dev_c = int(mesh.shape[cand_axis])
+        sharded_sweep = build_sharded_sweep(
+            ps, mesh, per_device_count(n_ei, n_dev_c), axis=cand_axis,
+            n_cand_cat_per_device=per_device_count(n_cat, n_dev_c),
+        )
 
     def settings(losses, valid):
         """Traced per-step (gamma, prior_weight, explore_fraction)."""
@@ -222,21 +243,26 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
             pad_gamma=_MAX_ADAPTIVE_GAMMA,
         )
 
-        new_values = jnp.zeros((D, batch), dtype=jnp.float32)
-        keys = jax.random.split(k_tpe, max(batch * (Dc + Dk), 1))
-        if fits["cont"] is not None:
-            cont_keys = keys[: batch * Dc].reshape(batch, Dc)
-            cont_vals, _ = K.ei_sweep_cont(
-                ps.q, c, cont_keys, fits["cont"], n_ei
-            )
-            new_values = new_values.at[c["cont_idx"]].set(cont_vals.T)
-        if fits["cat"] is not None:
-            pb, pa = fits["cat"]
-            cat_keys = keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
-            cat_vals, _ = K.ei_sweep_cat(cat_keys, pb, pa, n_cat)
-            new_values = new_values.at[c["cat_idx"]].set(
-                cat_vals.T + c["int_low"][:, None]
-            )
+        if sharded_sweep is not None:
+            new_values, _ = sharded_sweep(k_tpe, fits, batch)
+        else:
+            new_values = jnp.zeros((D, batch), dtype=jnp.float32)
+            keys = jax.random.split(k_tpe, max(batch * (Dc + Dk), 1))
+            if fits["cont"] is not None:
+                cont_keys = keys[: batch * Dc].reshape(batch, Dc)
+                cont_vals, _ = K.ei_sweep_cont(
+                    ps.q, c, cont_keys, fits["cont"], n_ei
+                )
+                new_values = new_values.at[c["cont_idx"]].set(cont_vals.T)
+            if fits["cat"] is not None:
+                pb, pa = fits["cat"]
+                cat_keys = (
+                    keys[batch * Dc: batch * (Dc + Dk)].reshape(batch, Dk)
+                )
+                cat_vals, _ = K.ei_sweep_cat(cat_keys, pb, pa, n_cat)
+                new_values = new_values.at[c["cat_idx"]].set(
+                    cat_vals.T + c["int_low"][:, None]
+                )
 
         if pure_categorical:
             # plain-TPE behavior: no restarts, no locking (measured
@@ -283,8 +309,41 @@ def _optimizer_for(domain, lock_fraction, elite_count):
     return opt
 
 
+def _sharded_dense(domain, trials, seed, batch, mesh, kw, linear_forgetting):
+    """Warm-path adaptive draw with the candidate sweep mesh-sharded:
+    the optimizer's per-step settings feed
+    :func:`parallel.sharded.build_sharded_suggest_fn` (cached per
+    settings tuple -- gamma/prior-weight each take two adaptive values,
+    so at most four builds per mesh)."""
+    import jax
+
+    from .jax_trials import cached_suggest_fn, host_key
+    from .parallel.mesh import CAND_AXIS
+    from .parallel.sharded import (
+        _history_inputs,
+        build_sharded_suggest_fn,
+        per_device_count,
+    )
+
+    buf = obs_buffer_for(domain, trials)
+    key = host_key(int(seed) % (2**31 - 1))
+    n_dev = int(mesh.shape[CAND_AXIS])
+    per_dev = per_device_count(kw["n_EI_candidates"], n_dev)
+    cat_per_dev = per_device_count(kw["n_EI_candidates_cat"], n_dev)
+    fn = cached_suggest_fn(
+        domain, "_atpe_sharded_cache",
+        (id(mesh), per_dev, float(kw["gamma"]), float(linear_forgetting),
+         float(kw["prior_weight"]), cat_per_dev),
+        lambda ps_, _mid, n_pd, g, lf, pw, cpd: build_sharded_suggest_fn(
+            ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
+        ),
+    )
+    values, active = fn(key, *_history_inputs(buf), batch=batch)
+    return jax.device_get((values, active))
+
+
 def _dense_draw(domain, trials, opt, rng, batch, n_startup_jobs,
-                linear_forgetting):
+                linear_forgetting, mesh=None):
     """The adaptive draw for a batch: device sweep under the optimizer's
     per-step settings, then per-column restart/lock rolls."""
     from . import tpe_jax
@@ -299,12 +358,18 @@ def _dense_draw(domain, trials, opt, rng, batch, n_startup_jobs,
         kw = dict(opt.tpe_settings(domain, trials))
         # consumed here, never forwarded to the jitted engine
         explore_fraction = kw.pop("explore_fraction", 0.0)
-    values, active = tpe_jax.suggest_dense(
-        domain, trials, int(rng.integers(0, 2**31 - 1)), batch,
-        n_startup_jobs=n_startup_jobs,
-        linear_forgetting=linear_forgetting,
-        **kw,
-    )
+    if warm and mesh is not None:
+        values, active = _sharded_dense(
+            domain, trials, int(rng.integers(0, 2**31 - 1)), batch, mesh,
+            kw, linear_forgetting,
+        )
+    else:
+        values, active = tpe_jax.suggest_dense(
+            domain, trials, int(rng.integers(0, 2**31 - 1)), batch,
+            n_startup_jobs=n_startup_jobs,
+            linear_forgetting=linear_forgetting,
+            **kw,
+        )
     values = np.array(values)
     active = np.asarray(active)
 
@@ -347,6 +412,7 @@ def suggest(
     elite_count=8,
     speculative=0,
     max_stale=None,
+    mesh=None,
 ):
     """``algo=atpe_jax.suggest``: adaptive TPE with the device sweep.
 
@@ -356,6 +422,11 @@ def suggest(
     the accepted ``max_queue_len=k`` staleness profile).  The
     saturated-pure-categorical auto-guard applies, judged at the
     adaptive layer's fixed categorical candidate count.
+
+    ``mesh`` shards the warm-path candidate sweep over every device of
+    the mesh's ``cand`` axis (the adaptive candidate count becomes the
+    TOTAL across devices), like
+    :func:`hyperopt_tpu.parallel.sharded.sharded_suggest` for plain TPE.
     """
     from . import tpe_jax
 
@@ -385,18 +456,20 @@ def suggest(
             int(n_startup_jobs), int(linear_forgetting), id(trials),
             int(speculative),
             int(speculative) - 1 if max_stale is None else int(max_stale),
+            0 if mesh is None else id(mesh),
         )
         values, active = tpe_jax._speculative_cols(
             domain, trials, seed, int(speculative), max_stale, params,
             n_startup_jobs,
             lambda s, k: _dense_draw(
                 domain, trials, opt, ensure_rng(s), k, n_startup_jobs,
-                linear_forgetting,
+                linear_forgetting, mesh=mesh,
             ),
         )
     else:
         values, active = _dense_draw(
-            domain, trials, opt, rng, B, n_startup_jobs, linear_forgetting
+            domain, trials, opt, rng, B, n_startup_jobs, linear_forgetting,
+            mesh=mesh,
         )
 
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)

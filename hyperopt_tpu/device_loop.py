@@ -208,10 +208,10 @@ def compile_fmin(
                     f"cand_axis {cand_axis!r} is not an axis of the mesh "
                     f"(axes: {tuple(mesh.shape)})"
                 )
-            if algo != "tpe":
+            if algo not in ("tpe", "atpe"):
                 raise ValueError(
-                    "cand_axis shards the TPE candidate sweep; "
-                    f"algo={algo!r} has no candidate sweep to shard"
+                    "cand_axis shards the (adaptive) TPE candidate "
+                    f"sweep; algo={algo!r} has no candidate sweep to shard"
                 )
             if joint_ei:
                 raise ValueError(
@@ -282,18 +282,21 @@ def compile_fmin(
     def _tpe_step(key, values, active, losses, valid):
         # the returned fns are jitted; nested jit inlines under the scan
         if cand_axis is not None:
-            from .parallel.sharded import build_sharded_suggest_fn
+            from .parallel.sharded import (
+                build_sharded_suggest_fn,
+                per_device_count,
+            )
 
             n_dev_c = int(mesh.shape[cand_axis])
             # n_EI_candidates is the TOTAL sweep width in every mode;
             # per-device counts round up (executed total may exceed the
-            # request by < n_dev per dim, same contract as
-            # parallel.sharded.sharded_suggest's n_EI_cat_total)
-            per_dev = -(-n_cand // n_dev_c)
+            # request by < n_dev per dim -- per_device_count pins the
+            # contract once for every sharded entry point)
             cat_total = n_cand if n_cand_cat is None else n_cand_cat
             fn_ = build_sharded_suggest_fn(
-                ps, mesh, per_dev, gamma_f, lf_f, pw, axis=cand_axis,
-                n_cand_cat_per_device=max(1, -(-cat_total // n_dev_c)),
+                ps, mesh, per_device_count(n_cand, n_dev_c), gamma_f,
+                lf_f, pw, axis=cand_axis,
+                n_cand_cat_per_device=per_device_count(cat_total, n_dev_c),
             )
         else:
             from .tpe_jax import build_suggest_fn
@@ -317,6 +320,8 @@ def compile_fmin(
         fn_ = build_atpe_device_fn(
             ps, lf_f, prior_weight=pw, base_n_ei=n_cand,
             n_cand_cat=n_cand_cat,
+            mesh=mesh if cand_axis is not None else None,
+            cand_axis=cand_axis,
         )
         return fn_(key, values, active, losses, valid, batch=B)
 

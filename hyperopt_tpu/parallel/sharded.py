@@ -25,7 +25,21 @@ from ..rand import docs_from_idxs_vals
 from ..vectorize import dense_to_idxs_vals
 from .mesh import CAND_AXIS, default_mesh
 
-__all__ = ["build_sharded_suggest_fn", "sharded_suggest", "suggest"]
+__all__ = [
+    "build_sharded_suggest_fn",
+    "build_sharded_sweep",
+    "per_device_count",
+    "sharded_suggest",
+    "suggest",
+]
+
+
+def per_device_count(total, n_dev):
+    """Per-device slab width for a TOTAL sweep width: round up, floor at
+    1 -- the executed total may exceed the request by < n_dev.  THE
+    single definition of the total->per-device contract, shared by every
+    sharded-sweep entry point (plain, adaptive, device-loop)."""
+    return max(1, -(-int(total) // int(n_dev)))
 
 
 def _shard_map():
@@ -38,22 +52,17 @@ def _shard_map():
     return sm
 
 
-def build_sharded_suggest_fn(
-    ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS,
-    n_cand_cat_per_device=None,
-):
-    """Compile the mesh-sharded TPE step for a PackedSpace.
+def build_sharded_sweep(ps, mesh, n_cand_per_device, axis=CAND_AXIS,
+                        n_cand_cat_per_device=None):
+    """The mesh-sharded EI candidate sweep, taking precomputed fits.
 
-    Returns jitted ``fn(key, values, active, losses, valid, batch)`` like
-    :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`, with the candidate sweep
-    sharded over ``axis`` of ``mesh``.
-
-    ``n_cand_cat_per_device`` (None = follow ``n_cand_per_device``) caps
-    the per-device categorical draw: the union of per-device draws is
-    statistically one (n_per_device x n_devices)-draw sweep, and the
-    categorical EI argmax saturates into pure exploitation once that
-    total covers every option (measured -- BASELINE.md NAS table), so
-    callers keep the TOTAL categorical draw near the reference's 24.
+    Returns ``sweep(key, fits, batch) -> (new_values [D, B], active)``
+    where ``fits`` is :func:`hyperopt_tpu.ops.kernels.fit_all_dims`
+    output.  Factored out so builders that compute their fits with
+    TRACED per-step settings (the adaptive on-device path,
+    :func:`hyperopt_tpu.atpe_jax.build_atpe_device_fn`) share the exact
+    per-device slab draw + argmax-allgather with the static-settings
+    :func:`build_sharded_suggest_fn`.
     """
     import jax
     import jax.numpy as jnp
@@ -61,15 +70,10 @@ def build_sharded_suggest_fn(
 
     from ..ops import kernels as K
 
-    K.check_prior_weight(prior_weight)
     c = ps._consts
     D = ps.n_dims
     Dc = len(ps.cont_idx)
     Dk = len(ps.cat_idx)
-    n_dev = int(mesh.shape[axis])
-    gamma = float(gamma)
-    lf_f = float(lf)
-    pw = float(prior_weight)
     n_cat = (
         int(n_cand_per_device)
         if n_cand_cat_per_device is None
@@ -103,8 +107,7 @@ def build_sharded_suggest_fn(
         scores = jnp.concatenate(out_scores, axis=1)
         return vals[None], scores[None]  # leading shard axis
 
-    def fn(key, values, active, losses, valid, batch):
-        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+    def sweep(key, fits, batch):
         zc = jnp.zeros((0,), jnp.float32)
         wb, mb, sb, wa, ma, sa = fits["cont"] or (zc,) * 6
         pb, pa = fits["cat"] or (zc, zc)
@@ -129,6 +132,44 @@ def build_sharded_suggest_fn(
                 best[:, Dc:].T + c["int_low"][:, None]
             )
         return new_values, ps.active_fn(new_values)
+
+    return sweep
+
+
+def build_sharded_suggest_fn(
+    ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS,
+    n_cand_cat_per_device=None,
+):
+    """Compile the mesh-sharded TPE step for a PackedSpace.
+
+    Returns jitted ``fn(key, values, active, losses, valid, batch)`` like
+    :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`, with the candidate sweep
+    sharded over ``axis`` of ``mesh``.
+
+    ``n_cand_cat_per_device`` (None = follow ``n_cand_per_device``) caps
+    the per-device categorical draw: the union of per-device draws is
+    statistically one (n_per_device x n_devices)-draw sweep, and the
+    categorical EI argmax saturates into pure exploitation once that
+    total covers every option (measured -- BASELINE.md NAS table), so
+    callers keep the TOTAL categorical draw near the reference's 24.
+    """
+    import jax
+
+    from ..ops import kernels as K
+
+    K.check_prior_weight(prior_weight)
+    c = ps._consts
+    gamma = float(gamma)
+    lf_f = float(lf)
+    pw = float(prior_weight)
+    sweep = build_sharded_sweep(
+        ps, mesh, n_cand_per_device, axis=axis,
+        n_cand_cat_per_device=n_cand_cat_per_device,
+    )
+
+    def fn(key, values, active, losses, valid, batch):
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        return sweep(key, fits, batch)
 
     return jax.jit(fn, static_argnames=("batch",))
 
@@ -208,7 +249,7 @@ def sharded_suggest(
     n_dev = int(mesh.shape[CAND_AXIS])
     cat_per_dev = (
         None if n_EI_cat_total is None
-        else max(1, -(-int(n_EI_cat_total) // n_dev))
+        else per_device_count(n_EI_cat_total, n_dev)
     )
 
     def draw(seed_, batch):

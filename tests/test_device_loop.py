@@ -398,6 +398,24 @@ def test_device_loop_cand_sharded_conditional_space():
     assert np.array_equal(act[d["lr"]], ~act[d["c"]])
 
 
+def test_device_loop_atpe_cand_sharded():
+    """Adaptive TPE with its candidate sweep sharded inside the scan:
+    the traced settings/lock layer is device-count-independent, so the
+    sharded program stays deterministic and converges."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("cand",))
+    runner = compile_fmin(
+        quad_obj, quad_space(), max_evals=128, batch_size=1,
+        algo="atpe", mesh=mesh, cand_axis="cand",
+    )
+    a = runner(seed=0)
+    b = runner(seed=0)
+    np.testing.assert_array_equal(a["losses"], b["losses"])
+    assert a["best_loss"] < 0.5
+
+
 def test_device_loop_cand_axis_validation():
     import jax
     from jax.sharding import Mesh
