@@ -315,31 +315,20 @@ def _sharded_dense(domain, trials, seed, batch, mesh, kw, linear_forgetting):
     :func:`parallel.sharded.build_sharded_suggest_fn` (cached per
     settings tuple -- gamma/prior-weight each take two adaptive values,
     so at most four builds per mesh)."""
-    import jax
-
-    from .jax_trials import cached_suggest_fn, host_key
+    from .jax_trials import host_key
     from .parallel.mesh import CAND_AXIS
-    from .parallel.sharded import (
-        _history_inputs,
-        build_sharded_suggest_fn,
-        per_device_count,
-    )
+    from .parallel.sharded import per_device_count, sharded_draw
 
     buf = obs_buffer_for(domain, trials)
     key = host_key(int(seed) % (2**31 - 1))
     n_dev = int(mesh.shape[CAND_AXIS])
-    per_dev = per_device_count(kw["n_EI_candidates"], n_dev)
-    cat_per_dev = per_device_count(kw["n_EI_candidates_cat"], n_dev)
-    fn = cached_suggest_fn(
-        domain, "_atpe_sharded_cache",
-        (id(mesh), per_dev, float(kw["gamma"]), float(linear_forgetting),
-         float(kw["prior_weight"]), cat_per_dev),
-        lambda ps_, _mid, n_pd, g, lf, pw, cpd: build_sharded_suggest_fn(
-            ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
-        ),
+    return sharded_draw(
+        domain, buf, mesh, "_atpe_sharded_cache",
+        per_device_count(kw["n_EI_candidates"], n_dev),
+        kw["gamma"], linear_forgetting, kw["prior_weight"],
+        per_device_count(kw["n_EI_candidates_cat"], n_dev),
+        key, batch,
     )
-    values, active = fn(key, *_history_inputs(buf), batch=batch)
-    return jax.device_get((values, active))
 
 
 def _dense_draw(domain, trials, opt, rng, batch, n_startup_jobs,

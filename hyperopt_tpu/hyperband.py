@@ -31,7 +31,13 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["successive_halving", "hyperband", "compile_sha", "budget_aware"]
+__all__ = [
+    "successive_halving",
+    "hyperband",
+    "compile_sha",
+    "compile_hyperband",
+    "budget_aware",
+]
 
 
 def _int_log(ratio, eta):
@@ -323,12 +329,21 @@ def compile_sha(
             f"n_rungs={n_rungs} must be in [1, {max_rungs + 1}] for "
             f"n_configs={P0}, eta={eta}"
         )
-    leading = {x.shape[0] for x in jax.tree.leaves(init_state)}
-    if leading != {R * P0}:
-        raise ValueError(
-            f"init_state leaves must have leading dim replicas * "
-            f"n_configs = {R * P0}; got {sorted(leading)}"
-        )
+    def _validate_leading(state):
+        leading = {x.shape[0] for x in jax.tree.leaves(state)}
+        if leading != {R * P0}:
+            raise ValueError(
+                f"init_state leaves must have leading dim replicas * "
+                f"n_configs = {R * P0}; got {sorted(leading)}"
+            )
+        return state
+
+    # init_state may be a zero-arg callable: materialized per run and
+    # released after it, so schedulers holding MANY compile_sha programs
+    # (compile_hyperband's brackets) don't pin every bracket's full
+    # population in memory for the runner's lifetime
+    if not callable(init_state):
+        _validate_leading(init_state)
     names, log_lo, log_hi = _log_bounds(hyper_bounds)
     constrain = _make_constrain(mesh, trial_axis)
 
@@ -373,7 +388,10 @@ def compile_sha(
         base = jax.random.key(int(seed) % 2**32)
         k_init, *rung_keys = jax.random.split(base, n_rungs + 1)
         log_h = init_hypers(k_init)
-        state = constrain(init_state)
+        state = constrain(
+            _validate_leading(init_state()) if callable(init_state)
+            else init_state
+        )
         n_live = P0
         steps = int(steps_per_rung)
         sched = []
@@ -420,6 +438,107 @@ def compile_sha(
             "state": state,
             "best_index": best_i,
             "replica_bests": [float(b) for b in rep_bests],
+        }
+
+    return runner
+
+
+def compile_hyperband(
+    train_fn,
+    init_state_fn,
+    hyper_bounds,
+    s_max,
+    eta=2,
+    steps_per_rung=5,
+    replicas=1,
+    mesh=None,
+    trial_axis="trial",
+):
+    """Full Hyperband over TRAINING, on-device: every bracket from the
+    most exploratory (``eta**s_max`` configs at the smallest rung-0
+    budget) to a single full-budget one, as chained ``compile_sha``
+    ladders.
+
+    The one-survivor bracket variant: bracket ``s`` runs ``eta**s``
+    configurations through ``s + 1`` rungs with per-rung base budget
+    ``steps_per_rung * eta**(s_max - s)`` training steps, so every
+    bracket's survivor retires at the same maximum budget while the
+    brackets trade configurations against rung-0 depth -- the Hyperband
+    exploration/exploitation spread (Li et al., 2018) with populations
+    kept ``eta``-powers for the fused ladders.  ``replicas=K``
+    bracket-packs every ladder (K independent instances of EACH
+    bracket).
+
+    Each bracket's rung chain dispatches asynchronously with one host
+    fetch at its end, so the device runs bracket-to-bracket back to
+    back; total wall-clock is the sum of bracket compute plus one
+    round-trip per bracket (vs the host driver
+    :func:`hyperband`, which must synchronize every evaluation of an
+    arbitrary Python objective).
+
+    Args:
+      train_fn: the :func:`compile_sha` / :func:`hyperopt_tpu.pbt`
+        population train-fn contract.
+      init_state_fn: ``(key, n) -> state pytree`` with leading dim
+        ``n`` on every leaf (e.g. ``transformer.init_population``
+        wrapped); called once per bracket at build time.
+      s_max: bracket count - 1; the widest bracket has ``eta**s_max``
+        configs per replica.
+
+    Returns ``runner(seed=0) -> {"best_loss", "best_hypers",
+    "brackets": [{"s", "n_configs", "rungs", "best_loss",
+    "replica_bests"}...], "best_bracket"}``.
+    """
+    import jax
+
+    if s_max < 0:
+        raise ValueError(f"s_max={s_max} must be >= 0")
+    bracket_runners = []
+    for s in range(int(s_max), -1, -1):
+        n_s = eta**s
+        bracket_runners.append((s, compile_sha(
+            train_fn,
+            # lazy: each bracket's population materializes when ITS
+            # ladder runs and is released after, so peak memory is one
+            # bracket, not the sum of all of them
+            (lambda s_=s, n_=n_s: init_state_fn(
+                jax.random.key(s_), int(replicas) * n_
+            )),
+            hyper_bounds,
+            n_configs=n_s,
+            eta=eta,
+            steps_per_rung=int(steps_per_rung) * eta ** (int(s_max) - s),
+            replicas=replicas,
+            mesh=mesh,
+            trial_axis=trial_axis,
+        )))
+
+    def runner(seed=0):
+        brackets = []
+        outs = []
+        for s, run_s in bracket_runners:
+            # distinct per-bracket seeds: fold the bracket id
+            out = run_s(seed=(int(seed) * 1_000_003 + s) % 2**31)
+            outs.append(out)
+            brackets.append({
+                "s": s,
+                "n_configs": eta**s,
+                "rungs": out["rungs"],
+                "best_loss": out["best_loss"],
+                "replica_bests": out["replica_bests"],
+            })
+        # NaN-safe winner: a diverged bracket (non-finite best) must
+        # never poison the result; all-diverged keeps bracket 0's NaN
+        keyed = [
+            b["best_loss"] if np.isfinite(b["best_loss"]) else np.inf
+            for b in brackets
+        ]
+        win = int(np.argmin(keyed))
+        return {
+            "best_loss": outs[win]["best_loss"],
+            "best_hypers": outs[win]["best_hypers"],
+            "brackets": brackets,
+            "best_bracket": win,
         }
 
     return runner

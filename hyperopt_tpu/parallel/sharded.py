@@ -174,6 +174,26 @@ def build_sharded_suggest_fn(
     return jax.jit(fn, static_argnames=("batch",))
 
 
+def sharded_draw(domain, buf, mesh, cache_attr, n_per_dev, gamma, lf,
+                 prior_weight, cat_per_dev, key, batch):
+    """One warm-path mesh-sharded draw: the cache-keyed builder +
+    history placement + device fetch sequence, shared by
+    :func:`sharded_suggest` and the adaptive path
+    (:func:`hyperopt_tpu.atpe_jax._sharded_dense`) so the cache-key and
+    multi-process placement contracts live in one place."""
+    import jax
+
+    fn = cached_suggest_fn(
+        domain, cache_attr,
+        (id(mesh), int(n_per_dev), float(gamma), float(lf),
+         float(prior_weight), cat_per_dev),
+        lambda ps_, _mid, n_pd, g, lf_, pw_, cpd: build_sharded_suggest_fn(
+            ps_, mesh, n_pd, g, lf_, pw_, n_cand_cat_per_device=cpd
+        ),
+    )
+    return jax.device_get(fn(key, *_history_inputs(buf), batch=batch))
+
+
 def _history_inputs(buf):
     """History buffers placed for the current process span.
 
@@ -255,19 +275,11 @@ def sharded_suggest(
     def draw(seed_, batch):
         key = host_key(int(seed_) % (2**31 - 1))
         if buf.count < n_startup_jobs:
-            out = ps.sample_prior(key, batch)
-        else:
-            fn = cached_suggest_fn(
-                domain, "_sharded_tpe_cache",
-                (id(mesh), int(n_EI_per_device), float(gamma),
-                 float(linear_forgetting), float(prior_weight), cat_per_dev),
-                lambda ps_, _mid, n_pd, g, lf, pw, cpd:
-                    build_sharded_suggest_fn(
-                        ps_, mesh, n_pd, g, lf, pw, n_cand_cat_per_device=cpd
-                    ),
-            )
-            out = fn(key, *_history_inputs(buf), batch=batch)
-        return jax.device_get(out)
+            return jax.device_get(ps.sample_prior(key, batch))
+        return sharded_draw(
+            domain, buf, mesh, "_sharded_tpe_cache", n_EI_per_device,
+            gamma, linear_forgetting, prior_weight, cat_per_dev, key, batch,
+        )
 
     if speculative and B == 1:
         from ..tpe_jax import _saturated_categorical, _warn_saturated

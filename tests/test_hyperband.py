@@ -330,6 +330,32 @@ def test_compile_sha_replicas_validates_leading_dim():
         )
 
 
+def test_compile_hyperband_on_device():
+    """Full multi-bracket Hyperband as chained on-device ladders: the
+    bracket spread (eta**s configs at rung-0 budget steps*eta**(s_max-s))
+    is correct, every bracket reports, replicas compose, reproducible."""
+    from hyperopt_tpu.hyperband import compile_hyperband
+
+    runner = compile_hyperband(
+        linear_train_fn, lambda key, n: {"theta": jnp.full((n,), 5.0)},
+        {"lr": (1e-3, 5.0)}, s_max=3, eta=2, steps_per_rung=2,
+    )
+    out = runner(seed=0)
+    assert [b["n_configs"] for b in out["brackets"]] == [8, 4, 2, 1]
+    assert [
+        [r["steps"] for r in b["rungs"]] for b in out["brackets"]
+    ] == [[2, 4, 8, 16], [4, 8, 16], [8, 16], [16]]
+    assert out["best_loss"] < 1e-2
+    assert out["best_loss"] == min(b["best_loss"] for b in out["brackets"])
+    assert runner(seed=0)["best_loss"] == out["best_loss"]
+
+    packed = compile_hyperband(
+        linear_train_fn, lambda key, n: {"theta": jnp.full((n,), 5.0)},
+        {"lr": (1e-3, 5.0)}, s_max=2, eta=2, steps_per_rung=2, replicas=3,
+    )(seed=1)
+    assert all(len(b["replica_bests"]) == 3 for b in packed["brackets"])
+
+
 def test_compile_sha_transformer_rungs():
     """SHA over real LM training: rung budgets deepen survivors and the
     final loss improves on rung-0's best."""
