@@ -416,6 +416,23 @@ def test_device_loop_atpe_cand_sharded():
     assert a["best_loss"] < 0.5
 
 
+def test_device_loop_cand_sharded_with_early_stop():
+    """The sharded sweep (shard_map) composes with the while_loop
+    early-stop form: a loss_threshold hit stops the cand-sharded
+    sequential scan early."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("cand",))
+    runner = compile_fmin(
+        quad_obj, quad_space(), max_evals=256, batch_size=1,
+        mesh=mesh, cand_axis="cand", loss_threshold=0.5,
+    )
+    out = runner(seed=0)
+    assert out["best_loss"] <= 0.5
+    assert out["n_evals"] < 256  # really stopped early
+
+
 def test_device_loop_cand_axis_validation():
     import jax
     from jax.sharding import Mesh
