@@ -34,10 +34,30 @@ logger = logging.getLogger(__name__)
 __all__ = [
     "successive_halving",
     "hyperband",
+    "asha",
     "compile_sha",
     "compile_hyperband",
     "budget_aware",
 ]
+
+
+def _budgets_integral(max_budget, min_budget):
+    """The shared integral-budget rule: fn sees ints whenever
+    ``max_budget`` is an int and ``min_budget`` is a whole number (so
+    epoch-count objectives survive hyperband's whole-float bracket
+    minimums).  One definition for every driver."""
+    return (
+        isinstance(max_budget, int)
+        and float(min_budget) == round(float(min_budget))
+    )
+
+
+def _vals_of(doc):
+    """Index-form config of a suggested trial doc (single-valued labels
+    only -- inactive conditional branches have empty vals lists)."""
+    return {
+        k: v[0] for k, v in doc["misc"]["vals"].items() if len(v) == 1
+    }
 
 
 def _int_log(ratio, eta):
@@ -109,19 +129,13 @@ def successive_halving(
     live = [t for t in trials._dynamic_trials if t["tid"] in tids]
 
     def config_of(doc):
-        vals = {
-            k: v[0] for k, v in doc["misc"]["vals"].items() if len(v) == 1
-        }
-        return space_eval(space, vals)
+        return space_eval(space, _vals_of(doc))
 
     import copy as _copy
 
     rungs = []
     budget = float(min_budget)
-    integral = (
-        isinstance(max_budget, int)
-        and float(min_budget) == round(float(min_budget))
-    )
+    integral = _budgets_integral(max_budget, min_budget)
     for r in range(n_rungs):
         b = int(round(budget)) if integral else budget
         new_ids = trials.new_trial_ids(len(live)) if r > 0 else None
@@ -542,3 +556,177 @@ def compile_hyperband(
         }
 
     return runner
+
+
+def asha(
+    fn,
+    space,
+    max_budget,
+    eta=3,
+    min_budget=1,
+    max_jobs=81,
+    workers=4,
+    algo=None,
+    trials=None,
+    rstate=None,
+):
+    """Asynchronous successive halving (ASHA, Li et al., 2020).
+
+    The synchronous :func:`successive_halving` waits for a whole rung
+    before promoting, so stragglers idle every worker; ASHA promotes a
+    configuration the moment it is in the top ``1/eta`` of COMPLETED
+    results at its rung, and otherwise starts a fresh rung-0
+    configuration -- workers never wait.  This is the scheduler shape
+    that fits this framework's asynchronous execution backends (the
+    filequeue/Mongo worker model): here it runs on an in-process thread
+    pool with the scheduler state under one lock, the same concurrency
+    discipline as ``distributed.threads.ThreadTrials``.
+
+    Args:
+      fn: ``fn(config, budget) -> loss`` (or dict with ``"loss"``);
+        called concurrently from ``workers`` threads -- it must be
+        thread-safe (pure functions and most surrogates are).
+      max_budget / min_budget / eta: the rung ladder, as in
+        :func:`successive_halving` (ints kept integral the same way).
+      max_jobs: total evaluations across all rungs (the stop rule).
+      workers: concurrent evaluator threads.
+      algo: suggest fn for rung-0 configurations (default random); asked
+        one configuration at a time, under the scheduler lock.
+      trials: optional ``Trials``; every evaluation is recorded with
+        ``result["budget"]`` (same contract as the sync drivers, so
+        ``budget_aware`` model fitting composes).
+
+    Returns ``{"best": config, "best_loss", "rungs": [{"budget", "n"}],
+    "trials"}`` where ``best`` is the best completed evaluation at the
+    HIGHEST budget reached (ASHA's answer is its deepest survivor).
+    """
+    import bisect
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    from .base import Domain, Trials
+    from . import rand as rand_mod
+    from .fmin import space_eval
+
+    if rstate is None:
+        rstate = np.random.default_rng()
+    if algo is None:
+        algo = rand_mod.suggest
+    if trials is None:
+        trials = Trials()
+    n_rungs = _int_log(max_budget / min_budget, eta) + 1
+    integral = _budgets_integral(max_budget, min_budget)
+
+    def rung_budget(r):
+        b = float(min_budget) * eta**r
+        return int(round(b)) if integral else b
+
+    domain = Domain(fn, space, pass_expr_memo_ctrl=False)
+    lock = threading.Lock()
+    # rung r -> SORTED list of (loss, config_key) (bisect.insort in
+    # _record), so the scheduler's promotable-set scan needs no per-call
+    # sort under the lock every worker contends on
+    done = [[] for _ in range(n_rungs)]
+    promoted = [set() for _ in range(n_rungs)]
+    configs = {}  # config_key -> config dict (index-form vals)
+    started = 0
+
+    def _suggest_one():
+        """One new rung-0 configuration through the algo seam."""
+        seed = int(rstate.integers(0, 2**31 - 1))
+        (tid,) = trials.new_trial_ids(1)
+        (doc,) = algo([tid], domain, trials, seed)
+        return _vals_of(doc)
+
+    def _next_job():
+        """Scheduler core, called under the lock: the highest-rung
+        eligible promotion, else a fresh rung-0 config."""
+        nonlocal started
+        if started >= max_jobs:
+            return None
+        for r in range(n_rungs - 2, -1, -1):
+            n_promotable = len(done[r]) // eta
+            for loss, key in done[r][:n_promotable]:
+                if key not in promoted[r]:
+                    promoted[r].add(key)
+                    started += 1
+                    return key, r + 1
+        key = len(configs)
+        configs[key] = _suggest_one()
+        started += 1
+        return key, 0
+
+    def _record(key, r, loss):
+        from .base import JOB_STATE_DONE
+
+        b = rung_budget(r)
+        (tid,) = trials.new_trial_ids(1)
+        misc = {
+            "tid": tid,
+            "cmd": ("domain_attachment", "FMinIter_Domain"),
+            "workdir": None,
+            "idxs": {k: [tid] for k in configs[key]},
+            "vals": {k: [v] for k, v in configs[key].items()},
+        }
+        result = {
+            "status": "ok",
+            "loss": float(loss) if np.isfinite(loss) else None,
+            "budget": b,
+        }
+        if result["loss"] is None:
+            result["status"] = "fail"
+        (doc,) = trials.new_trial_docs([tid], [None], [result], [misc])
+        doc["state"] = JOB_STATE_DONE
+        trials.insert_trial_docs([doc])
+        # refresh under the lock so a model-based rung-0 algo (tpe_jax,
+        # budget_aware) sees every completed evaluation, not an empty
+        # stale view -- trials.trials reads the refresh-synced list
+        trials.refresh()
+        if np.isfinite(loss):
+            bisect.insort(done[r], (float(loss), key))
+
+    def worker():
+        while True:
+            with lock:
+                job = _next_job()
+            if job is None:
+                return
+            key, r = job
+            cfg = space_eval(space, configs[key])
+            try:
+                loss = fn(cfg, rung_budget(r))
+                if isinstance(loss, dict):
+                    loss = loss["loss"]
+                loss = float(loss)
+            except Exception:
+                logger.exception("asha evaluation failed")
+                loss = float("nan")
+            with lock:
+                _record(key, r, loss)
+
+    with ThreadPoolExecutor(max_workers=int(workers)) as pool:
+        futures = [pool.submit(worker) for _ in range(int(workers))]
+        for f in futures:
+            f.result()  # surface worker crashes
+    trials.refresh()
+
+    populated = [r for r in range(n_rungs) if done[r]]
+    if not populated:
+        from .exceptions import AllTrialsFailed
+
+        raise AllTrialsFailed(
+            f"every asha evaluation failed ({max_jobs} jobs, all "
+            "non-finite or raising); the recorded trials are in the "
+            "trials= store if one was passed"
+        )
+    deepest = populated[-1]
+    best_loss, best_key = done[deepest][0]  # sorted: first is best
+    return {
+        "best": space_eval(space, configs[best_key]),
+        "best_loss": best_loss,
+        "rungs": [
+            {"budget": rung_budget(r), "n": len(done[r])}
+            for r in range(n_rungs)
+        ],
+        "trials": trials,
+    }
