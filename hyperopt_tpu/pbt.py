@@ -107,11 +107,15 @@ def compile_pbt(
       mesh / trial_axis: optional population sharding, as in
         :func:`hyperopt_tpu.device_loop.compile_fmin`.
 
-    Returns ``runner(seed=0) -> dict`` with ``best_loss``,
+    Returns ``runner(seed=0, init=None) -> dict`` with ``best_loss``,
     ``best_hypers`` ({name: float} of the best final member),
     ``hypers`` ({name: [P]} final), ``loss_history`` [n_rounds, P]
     (each round's last-step losses), and ``state`` (final population
-    pytree, device arrays).
+    pytree, device arrays).  ``runner(init=prev_out)`` RESUMES a
+    previous result's population (state + hypers) for another
+    ``n_rounds`` -- checkpoint/resume for the PBT path; persist/restore
+    the dict's ``state``/``hypers`` across processes with
+    ``utils.checkpoint.save_pytree``/``load_pytree``.
     """
     import jax
     import jax.numpy as jnp
@@ -163,6 +167,14 @@ def compile_pbt(
         log_h = log_h.at[bottom].set(new_rows)
         return (state, log_h), losses
 
+    def _finish(state, log_h, loss_hist):
+        final = loss_hist[-1]
+        # NaN-safe: a member perturbed into divergence in the last round
+        # must not win the argmin (argsort during training already sends
+        # NaNs to the replaced bottom quantile)
+        best_i = jnp.argmin(jnp.where(jnp.isfinite(final), final, jnp.inf))
+        return state, log_h, loss_hist, best_i
+
     @jax.jit
     def run(seed_arr):
         base = jax.random.key(seed_arr)
@@ -174,15 +186,56 @@ def compile_pbt(
             (constrain(init_state), log_h0),
             jax.random.split(k_rounds, n_rounds),
         )
-        final = loss_hist[-1]
-        # NaN-safe: a member perturbed into divergence in the last round
-        # must not win the argmin (argsort during training already sends
-        # NaNs to the replaced bottom quantile)
-        best_i = jnp.argmin(jnp.where(jnp.isfinite(final), final, jnp.inf))
-        return state, log_h, loss_hist, best_i
+        return _finish(state, log_h, loss_hist)
 
-    def runner(seed=0):
-        state, log_h, loss_hist, best_i = run(jnp.uint32(int(seed) % 2**32))
+    @jax.jit
+    def run_resume(seed_arr, state0, log_h0):
+        # fold a resume marker so runner(init=...) at the SAME seed does
+        # not replay the original segment's perturbation key stream --
+        # exploration across segments must be independent, as if these
+        # were rounds n..2n of one longer run
+        base = jax.random.fold_in(jax.random.key(seed_arr), 1)
+        _, k_rounds = jax.random.split(base)
+        (state, log_h), loss_hist = jax.lax.scan(
+            train_rounds,
+            (constrain(state0), log_h0),
+            jax.random.split(k_rounds, n_rounds),
+        )
+        return _finish(state, log_h, loss_hist)
+
+    def runner(seed=0, init=None):
+        """``init=prev_out`` resumes: the population state AND hypers of
+        a previous result dict (or one rebuilt via
+        ``utils.checkpoint.load_pytree``) continue for another
+        ``n_rounds`` -- checkpoint/resume for the on-device PBT path,
+        matching ``device_loop``'s ``runner(init=...)`` contract."""
+        if init is not None:
+            missing = [n for n in names if n not in init["hypers"]]
+            if missing:
+                raise ValueError(
+                    f"init hypers missing {missing}; expected {names}"
+                )
+            bad = {
+                n: np.shape(init["hypers"][n])
+                for n in names if np.shape(init["hypers"][n]) != (P,)
+            }
+            if bad:
+                raise ValueError(
+                    f"init hypers must cover {P} members x {names}; "
+                    f"got shapes {bad}"
+                )
+            log_h0 = jnp.log(jnp.stack(
+                [jnp.asarray(init["hypers"][n], jnp.float32) for n in names],
+                axis=1,
+            ))
+            state, log_h, loss_hist, best_i = run_resume(
+                np.uint32(int(seed) % 2**32), init["state"], log_h0
+            )
+            return _package(state, log_h, loss_hist, best_i)
+        state, log_h, loss_hist, best_i = run(np.uint32(int(seed) % 2**32))
+        return _package(state, log_h, loss_hist, best_i)
+
+    def _package(state, log_h, loss_hist, best_i):
         loss_hist = np.asarray(loss_hist)
         log_h = np.asarray(log_h)
         bi = int(best_i)

@@ -24,6 +24,8 @@ __all__ = [
     "load_obs_buffer_orbax",
     "save_trials",
     "load_trials",
+    "save_pytree",
+    "load_pytree",
 ]
 
 
@@ -173,6 +175,51 @@ def load_obs_buffer_orbax(space, directory):
     buf._n_scanned = int(data["n_scanned"])
     buf._pending = [int(i) for i in np.asarray(data["pending"])[1:]]
     return buf
+
+
+def save_pytree(tree, path):
+    """Checkpoint an arbitrary array pytree (population-scheduler state:
+    ``compile_pbt``/``compile_sha`` ``out["state"]``, model params, ...)
+    to one .npz, keyed by tree path.  Dependency-free counterpart of an
+    orbax tree save; pairs with :func:`load_pytree` and the schedulers'
+    ``runner(init=...)`` resume."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    arrays = {
+        jax.tree_util.keystr(kp): np.asarray(v) for kp, v in leaves
+    }
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load_pytree(target, path):
+    """Rebuild a pytree with ``target``'s structure from a saved .npz;
+    shapes and dtypes are validated leaf by leaf (``target`` may be the
+    live pytree or an abstract one of zeros)."""
+    import jax
+
+    with np.load(path) as data:
+        def fill(kp, leaf):
+            key = jax.tree_util.keystr(kp)
+            if key not in data:
+                raise ValueError(f"checkpoint is missing leaf {key!r}")
+            arr = data[key]
+            # shape/dtype attributes only -- np.asarray on a live device
+            # pytree would pull every array to host just to validate
+            want_shape = tuple(np.shape(leaf))
+            want_dtype = np.dtype(getattr(leaf, "dtype", type(leaf)))
+            if arr.shape != want_shape or arr.dtype != want_dtype:
+                raise ValueError(
+                    f"leaf {key!r}: checkpoint {arr.shape}/{arr.dtype} "
+                    f"does not match target {want_shape}/{want_dtype}"
+                )
+            return arr
+
+        return jax.tree_util.tree_map_with_path(fill, target)
 
 
 def save_trials(trials, path):

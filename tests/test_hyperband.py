@@ -435,6 +435,37 @@ def test_asha_failed_evaluations_never_promote():
     assert np.isfinite(out["best_loss"])
 
 
+def test_asha_concurrency_fuzz():
+    """Randomized evaluation durations x many workers: the scheduler's
+    invariants hold under real interleavings -- exact job count, valid
+    budget ladder, promotion chains intact (every rung-r config was
+    evaluated at rung r-1 first)."""
+    import time as _time
+
+    from hyperopt_tpu.hyperband import asha
+
+    def jittery(cfg, budget):
+        # thread-safe jitter: derived from the inputs, no shared rng
+        _time.sleep((hash((round(cfg["x"], 6), budget)) % 30) / 10_000.0)
+        return (cfg["x"] - 3.0) ** 2 / budget
+
+    for seed in range(3):
+        out = asha(
+            jittery, SPACE, max_budget=9, eta=3, max_jobs=60,
+            workers=8, rstate=np.random.default_rng(seed),
+        )
+        trials = out["trials"]
+        assert len(trials) == 60
+        budgets = [t["result"]["budget"] for t in trials.trials]
+        assert set(budgets) <= {1, 3, 9}
+        x_at = lambda b: {
+            round(t["misc"]["vals"]["x"][0], 9)
+            for t in trials.trials if t["result"]["budget"] == b
+        }
+        assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+        assert sum(r["n"] for r in out["rungs"]) == 60
+
+
 def test_compile_hyperband_on_device():
     """Full multi-bracket Hyperband as chained on-device ladders: the
     bracket spread (eta**s configs at rung-0 budget steps*eta**(s_max-s))
