@@ -1,15 +1,20 @@
 """Budget-aware schedulers the reference cannot express, on-device.
 
-Two ways to spend a training budget smarter than independent trials:
+Ways to spend a training budget smarter than independent trials:
 
 * **PBT** (``pbt.compile_pbt``): the population trains as one program;
   every ``exploit_every`` steps the bottom quartile copies a top
-  member's weights and perturbs its hyperparameters.
+  member's weights and perturbs its hyperparameters.  The result dict
+  RESUMES (``runner(init=prev_out)``) -- checkpoint/continue mid-study.
 * **Successive halving** (``hyperband.compile_sha``): rungs of
   shrinking population and growing budget; survivors CONTINUE from
-  their trained state.
+  their trained state.  ``replicas=K`` packs K independent brackets
+  into every rung program (late rungs fill the chip with other
+  brackets' members -- K results for ~one bracket's wall-clock).
+* **Hyperband** (``hyperband.compile_hyperband``): the full bracket
+  spread as chained ladders.
 
-Both share the same train-fn contract and run here over a tiny
+All share the same train-fn contract and run here over a tiny
 transformer LM population (models/transformer.py).
 
     python examples/09_pbt_and_sha.py [--pop 16] [--rounds 10]
@@ -24,7 +29,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from hyperopt_tpu.hyperband import compile_sha
+from hyperopt_tpu.hyperband import compile_hyperband, compile_sha
 from hyperopt_tpu.models import transformer
 from hyperopt_tpu.pbt import compile_pbt
 
@@ -58,6 +63,11 @@ def main():
         f"{np.nanmedian(out['loss_history'][-1]):.4f} "
         f"(best lr {out['best_hypers']['lr']:.3g})"
     )
+    resumed = pbt_runner(seed=1, init=out)  # checkpoint/continue
+    print(
+        f"PBT resumed +{resumed['n_steps']} steps -> "
+        f"best {resumed['best_loss']:.4f}"
+    )
 
     sha_runner = compile_sha(
         train_fn, (params, momentum), bounds,
@@ -68,6 +78,20 @@ def main():
     print(
         f"SHA: rungs {sched} (survivors continue training) -> "
         f"best {out['best_loss']:.4f} (lr {out['best_hypers']['lr']:.3g})"
+    )
+
+    def init_members(key, n):
+        p = transformer.init_population(model, n, key, seq_len=32)
+        return (p, jax.tree.map(jnp.zeros_like, p))
+
+    hb_runner = compile_hyperband(
+        train_fn, init_members, bounds, s_max=2, eta=2, steps_per_rung=3,
+    )
+    out = hb_runner(seed=0)
+    print(
+        f"Hyperband: brackets "
+        f"{[b['n_configs'] for b in out['brackets']]} -> "
+        f"best {out['best_loss']:.4f} (bracket {out['best_bracket']})"
     )
 
 

@@ -115,6 +115,7 @@ def test_example_pbt_and_sha_smoke():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "PBT:" in out.stdout and "SHA: rungs" in out.stdout
+    assert "PBT resumed" in out.stdout and "Hyperband: brackets" in out.stdout
 
 
 @pytest.mark.slow
