@@ -137,7 +137,8 @@ def compile_fmin(
         width: each device draws ``ceil(total / n_dev)`` so the executed
         total rounds up to a device multiple.  Composes with
         ``trial_axis`` on a 2-D mesh (population sharded, sweep
-        sharded); requires ``algo='tpe'`` and factorized EI.
+        sharded); requires ``algo='tpe'`` or ``'atpe'`` (the sweep is
+        what shards) and factorized EI.
       loss_threshold: stop as soon as a trial reaches this loss (fmin's
         stopping-rule parity) -- the scan becomes a ``lax.while_loop``,
         so a threshold hit early really does cut device wall-clock.
