@@ -249,6 +249,25 @@ def test_compile_sha_validates():
                     {"lr": (1.0, 0.5)}, n_configs=4)
 
 
+def test_compile_sha_replicas_compose_with_mesh():
+    """Bracket packing under a population mesh: the stacked K*P member
+    axis shards over 'trial' and per-bracket ranking survives."""
+    from hyperopt_tpu.parallel.mesh import mesh_from_spec
+
+    mesh = mesh_from_spec((8,), ("trial",))
+    P, K = 8, 2
+    runner = compile_sha(
+        linear_train_fn,
+        {"theta": jnp.full((K * P,), 5.0)},
+        {"lr": (1e-3, 1.0)},
+        n_configs=P, eta=2, steps_per_rung=3, replicas=K, mesh=mesh,
+    )
+    out = runner(seed=0)
+    assert [r["n"] for r in out["rungs"]] == [8, 4, 2, 1]
+    assert len(out["replica_bests"]) == K
+    assert out["best_loss"] < 1e-3
+
+
 def test_compile_sha_mesh_sharded_rungs():
     """SHA under a population mesh: rung populations shrink below the
     axis size (8 -> 4 -> 2 -> 1 on an 8-device mesh) and GSPMD handles
