@@ -52,7 +52,8 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
     * per-step decisions become traced scalars of the carry: the
       round-3 stall detector (best-loss gain over the last
       ``min(15, n//2)`` trials <= 2% of total gain) drives
-      ``prior_weight`` 1->1.5 + a 25% pure-prior restart fraction when
+      ``prior_weight`` to the absolute value 1.5 (host parity -- NOT a
+      multiple of the base) + a 25% pure-prior restart fraction when
       stalled, and sharpens ``gamma`` by 0.05 when improving;
     * parameter locking becomes a masked reduction: the elite set's
       per-dim spread (latent std vs 5% of prior width; categorical
@@ -149,7 +150,10 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
         gamma = jnp.where(
             improving, jnp.maximum(0.15, base_gamma - 0.05), base_gamma
         )
-        pw = jnp.where(stalled, 1.5 * pw0, pw0)
+        # host parity: ATPEOptimizer sets the ABSOLUTE value 1.5 when
+        # stalled (atpe.py tpe_settings), not a multiple of the base --
+        # the two agree only at prior_weight=1.0
+        pw = jnp.where(stalled, jnp.float32(1.5), pw0)
         explore = jnp.where(stalled, 0.25, 0.0)
         return gamma, pw, explore, ok, n
 

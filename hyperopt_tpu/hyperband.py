@@ -43,11 +43,16 @@ __all__ = [
 
 def _budgets_integral(max_budget, min_budget):
     """The shared integral-budget rule: fn sees ints whenever
-    ``max_budget`` is an int and ``min_budget`` is a whole number (so
-    epoch-count objectives survive hyperband's whole-float bracket
-    minimums).  One definition for every driver."""
+    ``max_budget`` is integral (python int OR any ``numbers.Integral``,
+    e.g. ``np.int64`` -- an epoch-count objective asserting ints must
+    not see 9.0 because the budget came through numpy) and
+    ``min_budget`` is a whole number (so epoch-count objectives survive
+    hyperband's whole-float bracket minimums).  One definition for
+    every driver."""
+    import numbers
+
     return (
-        isinstance(max_budget, int)
+        isinstance(max_budget, numbers.Integral)
         and float(min_budget) == round(float(min_budget))
     )
 
@@ -352,11 +357,35 @@ def compile_sha(
             )
         return state
 
-    # init_state may be a zero-arg callable: materialized per run and
-    # released after it, so schedulers holding MANY compile_sha programs
+    # init_state may be a callable: materialized per run and released
+    # after it, so schedulers holding MANY compile_sha programs
     # (compile_hyperband's brackets) don't pin every bracket's full
-    # population in memory for the runner's lifetime
-    if not callable(init_state):
+    # population in memory for the runner's lifetime.  A one-arg
+    # callable receives the runner's seed, so seed sweeps can vary the
+    # initial population too (advisor r4).
+    init_takes_seed = False
+    if callable(init_state):
+        import inspect as _inspect
+
+        # seed-taking ONLY on a required positional parameter: a
+        # zero-required-arg callable (default-capture lambdas, **kwargs,
+        # non-introspectable C callables) keeps the zero-arg contract --
+        # passing the seed into a default-bound parameter would silently
+        # override the captured value
+        try:
+            init_takes_seed = any(
+                p.default is _inspect.Parameter.empty
+                and p.kind in (
+                    _inspect.Parameter.POSITIONAL_ONLY,
+                    _inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                )
+                for p in _inspect.signature(
+                    init_state
+                ).parameters.values()
+            )
+        except (TypeError, ValueError):
+            init_takes_seed = False
+    else:
         _validate_leading(init_state)
     names, log_lo, log_hi = _log_bounds(hyper_bounds)
     constrain = _make_constrain(mesh, trial_axis)
@@ -402,10 +431,11 @@ def compile_sha(
         base = jax.random.key(int(seed) % 2**32)
         k_init, *rung_keys = jax.random.split(base, n_rungs + 1)
         log_h = init_hypers(k_init)
-        state = constrain(
-            _validate_leading(init_state()) if callable(init_state)
-            else init_state
-        )
+        if callable(init_state):
+            raw = init_state(int(seed)) if init_takes_seed else init_state()
+            state = constrain(_validate_leading(raw))
+        else:
+            state = constrain(init_state)
         n_live = P0
         steps = int(steps_per_rung)
         sched = []
@@ -495,7 +525,12 @@ def compile_hyperband(
         population train-fn contract.
       init_state_fn: ``(key, n) -> state pytree`` with leading dim
         ``n`` on every leaf (e.g. ``transformer.init_population``
-        wrapped); called once per bracket at build time.
+        wrapped).  Deliberately LAZY: invoked once per bracket on every
+        ``runner()`` call (not at build time), so peak memory is one
+        bracket's population, released after its ladder runs.  The key
+        folds the bracket id with the runner seed, so ``runner(seed=0)``
+        and ``runner(seed=1)`` start every bracket from DIFFERENT
+        initial populations (advisor r4).
       s_max: bracket count - 1; the widest bracket has ``eta**s_max``
         configs per replica.
 
@@ -514,9 +549,12 @@ def compile_hyperband(
             train_fn,
             # lazy: each bracket's population materializes when ITS
             # ladder runs and is released after, so peak memory is one
-            # bracket, not the sum of all of them
-            (lambda s_=s, n_=n_s: init_state_fn(
-                jax.random.key(s_), int(replicas) * n_
+            # bracket, not the sum of all of them.  The one-arg form
+            # receives the ladder's seed: folding it into the bracket
+            # key makes seed sweeps vary initial populations too.
+            (lambda seed_, s_=s, n_=n_s: init_state_fn(
+                jax.random.fold_in(jax.random.key(s_), seed_ % 2**31),
+                int(replicas) * n_,
             )),
             hyper_bounds,
             n_configs=n_s,
@@ -629,14 +667,19 @@ def asha(
     done = [[] for _ in range(n_rungs)]
     promoted = [set() for _ in range(n_rungs)]
     configs = {}  # config_key -> config dict (index-form vals)
+    pending = {}  # config_key -> suggested doc, completed at its rung-0 record
     started = 0
 
     def _suggest_one():
-        """One new rung-0 configuration through the algo seam."""
+        """One new rung-0 configuration through the algo seam.  The
+        suggested doc itself is kept (``pending``) and completed by the
+        rung-0 ``_record``, reusing its tid -- allocating a second tid
+        for the stored doc would leave the suggestion's tid orphaned and
+        the store's tid sequence non-contiguous (advisor r4)."""
         seed = int(rstate.integers(0, 2**31 - 1))
         (tid,) = trials.new_trial_ids(1)
         (doc,) = algo([tid], domain, trials, seed)
-        return _vals_of(doc)
+        return doc
 
     def _next_job():
         """Scheduler core, called under the lock: the highest-rung
@@ -652,7 +695,9 @@ def asha(
                     started += 1
                     return key, r + 1
         key = len(configs)
-        configs[key] = _suggest_one()
+        doc = _suggest_one()
+        configs[key] = _vals_of(doc)
+        pending[key] = doc
         started += 1
         return key, 0
 
@@ -660,14 +705,6 @@ def asha(
         from .base import JOB_STATE_DONE
 
         b = rung_budget(r)
-        (tid,) = trials.new_trial_ids(1)
-        misc = {
-            "tid": tid,
-            "cmd": ("domain_attachment", "FMinIter_Domain"),
-            "workdir": None,
-            "idxs": {k: [tid] for k in configs[key]},
-            "vals": {k: [v] for k, v in configs[key].items()},
-        }
         result = {
             "status": "ok",
             "loss": float(loss) if np.isfinite(loss) else None,
@@ -675,7 +712,22 @@ def asha(
         }
         if result["loss"] is None:
             result["status"] = "fail"
-        (doc,) = trials.new_trial_docs([tid], [None], [result], [misc])
+        doc = pending.pop(key, None)
+        if doc is not None:
+            # rung 0 completes the SUGGESTED doc itself (tid reuse)
+            doc["result"] = result
+        else:
+            # promotions append a NEW trial per (config, budget):
+            # lower-rung results stay as learning-curve history
+            (tid,) = trials.new_trial_ids(1)
+            misc = {
+                "tid": tid,
+                "cmd": ("domain_attachment", "FMinIter_Domain"),
+                "workdir": None,
+                "idxs": {k: [tid] for k in configs[key]},
+                "vals": {k: [v] for k, v in configs[key].items()},
+            }
+            (doc,) = trials.new_trial_docs([tid], [None], [result], [misc])
         doc["state"] = JOB_STATE_DONE
         trials.insert_trial_docs([doc])
         # refresh under the lock so a model-based rung-0 algo (tpe_jax,

@@ -534,3 +534,102 @@ def test_compile_sha_transformer_rungs():
     out = runner(seed=0)
     assert np.isfinite(out["best_loss"])
     assert out["best_loss"] <= out["rungs"][0]["best_loss"]
+
+
+# ---------------------------------------------------------------------------
+# round-5 advisor regressions
+# ---------------------------------------------------------------------------
+
+
+def test_budgets_integral_accepts_numpy_ints():
+    """np.int64 max_budget is integral too (advisor r4): an epoch-count
+    objective asserting ints must not see 9.0 because the budget came
+    through numpy arithmetic."""
+    seen = []
+
+    def int_checking(cfg, budget):
+        seen.append(budget)
+        assert isinstance(budget, int), budget
+        return (cfg["x"] - 3.0) ** 2 / budget
+
+    out = successive_halving(
+        int_checking, SPACE, max_budget=np.int64(9), eta=3,
+        rstate=np.random.default_rng(0),
+    )
+    assert np.isfinite(out["best_loss"])
+    assert set(seen) == {1, 3, 9}
+
+
+def test_asha_tid_sequence_contiguous():
+    """The rung-0 suggestion's tid is REUSED by its record (advisor r4):
+    no orphaned tids, so the store's tid sequence is exactly 0..N-1."""
+    from hyperopt_tpu.hyperband import asha
+
+    out = asha(
+        budgeted_quad, SPACE, max_budget=9, eta=3, max_jobs=30,
+        workers=1, rstate=np.random.default_rng(2),
+    )
+    tids = sorted(t["tid"] for t in out["trials"].trials)
+    assert tids == list(range(30))
+
+
+def test_compile_sha_init_state_seed_arg():
+    """A one-arg init_state callable receives the runner's seed, so seed
+    sweeps can vary the initial population (advisor r4)."""
+    got = []
+
+    def init(seed):
+        got.append(seed)
+        return {"theta": jnp.full((4,), 2.0)}
+
+    runner = compile_sha(
+        linear_train_fn, init, {"lr": (1e-3, 1.0)},
+        n_configs=4, eta=2, steps_per_rung=2,
+    )
+    runner(seed=3)
+    runner(seed=11)
+    assert got == [3, 11]
+
+
+def test_compile_hyperband_seed_varies_initial_population():
+    """runner(seed=...) folds into each bracket's init key: different
+    seeds start every bracket from DIFFERENT initial populations, while
+    the same seed reproduces bitwise (advisor r4 -- previously keyed by
+    bracket id alone)."""
+    from hyperopt_tpu.hyperband import compile_hyperband
+
+    keys = []
+
+    def init(key, n):
+        keys.append(np.asarray(jax.random.key_data(key)).tolist())
+        return {"theta": 2.0 + jax.random.uniform(key, (n,))}
+
+    runner = compile_hyperband(
+        linear_train_fn, init, {"lr": (1e-3, 1.0)},
+        s_max=1, eta=2, steps_per_rung=2,
+    )
+    runner(seed=0)
+    k_seed0 = list(keys)
+    keys.clear()
+    runner(seed=1)
+    k_seed1 = list(keys)
+    assert k_seed1 != k_seed0  # the seed reaches the init key
+    keys.clear()
+    runner(seed=1)
+    assert keys == k_seed1  # and stays deterministic per seed
+
+
+def test_compile_sha_zero_required_arg_callables_keep_zero_arg_call():
+    """Default-valued / **kwargs callables are NOT seed-taking: passing
+    the seed into a default-bound parameter would silently override the
+    captured value (code-review r5)."""
+    state = {"theta": jnp.full((4,), 2.0)}
+    for init in (
+        lambda s_=state: s_,            # default-capture idiom
+        lambda **kw: state,             # kwargs-only
+    ):
+        runner = compile_sha(
+            linear_train_fn, init, {"lr": (1e-3, 1.0)},
+            n_configs=4, eta=2, steps_per_rung=2,
+        )
+        assert np.isfinite(runner(seed=3)["best_loss"])
