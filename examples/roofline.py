@@ -182,6 +182,177 @@ def run_experiments(args):
     return results
 
 
+def run_b1(args):
+    """Per-trial cost decomposition of the SEQUENTIAL B=1 device loop --
+    the flagship quality mode (VERDICT r4 weak #1).
+
+    The batched roofline says nothing about this regime: at B=1 the
+    [S, K] sweep is ~4096x smaller than the benched B=4096 program, so
+    fixed per-step costs dominate.  Each component of the step
+    (``device_loop.compile_fmin`` batch_size=1) is timed as its own
+    1000-iteration ``lax.scan`` at the REAL shapes (cap=1024 history,
+    20-dim mixed space, 128/24 candidates), output folded into a scalar
+    carry (serializes steps + defeats DCE), completion forced by the
+    scalar fetch.  Prints one JSON line with ms/step per component.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.device_loop import compile_fmin
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn_jax
+    from hyperopt_tpu.ops import kernels as K
+    from hyperopt_tpu.ops.compile import compile_space
+
+    platform = jax.devices()[0].platform
+    N = args.b1_steps  # steps per component program
+    S, S_cat = args.n_cand, 24
+    gamma, lf, pw = 0.25, 25.0, 1.0
+    results = {"platform": platform, "n_steps": N, "n_cand": S}
+
+    # -- the real thing: full runner, tpe vs rand ------------------------
+    space = mixed_space()
+    for algo in ("tpe", "rand"):
+        runner = compile_fmin(
+            mixed_space_fn_jax, space, max_evals=N, batch_size=1,
+            n_EI_candidates=S, n_EI_candidates_cat=S_cat, algo=algo,
+        )
+        runner(seed=1)  # compile (runner fetches its results = completion)
+        t0 = time.perf_counter()
+        runner(seed=7)
+        results[f"loop_{algo}_ms"] = round(
+            (time.perf_counter() - t0) / N * 1000, 4
+        )
+
+    # -- components, each as its own scan at the real shapes -------------
+    ps = compile_space(space)
+    c = ps._consts
+    cap = 1024
+    key0 = jax.random.key(0)
+    values, active = jax.device_get(ps.sample_prior(key0, cap))
+    values = jnp.asarray(values)
+    active = jnp.asarray(active)
+    losses = jnp.asarray(
+        np.random.default_rng(0).uniform(0, 10, cap).astype(np.float32)
+    )
+    valid = jnp.ones((cap,), bool)
+    cont_idx = c["cont_idx"]
+    lat = jnp.where(
+        c["logspace"][:, None], jnp.log(jnp.maximum(values[cont_idx], 1e-30)),
+        values[cont_idx],
+    )
+    act_c = active[cont_idx]
+    dc = int(cont_idx.shape[0])
+    pw_v = jnp.full((dc,), pw, jnp.float32)
+    lf_v = jnp.full((dc,), lf, jnp.float32)
+    lf_pad = K._below_pad(lf, cap=cap, gamma=gamma)
+    below0, above0, _ = K.split_below_above(losses, valid, gamma, lf)
+    fits0 = K.fit_all_dims(c, values, active, losses, valid, gamma, lf, pw)
+
+    def timed_scan(name, step_fn):
+        @jax.jit
+        def prog(key):
+            def body(acc, i):
+                return acc + step_fn(jax.random.fold_in(key, i)), None
+            acc, _ = jax.lax.scan(body, jnp.float32(0.0), jnp.arange(N))
+            return acc
+        float(prog(jax.random.key(1)))  # compile + first run
+        t0 = time.perf_counter()
+        float(prog(jax.random.key(2)))  # scalar fetch forces completion
+        results[name] = round((time.perf_counter() - t0) / N * 1000, 4)
+
+    # scan floor: key fold + a trivial draw
+    timed_scan("scan_floor_ms", lambda k: jax.random.uniform(k, ()))
+
+    # good/bad split: argsort [cap] + rank scatter
+    def step_split(k):
+        b, a, nb = K.split_below_above(
+            losses + jax.random.uniform(k, ()), valid, gamma, lf
+        )
+        return jnp.sum(b.astype(jnp.float32)) + nb
+
+    timed_scan("split_ms", step_split)
+
+    # below-set compaction: vmapped stable argsort [cap] per cont dim
+    def step_compact(k):
+        m = act_c & (below0[None, :] ^ (jax.random.uniform(k, ()) > 2.0))
+        lat_b, mask_b = jax.vmap(K.compact_below, in_axes=(0, 0, None))(
+            lat, m, lf_pad
+        )
+        return jnp.sum(lat_b * mask_b)
+
+    timed_scan("compact_below_ms", step_compact)
+
+    # above-model Parzen fit: vmapped argsort-by-mu at [cap + 1]
+    def step_fit_above(k):
+        wa, ma, sa = jax.vmap(K.parzen_fit)(
+            lat + jax.random.uniform(k, ()), act_c & above0[None, :],
+            c["prior_mu"], c["prior_sigma"], pw_v, lf_v,
+        )
+        return jnp.sum(wa) + jnp.sum(ma[:, :2]) + jnp.sum(sa[:, :2])
+
+    timed_scan("fit_above_cont_ms", step_fit_above)
+
+    # the whole fit front half (split + compact + below/above + cat)
+    def step_fit_all(k):
+        f = K.fit_all_dims(
+            c, values, active, losses + jax.random.uniform(k, ()),
+            valid, gamma, lf, pw,
+        )
+        out = jnp.float32(0.0)
+        for fam in ("cont", "cat"):
+            if f[fam] is not None:
+                out += sum(jnp.sum(t[:, :2]) for t in f[fam])
+        return out
+
+    timed_scan("fit_all_ms", step_fit_all)
+
+    # EI candidate sweep at B=1 with FIXED fits (the back half)
+    def step_sweep(k):
+        keys = jax.random.split(k, ps.n_dims)
+        v_cont, s_cont = K.ei_sweep_cont(
+            ps.q, c, keys[None, :dc], fits0["cont"], S
+        )
+        v_cat, s_cat = K.ei_sweep_cat(
+            keys[None, dc:], *fits0["cat"], S_cat
+        )
+        return jnp.sum(v_cont) + jnp.sum(s_cont) + jnp.sum(v_cat)
+
+    timed_scan("sweep_ms", step_sweep)
+
+    # objective eval + history scatter (buffer carry, fixed suggestion)
+    col = values[:, :1]
+    acol = active[:, :1]
+
+    @jax.jit
+    def prog_scatter(key):
+        def body(carry, i):
+            v, a, l = carry
+            # fold i: a loop-invariant objective would be hoisted out of
+            # the scan and the component would time only the scatter
+            ki = jax.random.fold_in(key, i)
+            nl = mixed_space_fn_jax(
+                {lab: col[d] + jax.random.uniform(ki, ())
+                 for d, lab in enumerate(ps.labels)}
+            )
+            idx = i * 1 + jnp.arange(1)
+            return (
+                v.at[:, idx].set(col), a.at[:, idx].set(acol),
+                l.at[idx].set(nl.astype(jnp.float32)),
+            ), None
+        (v, a, l), _ = jax.lax.scan(
+            body, (values, active, losses), jnp.arange(N)
+        )
+        return jnp.sum(l[:4])
+
+    float(prog_scatter(jax.random.key(1)))
+    t0 = time.perf_counter()
+    float(prog_scatter(jax.random.key(2)))
+    results["eval_scatter_ms"] = round((time.perf_counter() - t0) / N * 1000, 4)
+
+    print(json.dumps(results))
+    return results
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=4096)
@@ -192,9 +363,17 @@ def main():
     ap.add_argument("--experiments", action="store_true",
                     help="run the round-4 roofline-suspect experiments "
                     "instead of the headline arithmetic")
+    ap.add_argument("--b1", action="store_true",
+                    help="decompose the sequential B=1 device loop's "
+                    "per-trial cost (round-5)")
+    ap.add_argument("--b1-steps", type=int, default=1000,
+                    help="steps per component program in --b1 mode")
     args = ap.parse_args()
     if args.experiments:
         run_experiments(args)
+        return
+    if args.b1:
+        run_b1(args)
         return
 
     import jax

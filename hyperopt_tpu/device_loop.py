@@ -456,12 +456,19 @@ def compile_fmin(
                 best0 = np.float32(fin.min())
         # scalars as host numpy (uncommitted) for the same multi-process
         # placement reason as the zero buffers above
-        values, active, losses, valid, best_i, n_done = jax.block_until_ready(
-            run(
-                np.uint32(int(seed) % (2**32)),
-                values0, active0, losses0, valid0, np.int32(c0),
-                np.float32(best0),
-            )
+        out_dev = run(
+            np.uint32(int(seed) % (2**32)),
+            values0, active0, losses0, valid0, np.int32(c0),
+            np.float32(best0),
+        )
+        # ONE batched device->host fetch for every result (values/active/
+        # losses/valid/best_i/n_done): per-array np.asarray fetches paid
+        # one tunnel round-trip EACH and were 63% of a 1k-trial B=1
+        # runner call (measured, bench_artifacts/ROOFLINE.md round 5);
+        # device_get also forces completion (block_until_ready is a
+        # no-op on remote-attached platforms)
+        values, active, losses, valid, best_i, n_done = jax.device_get(
+            out_dev
         )
         n_ran = int(n_done) * B
         total = c0 + n_ran

@@ -83,12 +83,19 @@ def compact_below(obs_row, below_row, lf_pad):
 
     The below model has at most ``n_below <= LF`` components, but the
     observation buffer is capacity-sized; compacting before the Parzen fit
-    shrinks the candidate-scoring inner dimension ~cap/LF-fold.  A stable
-    argsort on ~mask keeps slot (time) order, so forgetting weights are
-    unchanged.
+    shrinks the candidate-scoring inner dimension ~cap/LF-fold.  Selection
+    is ``top_k`` over descending slot keys -- the first ``lf_pad`` set
+    slots in slot (time) order, so forgetting weights are unchanged --
+    instead of a full stable argsort over the capacity (measured 1.4x
+    on the B=1 device-loop fit, bench_artifacts/ROOFLINE.md round 5).
+    Slots past the set count gather garbage values under a False mask
+    (ignored by every consumer, exactly as the argsort form's inf-pad).
     """
-    order = jnp.argsort(~below_row, stable=True)
-    idx = order[:lf_pad]
+    n = below_row.shape[0]
+    slot_key = jnp.where(
+        below_row, jnp.arange(n, 0, -1, dtype=jnp.int32), 0
+    )
+    _, idx = jax.lax.top_k(slot_key, lf_pad)
     return obs_row[idx], below_row[idx]
 
 
@@ -206,11 +213,16 @@ def parzen_fit(obs, mask, prior_mu, prior_sigma, prior_weight, lf):
         [jnp.zeros_like(mask), jnp.ones((1,), dtype=bool)]
     )
 
-    order = jnp.argsort(vals, stable=True)
-    sv = vals[order]
-    sw = wts[order]
-    sprior = is_prior[order]
-    svalid = valid[order]
+    # ONE variadic stable sort carrying every payload: bitwise-identical
+    # to argsort + four gathers, but TPU gathers serialize -- the fused
+    # sort is 4x faster at capacity width on the B=1 device loop
+    # (bench_artifacts/ROOFLINE.md round 5; the fit was 40% of a step)
+    sv, sw, sprior, svalid = jax.lax.sort(
+        (vals, wts, is_prior.astype(jnp.int8), valid.astype(jnp.int8)),
+        num_keys=1, is_stable=True,
+    )
+    sprior = sprior.astype(bool)
+    svalid = svalid.astype(bool)
 
     m = sv.shape[0]
     neg = -jnp.inf
@@ -522,26 +534,58 @@ def _ei_sweep_grouped(q_np, consts, cont_keys, fit_arrays, n_cand, kernel):
     ``kernel(key, *fits, *consts, n_cand=, has_q=)`` double-vmapped over
     (trial, dim) per group, and scatter-merge the per-group outputs.
     Every dim lands in exactly one group, so the zero inits never leak.
+
+    At B=1 (the sequential device loop / single-ask latency path) the
+    [S, K] grids are tiny and per-kernel overhead dominates, so BOTH
+    families run as ONE fused group with traced-``q`` dispatch instead
+    -- each dim's selected family computes the same formulas on the
+    same per-dim key, so outputs are bitwise identical to the
+    partitioned form, at ~0.08 ms/step less (measured, B=1 device loop,
+    bench_artifacts/ROOFLINE.md round 5).  Batched calls keep the
+    partition: there the grids are large and the saved ndtr FLOPs win.
     """
     B, Dc = cont_keys.shape
     outs = None
     q_np = np.asarray(q_np)
-    for has_q, pos in (
+    groups = (
         (False, np.flatnonzero(q_np <= 0)),
         (True, np.flatnonzero(q_np > 0)),
-    ):
+    )
+    if B == 1 and all(p.size for _, p in groups):
+        groups = ((None, np.arange(len(q_np))),)
+    for has_q, pos in groups:
         if pos.size == 0:
             continue
-        grp_fits = tuple(t[pos] for t in fit_arrays)
-        grp_consts = tuple(
-            consts[k][pos] for k in ("low", "high", "logspace", "q")
-        )
+        if pos.size == len(q_np):
+            # identity group (the fused B=1 path): indexing runtime
+            # arrays with arange emits per-dim gathers, which serialize
+            # on TPU and cost more than the fused sweep itself
+            grp_fits = tuple(fit_arrays)
+            grp_consts = tuple(
+                consts[k] for k in ("low", "high", "logspace", "q")
+            )
+        else:
+            grp_fits = tuple(t[pos] for t in fit_arrays)
+            grp_consts = tuple(
+                consts[k][pos] for k in ("low", "high", "logspace", "q")
+            )
         per_dim = jax.vmap(
             lambda k, *a: kernel(k, *a, n_cand=n_cand, has_q=has_q),
             in_axes=(0,) * 11,
         )
         per_batch = jax.vmap(per_dim, in_axes=(0,) + (None,) * 10)
-        res = per_batch(cont_keys[:, pos], *grp_fits, *grp_consts)
+        if B == 1 and pos.size == len(q_np):
+            # identity group at B=1 ONLY: single-dim vmap with the batch
+            # axis re-attached by broadcast -- the size-1 outer vmap and
+            # the arange scatter-merge both lower to serializing ops.
+            # At B > 1 this branch would broadcast row-0's keys to every
+            # column (regression caught by the atpe lock test).
+            res = per_dim(cont_keys[0], *grp_fits, *grp_consts)
+            return tuple(r[None] for r in res)
+        keys_grp = cont_keys if pos.size == len(q_np) else cont_keys[:, pos]
+        res = per_batch(keys_grp, *grp_fits, *grp_consts)
+        if pos.size == len(q_np):
+            return res
         if outs is None:
             outs = tuple(
                 jnp.zeros((B, Dc) + r.shape[2:], r.dtype) for r in res

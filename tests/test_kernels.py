@@ -276,3 +276,69 @@ def test_check_prior_weight_guard():
         tpe_jax.build_suggest_fn(ps, 16, 0.25, 25.0, 0.0)
     with pytest.raises(ValueError, match="prior_weight must be > 0"):
         build_sharded_suggest_fn(ps, default_mesh(), 16, 0.25, 25.0, 0.0)
+
+
+def test_ei_sweep_fused_b1_matches_grouped():
+    """Round-5 B=1 optimization: when a space has BOTH q and non-q
+    continuous dims, the B=1 sweep runs as ONE fused traced-q group
+    (fewer kernels) -- its draws and scores must be bitwise identical
+    to the q-partitioned form, which still runs at B > 1.  Row 0 of a
+    B=2 grouped call uses the same per-dim keys as the B=1 fused call,
+    so the two must agree exactly."""
+    import jax
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.ops.compile import compile_space
+
+    space = {
+        "u": hp.uniform("u", -5.0, 5.0),
+        "qu": hp.quniform("qu", 0.0, 20.0, 1.0),
+        "lu": hp.loguniform("lu", -4.0, 2.0),
+    }
+    ps = compile_space(space)
+    c = ps._consts
+    dc = len(ps.cont_idx)
+    cap = 128
+    rng = np.random.default_rng(0)
+    values, active = jax.device_get(ps.sample_prior(jax.random.key(0), cap))
+    losses = jnp.asarray(rng.uniform(0, 10, cap).astype(np.float32))
+    valid = jnp.ones((cap,), bool)
+    fits = K.fit_all_dims(
+        c, jnp.asarray(values), jnp.asarray(active), losses, valid,
+        0.25, 25.0, 1.0,
+    )
+    keys = jax.random.split(jax.random.key(1), 2 * dc).reshape(2, dc)
+
+    v1, s1 = K.ei_sweep_cont(ps.q, c, keys[:1], fits["cont"], 16)  # fused
+    v2, s2 = K.ei_sweep_cont(ps.q, c, keys, fits["cont"], 16)  # grouped
+    assert np.array_equal(np.asarray(v1[0]), np.asarray(v2[0]))
+    assert np.array_equal(np.asarray(s1[0]), np.asarray(s2[0]))
+
+
+def test_ei_sweep_single_group_batch_rows_independent():
+    """Regression (round 5): the identity-group fast path must never
+    collapse a B > 1 batch onto row 0's keys -- every row draws with its
+    own keys, so rows differ (an all-non-q space is a single group)."""
+    import jax
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.ops.compile import compile_space
+
+    ps = compile_space({
+        "x": hp.uniform("x", -5.0, 5.0),
+        "y": hp.uniform("y", -5.0, 5.0),
+    })
+    c = ps._consts
+    cap = 128
+    rng = np.random.default_rng(1)
+    values, active = jax.device_get(ps.sample_prior(jax.random.key(0), cap))
+    losses = jnp.asarray(rng.uniform(0, 10, cap).astype(np.float32))
+    valid = jnp.ones((cap,), bool)
+    fits = K.fit_all_dims(
+        c, jnp.asarray(values), jnp.asarray(active), losses, valid,
+        0.25, 25.0, 1.0,
+    )
+    keys = jax.random.split(jax.random.key(2), 3 * 2).reshape(3, 2)
+    v, s = K.ei_sweep_cont(ps.q, c, keys, fits["cont"], 16)
+    assert not np.array_equal(np.asarray(v[0]), np.asarray(v[1]))
+    assert not np.array_equal(np.asarray(v[1]), np.asarray(v[2]))
