@@ -11,6 +11,7 @@ from hyperopt_tpu.models.synthetic import DOMAINS
 from test_domains import THRESHOLD_DOMAINS, median5
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("name", THRESHOLD_DOMAINS)
 def test_anneal_jax_hits_thresholds(name):
     domain = DOMAINS[name]

@@ -4,10 +4,13 @@ the hermetic CPU platform, as a real subprocess."""
 
 import json
 import os
+
+import pytest
 import subprocess
 import sys
 
 
+@pytest.mark.slow
 def test_bench_prints_one_json_line():
     env = dict(os.environ)
     env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")

@@ -123,6 +123,7 @@ def test_sample_prior_deterministic():
     np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
 
 
+@pytest.mark.slow
 def test_compile_deeply_nested_choice_stress():
     """Three levels of hp.choice nesting (the NAS-style stress case,
     SURVEY.md SS7 'hard parts'): activity masks must reflect the full

@@ -7,6 +7,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.examples
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -43,18 +45,21 @@ def test_every_example_has_a_test():
     )
 
 
+@pytest.mark.slow
 def test_example_quickstart():
     out = run_example("01_quickstart.py")
     assert out.returncode == 0, out.stderr[-2000:]
     assert "best loss:" in out.stdout
 
 
+@pytest.mark.slow
 def test_example_conditional_space():
     out = run_example("02_conditional_space.py")
     assert out.returncode == 0, out.stderr[-2000:]
     assert "best vals" in out.stdout
 
 
+@pytest.mark.slow
 def test_example_sharded_suggest_virtual_mesh():
     out = run_example(
         "06_sharded_suggest.py", {"HYPEROPT_TPU_VIRTUAL_MESH": "1"}
@@ -76,6 +81,7 @@ def test_example_device_loop():
     assert "trials/s" in out.stdout
 
 
+@pytest.mark.slow
 def test_example_speculative_sequential():
     out = run_example("07_speculative_sequential.py")
     assert out.returncode == 0, out.stderr[-2000:]

@@ -192,6 +192,7 @@ def test_fuzzed_space_tpe_jax_end_to_end(seed):
     check_batch(ps, dense, act)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("seed,algo", [(1, "tpe"), (4, "tpe"), (6, "anneal")])
 def test_fuzzed_space_device_loop(seed, algo):
     """The flagship on-device loop must run fuzzed conditional spaces end

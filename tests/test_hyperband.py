@@ -511,6 +511,7 @@ def test_compile_hyperband_on_device():
     assert all(len(b["replica_bests"]) == 3 for b in packed["brackets"])
 
 
+@pytest.mark.slow
 def test_compile_sha_transformer_rungs():
     """SHA over real LM training: rung budgets deepen survivors and the
     final loss improves on rung-0's best."""

@@ -171,6 +171,7 @@ def test_atpe_no_locking_on_single_dim_space():
     assert locked == {}
 
 
+@pytest.mark.slow
 def test_resnet_tiny_objective_lr_sensitivity():
     from hyperopt_tpu.models import resnet
 
@@ -181,6 +182,7 @@ def test_resnet_tiny_objective_lr_sensitivity():
     assert good < bad  # a sane lr must beat a vanishing one after 2 steps
 
 
+@pytest.mark.slow
 def test_transformer_objective_lr_sensitivity():
     from hyperopt_tpu.models import transformer
 
@@ -191,6 +193,7 @@ def test_transformer_objective_lr_sensitivity():
     assert good < bad  # a sane lr must beat a vanishing one after 6 steps
 
 
+@pytest.mark.slow
 def test_transformer_population_sharded_step():
     """The transformer population trains with the population sharded over
     'trial' and the token batch over 'cand' on the 8-device mesh --
@@ -225,6 +228,7 @@ def test_transformer_population_sharded_step():
     assert losses[-1].min() < losses[0].min()
 
 
+@pytest.mark.slow
 def test_atpe_jax_end_to_end():
     """Adaptive TPE over the device sweep: runs, beats random at median,
     locks respect conditional structure."""
@@ -282,6 +286,7 @@ def test_mixed_space_fn_jax_matches_host():
     assert np.allclose(host, dev, atol=1e-4)
 
 
+@pytest.mark.slow
 def test_atpe_jax_not_worse_than_tpe_on_surrogate():
     """VERDICT round-2 evidence test: adaptive TPE must EARN its name --
     on the HPOBench-style mixed surrogate its online adaptation
@@ -382,6 +387,7 @@ def test_atpe_stall_detector_fires_and_clears():
     assert s["gamma"] < 0.22  # sharpened
 
 
+@pytest.mark.slow
 def test_atpe_jax_trap15_quality():
     """The round-3 stall battery config (deceptive multi-basin trap15):
     ATPE with the stall lever must comfortably beat random's ~0.30

@@ -544,6 +544,7 @@ def _spawn_workers(args_list, timeout=120):
     return procs, outs
 
 
+@pytest.mark.slow
 def test_reserve_cas_exclusive_across_processes(fake_mongo, tmp_path):
     """VERDICT r3 item 6: the reserve CAS proven exclusive across real
     PROCESS boundaries, not just threads -- 4 worker processes drain one

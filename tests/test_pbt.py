@@ -108,6 +108,7 @@ def test_pbt_validates_quantile_and_bounds():
         )
 
 
+@pytest.mark.slow
 def test_pbt_sha_config_fuzz():
     """Randomized scheduler configs: every valid (pop, quantile, rounds,
     bounds) combination must produce finite, shape-correct, in-bounds
@@ -156,6 +157,7 @@ def test_pbt_sha_config_fuzz():
         assert np.isfinite(out["best_loss"])
 
 
+@pytest.mark.slow
 def test_pbt_transformer_population():
     """PBT over real model training: a TinyLM population's next-token
     loss improves and the schedule stays finite end-to-end."""

@@ -73,6 +73,7 @@ def test_sharded_suggest_mixed_conditional_space():
     assert np.isfinite(trials.best_trial["result"]["loss"])
 
 
+@pytest.mark.slow
 def test_sharded_matches_unsharded_quality():
     """Sharded and unsharded TPE should reach comparable losses (same
     algorithm, more candidates)."""
@@ -93,6 +94,7 @@ def test_sharded_matches_unsharded_quality():
     assert unsharded_loss < 1.0
 
 
+@pytest.mark.slow
 def test_sharded_atpe_end_to_end():
     """Adaptive TPE with the warm-path candidate sweep sharded over the
     8-device mesh (``atpe_jax.suggest(mesh=)``): converges, and the

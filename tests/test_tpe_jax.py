@@ -27,6 +27,7 @@ def test_rand_jax_end_to_end():
     assert -10 <= best["x"] <= 10
 
 
+@pytest.mark.slow
 def test_tpe_jax_beats_random_on_quadratic():
     def run(algo, seed):
         trials = Trials()
@@ -183,6 +184,7 @@ def test_tpe_jax_reproducible():
     assert run() == run()
 
 
+@pytest.mark.slow
 def test_tpe_jax_joint_ei_conditional_space():
     """joint_ei=True scores whole configurations; draws must still respect
     bounds, types, and conditional activity, and be deterministic."""
@@ -230,6 +232,7 @@ def test_tpe_jax_joint_ei_conditional_space():
     assert trials.losses() == run().losses()  # fixed seed -> identical
 
 
+@pytest.mark.slow
 def test_tpe_jax_joint_ei_beats_random_on_correlated():
     """Whole-configuration scoring handles a correlated objective: loss
     depends on x + y, which the factorized marginals cannot represent."""
@@ -256,6 +259,7 @@ def test_tpe_jax_joint_ei_beats_random_on_correlated():
     assert joint < random, (joint, random)
 
 
+@pytest.mark.slow
 def test_tpe_jax_wide_space_68_labels():
     """Scaling smoke: a 68-label mixed space (24 uniform, 12 loguniform,
     8 quantized, 12 flat choices, 4 nested choices) compiles and
@@ -527,6 +531,7 @@ def test_speculative_rand_and_atpe_paths(monkeypatch):
     assert dense_calls == [4]  # one adaptive draw serves four asks
 
 
+@pytest.mark.slow
 def test_speculative_fmin_quality_and_structure():
     """End-to-end fmin with speculative asks: same quality profile as
     max_queue_len batching, valid trial docs, beats random."""
@@ -550,6 +555,7 @@ def test_speculative_fmin_quality_and_structure():
     assert min(spec_losses) < 0.35
 
 
+@pytest.mark.slow
 def test_speculative_reproducible():
     from functools import partial
 
@@ -565,6 +571,7 @@ def test_speculative_reproducible():
     assert run() == run()
 
 
+@pytest.mark.slow
 def test_joint_ei_battery_vs_factorized():
     """The joint_ei verdict (measured, 5 seeds, round 2): whole-config
     scoring NEVER materially beats factorized EI -- candidates come from
