@@ -7,6 +7,8 @@ Reuse the runner across seeds to amortize compilation.
     python examples/03_device_loop.py
 """
 
+import os
+
 import time
 
 import jax.numpy as jnp
@@ -40,6 +42,10 @@ def objective(cfg, active):
 
 
 def main():
+    if os.environ.get("HYPEROPT_TPU_COMPILATION_CACHE", "1") != "0":
+        from hyperopt_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()
     runner = compile_fmin(
         objective, space, max_evals=4096, batch_size=64,
         n_EI_candidates=64,

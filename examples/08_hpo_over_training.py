@@ -12,6 +12,7 @@ SGD steps run in ~1 s steady-state (BASELINE.md round 3).
 """
 
 import argparse
+import os
 import time
 
 import numpy as np
@@ -26,6 +27,10 @@ def main():
     ap.add_argument("--steps", type=int, default=8)
     ap.add_argument("--batch-size", type=int, default=8)
     args = ap.parse_args()
+    if os.environ.get("HYPEROPT_TPU_COMPILATION_CACHE", "1") != "0":
+        from hyperopt_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()
 
     obj = transformer.device_objective(
         n_steps=args.steps, batch_size=32, seq_len=32, vocab=32,

@@ -38,7 +38,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--pop", type=int, default=16)
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--no-compilation-cache", action="store_true",
+                    help="skip the persistent XLA compilation cache "
+                    "(on by default: the scheduler ladders are the "
+                    "most compile-heavy programs in the framework)")
     args = ap.parse_args()
+
+    if not args.no_compilation_cache:
+        from hyperopt_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()
 
     P = args.pop
     model = transformer.TinyLM(vocab=32, d_model=32, n_heads=2, n_layers=2,

@@ -17,6 +17,7 @@ Prints one JSON line per checkpoint plus a summary table.
 """
 
 import argparse
+import os
 import json
 import resource
 import time
@@ -35,6 +36,10 @@ def main():
     ap.add_argument("--n-cand", type=int, default=128)
     ap.add_argument("--n-calls", type=int, default=8)
     args = ap.parse_args()
+    if os.environ.get("HYPEROPT_TPU_COMPILATION_CACHE", "1") != "0":
+        from hyperopt_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()
 
     import jax
 

@@ -17,6 +17,7 @@ Prints one JSON line per batch size plus a summary table.
 """
 
 import argparse
+import os
 import json
 import time
 
@@ -31,6 +32,10 @@ def main():
     ap.add_argument("--n-cand", type=int, default=128)
     ap.add_argument("--n-cand-cat", type=int, default=24)
     args = ap.parse_args()
+    if os.environ.get("HYPEROPT_TPU_COMPILATION_CACHE", "1") != "0":
+        from hyperopt_tpu.utils import enable_compilation_cache
+
+        enable_compilation_cache()
 
     import jax
 
