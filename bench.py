@@ -205,6 +205,50 @@ def bench_device_loop(n_evals=8192, batch=128):
         return None
 
 
+def bench_asha_device(max_jobs=40, workers=4, max_budget=27, eta=3):
+    """ASHA driving COMPILED DEVICE training programs (round 5): each
+    evaluation is one jitted TinyLM train run of ``budget`` SGD steps;
+    the async workers overlap host scheduling + result fetches with the
+    device queue.  Returns (asha_seconds, sync_seconds, asha_best,
+    sync_best) at EQUAL jobs -- the sync ladder evaluates the same
+    number of programs serially, paying one dispatch+fetch round-trip
+    per evaluation with an idle device in between.
+    """
+    try:
+        from hyperopt_tpu.hyperband import asha, successive_halving
+        from hyperopt_tpu.models import transformer
+
+        fn = transformer.budget_objective()
+        space = transformer.hpo_space()
+        # warm every rung budget once: compiles out of the timing
+        for b in (1, 3, 9, 27):
+            if b <= max_budget:
+                fn({"lr": 0.1, "wd": 1e-4}, b)
+
+        t0 = time.perf_counter()
+        out_a = asha(
+            fn, space, max_budget=max_budget, eta=eta, max_jobs=max_jobs,
+            workers=workers, rstate=np.random.default_rng(0),
+        )
+        asha_s = time.perf_counter() - t0
+
+        # the sync ladder at the same total evaluation count: one
+        # n_configs=27, eta=3 bracket is 27+9+3+1 = 40 evals = max_jobs
+        t0 = time.perf_counter()
+        out_s = successive_halving(
+            fn, space, max_budget=max_budget, min_budget=1, eta=eta,
+            n_configs=27, rstate=np.random.default_rng(0),
+        )
+        sync_s = time.perf_counter() - t0
+        return asha_s, sync_s, out_a["best_loss"], out_s["best_loss"]
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_asha_device failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None, None, None, None
+
+
 # THE BASELINE.md PBT study config (32 members x 200 steps, exploit/
 # explore every 10): the single source for both the executed run and the
 # JSON comparability stamp, so the stamp can never drift from what ran
@@ -393,10 +437,12 @@ def main():
             n_trials=n_trials_1k, n_cand=n_cand, batch_size=1
         )
         pbt_rate, pbt_median = bench_pbt()
+        asha_s, sha_sync_s, asha_best, sha_sync_best = bench_asha_device()
     else:
         dl_sec_1k, dl_best_1k, dl_n = None, None, 0
         dls_sec_1k, dls_best_1k, dls_n = None, None, 0
         pbt_rate, pbt_median = None, None
+        asha_s, sha_sync_s, asha_best, sha_sync_best = (None,) * 4
     # comparability contract: the stamped config IS the dict bench_pbt
     # defaulted from, so the JSON cannot misreport what ran
     pbt_config = dict(
@@ -450,6 +496,23 @@ def main():
                     round(pbt_median, 4) if pbt_median is not None else None
                 ),
                 "pbt_config": pbt_config if pbt_rate else None,
+                "asha_device_seconds": (
+                    round(asha_s, 2) if asha_s is not None else None
+                ),
+                "sha_sync_device_seconds": (
+                    round(sha_sync_s, 2) if sha_sync_s is not None else None
+                ),
+                "asha_device_speedup_x": (
+                    round(sha_sync_s / asha_s, 2)
+                    if asha_s and sha_sync_s else None
+                ),
+                "asha_device_best": (
+                    round(asha_best, 4) if asha_best is not None else None
+                ),
+                "sha_sync_device_best": (
+                    round(sha_sync_best, 4)
+                    if sha_sync_best is not None else None
+                ),
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,
