@@ -341,7 +341,10 @@ def compile_sha(
     dispatching asynchronously with a single end-of-run fetch, so use
     it where kills hurt -- the cold-compile regime -- and skip it for
     steady-state seed sweeps.  A snapshot from a different seed or
-    ladder schedule is rejected, never silently resumed.
+    ladder schedule is rejected, never silently resumed.  Durable mode
+    is single-process: over a multi-process mesh the trial-sharded
+    state is not host-addressable (fetch survivors with
+    ``multihost_utils.process_allgather`` instead).
     """
     import jax
     import jax.numpy as jnp
@@ -423,6 +426,17 @@ def compile_sha(
 
             state, losses_seq = jax.lax.scan(step, state, keys)
             losses = losses_seq[-1]  # [R * p_live]
+            if mesh is not None:
+                # replicate the bookkeeping outputs: with the population
+                # sharded over a multi-PROCESS mesh, trial-sharded
+                # losses/order would not be host-addressable and the
+                # runner's device_get would fail -- and every process
+                # needs the full ranking to drive identical promotions
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                losses = jax.lax.with_sharding_constraint(
+                    losses, NamedSharding(mesh, PartitionSpec())
+                )
             keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
             # rank WITHIN each bracket; emit global member indices
             by_rep = keyed.reshape(R, p_live)
@@ -430,6 +444,12 @@ def compile_sha(
             order = order + (
                 jnp.arange(R, dtype=order.dtype)[:, None] * p_live
             )
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                order = jax.lax.with_sharding_constraint(
+                    order, NamedSharding(mesh, PartitionSpec())
+                )
             return state, losses, order
 
         return jax.jit(rung)

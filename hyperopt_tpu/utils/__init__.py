@@ -38,6 +38,11 @@ def enable_compilation_cache(cache_dir=None):
                 os.path.expanduser("~"), ".cache", "hyperopt_tpu_xla"
             ),
         )
+        # partition by backend: entries AOT-compiled through a
+        # remote-attachment platform can carry host-machine features the
+        # local CPU lacks (XLA warns of potential SIGILL on load), so a
+        # cpu run must never read an accelerator run's entries
+        cache_dir = os.path.join(cache_dir, jax.default_backend())
     os.makedirs(cache_dir, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     # cache every compilation, however small/fast

@@ -210,11 +210,14 @@ def test_two_process_dcn_sharded_suggest():
     ``jax.distributed`` CPU runtime running (a) the public
     ``sharded_suggest`` API on a continuous space, (b) the same API on a
     MIXED space so the categorical EI sweep's hit-mask contraction and
-    argmax-allgather cross DCN, and (c) a population-sharded
-    ``device_loop.compile_fmin`` whose trial axis spans both processes.
-    Agreement with the single-process path (two-sample KS per dim,
-    n=256) and loop determinism are asserted inside the process-0
-    worker; this test asserts the run and its verdict line."""
+    argmax-allgather cross DCN, (c) a population-sharded
+    ``device_loop.compile_fmin`` whose trial axis spans both processes,
+    and (d, round 5) a fused ``compile_sha`` ladder whose rung
+    populations and survivor gathers span both processes, matching the
+    single-process ladder exactly.  Agreement with the single-process
+    path (two-sample KS per dim, n=256), loop determinism, and the
+    sha-over-DCN exact-match are asserted inside the process-0 worker;
+    this test asserts the run and its verdict line."""
     from hyperopt_tpu.parallel import dcn_check
 
     out = dcn_check.launch()
@@ -223,6 +226,9 @@ def test_two_process_dcn_sharded_suggest():
     assert "mixed_ks=" in out
     assert "pop_sharded_loop={trial: 8}" in out
     assert "deterministic=True" in out
+    assert "sha_dcn={trial: 8, n_configs: 8}" in out
+    assert "sha_matches_unsharded=True" in out
+    assert "sha_deterministic=True" in out
 
 
 def test_sharded_suggest_10k_candidates_nasbench():
