@@ -32,7 +32,7 @@ def test_every_example_has_a_test():
         "04_distributed_workers.py", "05_population_training.py",
         "06_sharded_suggest.py", "07_speculative_sequential.py",
         "08_hpo_over_training.py", "09_pbt_and_sha.py", "roofline.py",
-        "soak_10k.py", "study_device_loop_batch.py",
+        "scheduler_battery.py", "soak_10k.py", "study_device_loop_batch.py",
     }
     on_disk = {
         f for f in os.listdir(os.path.join(_ROOT, "examples"))
@@ -152,3 +152,26 @@ def test_example_study_device_loop_batch_smoke():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert '"posterior_updates"' in out.stdout
+
+
+@pytest.mark.slow
+def test_example_scheduler_battery_smoke():
+    """The --quick tier of the scheduler quality battery (round 5): all
+    six schedulers run at near-equal spend on the surrogate domain and
+    report a finite true-best each."""
+    import json
+    import math
+
+    out = run_example("scheduler_battery.py", args=("--quick",),
+                      extra_env={"HYPEROPT_TPU_COMPILATION_CACHE": "0"})
+    assert out.returncode == 0, out.stderr[-2000:]
+    last = json.loads(out.stdout.strip().splitlines()[-1])
+    cells = last["battery"]
+    assert set(cells) == {
+        f"surrogate/{s}" for s in
+        ("tpe_fmin", "sha", "hyperband", "bohb", "asha_4w", "asha_8w")
+    }
+    for cell in cells.values():
+        assert math.isfinite(cell["median_true_best"])
+        # equal-budget contract: every scheduler lands within 20% of T
+        assert 345 <= cell["median_spend"] <= 520, cell
