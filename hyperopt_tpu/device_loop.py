@@ -104,7 +104,14 @@ def compile_fmin(
 
     Returns ``runner(seed=0, return_trials=False) -> result dict``; the
     seed is a traced input, so repeated runs (seed sweeps, CV repeats)
-    reuse the compilation.
+    reuse the compilation.  ``runner(seed=[s0, s1, ...])`` runs a
+    VECTORIZED seed sweep -- the whole experiment scan ``vmap``-ed over
+    the seed axis, S independent loops (own histories/key streams)
+    advancing in lockstep in one program -- and returns a LIST of
+    per-seed result dicts.  At B=1, where fixed per-step cost dominates
+    (ROOFLINE.md round 5), S seeds cost ~one seed's wall-clock: the
+    median-of-seeds study collapses to a single call (measured --
+    BASELINE.md round-5 seed-sweep row).
 
     Args:
       fn: JAX-traceable objective over a dict of [batch] value arrays.
@@ -408,8 +415,61 @@ def compile_fmin(
     cat_dims = set(ps.cat_idx.tolist())
 
     zero_buffers = []  # device-resident, reused by every cold run
+    run_vmapped = []  # lazily-built vmap-over-seeds twin of `run`
+
+    def _runner_seeds(seeds, return_trials):
+        """Vectorized seed sweep: the WHOLE experiment scan vmapped over
+        a seed axis -- S independent sequential loops (own histories,
+        own key streams) advance in lockstep inside one XLA program, so
+        the fixed per-step cost that dominates the B=1 flagship mode
+        (bench_artifacts/ROOFLINE.md round 5) is paid once for all S.
+        A median-of-5-seeds study costs ~one seed's wall-clock.
+
+        Semantics per seed are the single-seed program's (same suggest
+        math on the same key stream derived from each seed); under
+        early stopping the vmapped ``while_loop`` runs until every seed
+        stops, freezing finished seeds -- results are unchanged, only
+        the finished seeds' slack compute differs.  Returns a list of
+        per-seed result dicts (exactly the single-seed shape).
+        """
+        S = len(seeds)
+        if not run_vmapped:
+            run_vmapped.append(jax.jit(jax.vmap(
+                run, in_axes=(0, 0, 0, 0, 0, None, None)
+            )))
+        seeds_arr = np.asarray(
+            [int(s) % (2**32) for s in seeds], dtype=np.uint32
+        )
+        zeros = (
+            np.zeros((S, D, cap), dtype=np.float32),
+            np.zeros((S, D, cap), dtype=bool),
+            np.zeros((S, cap), dtype=np.float32),
+            np.zeros((S, cap), dtype=bool),
+        )
+        out_dev = run_vmapped[0](
+            seeds_arr, *zeros, np.int32(0), np.float32(np.inf)
+        )
+        values, active, losses, valid, best_i, n_done = jax.device_get(
+            out_dev
+        )
+        outs = []
+        for i in range(S):
+            n_ran = int(n_done[i]) * B
+            outs.append(_package_result(
+                values[i][:, :n_ran], active[i][:, :n_ran],
+                losses[i][:n_ran], int(best_i[i]), n_ran, n_ran,
+                return_trials,
+            ))
+        return outs
 
     def runner(seed=0, return_trials=False, init=None):
+        if isinstance(seed, (list, tuple, np.ndarray)):
+            if init is not None:
+                raise ValueError(
+                    "init= resume is single-seed; run the seed sweep "
+                    "fresh or resume seeds individually"
+                )
+            return _runner_seeds(list(seed), return_trials)
         c0 = 0
         best0 = np.float32(np.inf)
         if init is None:
@@ -472,16 +532,20 @@ def compile_fmin(
         )
         n_ran = int(n_done) * B
         total = c0 + n_ran
-        values_np = np.asarray(values)[:, :total]
-        active_np = np.asarray(active)[:, :total]
-        losses_np = np.asarray(losses)[:total]
+        return _package_result(
+            np.asarray(values)[:, :total], np.asarray(active)[:, :total],
+            np.asarray(losses)[:total], int(best_i), n_ran, total,
+            return_trials,
+        )
+
+    def _package_result(values_np, active_np, losses_np, bi, n_ran, total,
+                        return_trials):
         if not np.isfinite(losses_np).any():
             from .exceptions import AllTrialsFailed
 
             raise AllTrialsFailed(
                 "every on-device trial returned a non-finite loss"
             )
-        bi = int(best_i)
 
         best = {}
         for d, label in enumerate(ps.labels):

@@ -661,3 +661,45 @@ def test_device_loop_best_is_space_eval_compatible():
     arm = cfg["arch"]
     assert ("depth" in arm) != ("w" in arm)
     assert arm["k"] in (0, 1)
+
+
+def test_runner_vectorized_seed_sweep_matches_single_seed():
+    """Round-5 seed-sweep vectorization: runner(seed=[...]) returns one
+    result per seed, and every per-seed result matches the single-seed
+    runner bitwise (the vmapped program advances the same per-seed key
+    streams and histories in lockstep)."""
+    space = {
+        "x": hp.uniform("x", -5.0, 5.0),
+        "c": hp.choice("c", [0, 1, 2]),
+    }
+
+    def obj(cfg):
+        return (cfg["x"] - 1.0) ** 2 + 0.1 * cfg["c"]
+
+    runner = compile_fmin(obj, space, max_evals=48, batch_size=1,
+                          n_EI_candidates=16)
+    swept = runner(seed=[3, 4, 5])
+    assert isinstance(swept, list) and len(swept) == 3
+    for seed, out in zip((3, 4, 5), swept):
+        single = runner(seed=seed)
+        assert out["best_loss"] == single["best_loss"], seed
+        assert np.array_equal(out["losses"], single["losses"]), seed
+        assert np.array_equal(out["values"], single["values"]), seed
+        assert out["best"] == single["best"], seed
+    with pytest.raises(ValueError, match="single-seed"):
+        runner(seed=[1, 2], init=swept[0])
+
+
+def test_runner_seed_sweep_composes_with_early_stop():
+    """The vmapped while_loop under loss_threshold runs until every
+    seed stops; per-seed results still match the single-seed program."""
+    space = {"x": hp.uniform("x", -5.0, 5.0)}
+    runner = compile_fmin(
+        lambda cfg: (cfg["x"] - 1.0) ** 2, space, max_evals=64,
+        batch_size=1, n_EI_candidates=8, loss_threshold=0.05,
+    )
+    swept = runner(seed=[0, 1])
+    for seed, out in zip((0, 1), swept):
+        single = runner(seed=seed)
+        assert out["n_evals"] == single["n_evals"], seed
+        assert np.array_equal(out["losses"], single["losses"]), seed
