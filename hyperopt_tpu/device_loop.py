@@ -463,7 +463,9 @@ def compile_fmin(
         return outs
 
     def runner(seed=0, return_trials=False, init=None):
-        if isinstance(seed, (list, tuple, np.ndarray)):
+        if isinstance(seed, (list, tuple)) or (
+            isinstance(seed, np.ndarray) and seed.ndim > 0
+        ):
             if init is not None:
                 raise ValueError(
                     "init= resume is single-seed; run the seed sweep "
