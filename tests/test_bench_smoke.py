@@ -42,3 +42,7 @@ def test_bench_prints_one_json_line():
     assert d["single_suggest_sync_per_sec"] > 0
     # device-loop variant is accelerator-only; key must exist either way
     assert "device_loop_seconds_at_1k" in d
+    # round-5 fields: cache stamp always present; asha-on-device keys
+    # exist (None off-accelerator)
+    assert d["compilation_cache"] in (True, False)
+    assert "asha_device_seconds" in d and "asha_device_speedup_x" in d
