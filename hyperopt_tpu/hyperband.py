@@ -757,6 +757,52 @@ def compile_hyperband(
     return runner
 
 
+def _space_fingerprint(expr):
+    """Stable structural hash of a pyll space graph, for checkpoint
+    guards: distributions, bounds, labels, and choice-option ORDER all
+    change it; process identity does not.  ``str(expr)`` is NOT usable
+    here -- it embeds ``repr()`` of literal objects, and a space with
+    callables/objects as choice options (a standard pattern) would
+    print per-process memory addresses, refusing every real
+    cross-process resume.  Non-primitive literal values are therefore
+    normalized to their type name (their index in the graph still
+    participates, so reordering options changes the hash)."""
+    import hashlib
+
+    from .pyll.base import Literal, dfs
+
+    def norm(v):
+        if isinstance(v, (str, int, float, bool, type(None))):
+            return repr(v)
+        if isinstance(v, np.generic):  # numpy scalars: not python
+            # int/float instances, but value+dtype reprs are stable
+            return f"np.{type(v).__name__}({v!r})"
+        if isinstance(v, np.ndarray):
+            if v.dtype == object:
+                return f"nd.object{norm(v.tolist())}"
+            return (
+                f"nd({v.dtype},{v.shape},"
+                f"{hashlib.sha256(np.ascontiguousarray(v).tobytes()).hexdigest()})"
+            )
+        if isinstance(v, (list, tuple)):
+            return f"{type(v).__name__}({','.join(norm(x) for x in v)})"
+        if isinstance(v, dict):
+            items = ",".join(
+                f"{norm(k)}:{norm(v[k])}" for k in sorted(v, key=repr)
+            )
+            return f"dict({items})"
+        return f"<{type(v).__module__}.{type(v).__qualname__}>"
+
+    parts = []
+    for node in dfs(expr):
+        if isinstance(node, Literal):
+            parts.append(f"L:{norm(node.obj)}")
+        else:
+            kw = ",".join(sorted(k for k, _ in node.named_args))
+            parts.append(f"A:{node.name}/{len(node.pos_args)}/{kw}")
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
 def asha(
     fn,
     space,
@@ -768,6 +814,8 @@ def asha(
     algo=None,
     trials=None,
     rstate=None,
+    checkpoint=None,
+    checkpoint_every=1,
 ):
     """Asynchronous successive halving (ASHA, Li et al., 2020).
 
@@ -794,6 +842,29 @@ def asha(
       trials: optional ``Trials``; every evaluation is recorded with
         ``result["budget"]`` (same contract as the sync drivers, so
         ``budget_aware`` model fitting composes).
+      checkpoint: optional path for durable kill/resume, completing the
+        resume family (``device_loop``/``pbt``/``compile_sha``/
+        ``compile_hyperband`` all have one).  The scheduler state is a
+        host-object graph -- per-rung sorted results, the config table,
+        the generator state, the trials store -- so the snapshot is an
+        atomic-rename pickle (the ``save_trials`` mechanism), written
+        under the scheduler lock every ``checkpoint_every`` recorded
+        evaluations.  If ``checkpoint`` exists, it is resumed: the
+        restored trials/rstate REPLACE the ``trials=``/``rstate=``
+        arguments (the snapshot is the source of truth of the
+        interrupted run), in-flight evaluations at kill time are
+        re-run -- a rung-0 suggestion re-runs its exact suggested
+        config (the snapshot carries it), a promotion becomes eligible
+        again -- and the run continues to ``max_jobs`` total recorded
+        evaluations.  With ``workers=1`` the resumed run
+        reproduces the uninterrupted one bitwise (the snapshot's
+        generator state predates the in-flight job's suggestion, so the
+        re-suggestion replays it); with ``workers>1`` completion order
+        is scheduling-dependent either way, so resume preserves the
+        invariants, not the stream.  The file is kept on success.
+      checkpoint_every: snapshot cadence in recorded evaluations
+        (default 1: every record; raise it if pickling a large trials
+        store every record measures as the bottleneck).
 
     Returns ``{"best": config, "best_loss", "rungs": [{"budget", "n"}],
     "trials"}`` where ``best`` is the best completed evaluation at the
@@ -830,6 +901,78 @@ def asha(
     configs = {}  # config_key -> config dict (index-form vals)
     pending = {}  # config_key -> suggested doc, completed at its rung-0 record
     started = 0
+    recorded = 0  # completed _record calls (incl. failed evals): the
+    # durable progress measure -- ``started`` counts assignments, which
+    # include in-flight work a kill would lose
+    # promoted[] marks claims at ASSIGNMENT time (so two workers cannot
+    # promote the same key); attempted[] marks them at RECORD time.  The
+    # snapshot persists attempted, not promoted: a claim whose
+    # evaluation died in flight must be re-runnable after resume, while
+    # a recorded attempt (even a failed one) must not repeat -- exactly
+    # the uninterrupted run's behavior
+    attempted = [set() for _ in range(n_rungs)]
+    # ladder + budget + space identity; a snapshot from a different
+    # schedule (or a different space: index-form vals would be silently
+    # decoded against the wrong labels/options/ranges) must be refused.
+    # Guard built only when checkpointing (it is the only consumer)
+    if checkpoint is not None:
+        if int(checkpoint_every) < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every!r}"
+            )
+        ckpt_guard = (
+            "asha", n_rungs, float(max_budget), float(min_budget),
+            float(eta), int(max_jobs),
+            type(rstate.bit_generator).__name__,
+            _space_fingerprint(domain.expr),
+        )
+    requeue = []  # restored in-flight rung-0 keys, re-assigned first
+
+    def _write_ckpt():
+        """Snapshot the full scheduler state (the ``save_trials``
+        atomic-rename pickle); called under the lock (every mutated
+        structure is lock-guarded).  ``pending`` rides along so a
+        rung-0 suggestion whose evaluation was in flight at kill time
+        is re-run on resume, not dropped -- its doc and the tid the
+        store already allocated for it are pickled together, keeping
+        the tid sequence contiguous."""
+        from .utils.checkpoint import save_trials
+
+        save_trials({
+            "guard": ckpt_guard,
+            "configs": configs,
+            "done": done,
+            "attempted": [sorted(s) for s in attempted],
+            "pending": pending,
+            "recorded": recorded,
+            "rstate": rstate.bit_generator.state,
+            "trials": trials,
+        }, checkpoint)
+
+    if checkpoint is not None and os.path.exists(checkpoint):
+        from .utils.checkpoint import load_trials
+
+        snap = load_trials(checkpoint)
+        if snap["guard"] != ckpt_guard:
+            raise ValueError(
+                f"checkpoint {checkpoint!r} was written by schedule "
+                f"{snap['guard']}; refusing to resume {ckpt_guard}"
+            )
+        configs = snap["configs"]
+        done = snap["done"]
+        # attempted (record-time marks), not assignment-time claims: a
+        # promotion whose evaluation died in flight must re-run
+        promoted = [set(s) for s in snap["attempted"]]
+        attempted = [set(s) for s in snap["attempted"]]
+        pending = snap["pending"]
+        requeue = sorted(pending)
+        recorded = snap["recorded"]
+        started = recorded  # in-flight-at-kill assignments are re-run
+        # fresh generator of the guarded type -- restoring must not
+        # clobber the caller's rstate object as a side effect
+        rstate = np.random.Generator(type(rstate.bit_generator)())
+        rstate.bit_generator.state = snap["rstate"]
+        trials = snap["trials"]
 
     def _suggest_one():
         """One new rung-0 configuration through the algo seam.  The
@@ -848,6 +991,10 @@ def asha(
         nonlocal started
         if started >= max_jobs:
             return None
+        if requeue:  # restored in-flight suggestions resume first
+            key = requeue.pop(0)
+            started += 1
+            return key, 0
         for r in range(n_rungs - 2, -1, -1):
             n_promotable = len(done[r]) // eta
             for loss, key in done[r][:n_promotable]:
@@ -863,6 +1010,7 @@ def asha(
         return key, 0
 
     def _record(key, r, loss):
+        nonlocal recorded
         from .base import JOB_STATE_DONE
 
         b = rung_budget(r)
@@ -897,6 +1045,11 @@ def asha(
         trials.refresh()
         if np.isfinite(loss):
             bisect.insort(done[r], (float(loss), key))
+        if r > 0:
+            attempted[r - 1].add(key)
+        recorded += 1
+        if checkpoint is not None and recorded % int(checkpoint_every) == 0:
+            _write_ckpt()
 
     def worker():
         while True:
@@ -922,6 +1075,9 @@ def asha(
         for f in futures:
             f.result()  # surface worker crashes
     trials.refresh()
+    if checkpoint is not None:
+        with lock:
+            _write_ckpt()  # final state, whatever the cadence left off
 
     populated = [r for r in range(n_rungs) if done[r]]
     if not populated:

@@ -485,6 +485,195 @@ def test_asha_concurrency_fuzz():
         assert sum(r["n"] for r in out["rungs"]) == 60
 
 
+def test_asha_checkpoint_resume_bitwise(tmp_path):
+    """Kill mid-run, resume from the snapshot, and reproduce the
+    uninterrupted run EXACTLY (workers=1: the snapshot's generator state
+    predates the in-flight job's suggestion, so resume replays it) --
+    the same contract the device_loop/pbt/sha resume tests pin."""
+    from hyperopt_tpu.hyperband import asha
+
+    kw = dict(max_budget=9, eta=3, max_jobs=40, workers=1)
+
+    def digest(out):
+        t = out["trials"].trials
+        return (
+            out["best_loss"], out["best"]["x"],
+            [r["n"] for r in out["rungs"]],
+            [(d["tid"], d["result"]["budget"], d["result"]["loss"])
+             for d in t],
+        )
+
+    ref = digest(asha(
+        budgeted_quad, SPACE, rstate=np.random.default_rng(7), **kw
+    ))
+
+    calls = [0]
+
+    def dies_at_13(cfg, budget):
+        calls[0] += 1
+        if calls[0] == 13:
+            raise KeyboardInterrupt  # BaseException: not caught as a
+            # failed eval; surfaces through the worker future like a kill
+        return budgeted_quad(cfg, budget)
+
+    path = str(tmp_path / "asha.ckpt")
+    with pytest.raises(KeyboardInterrupt):
+        asha(
+            dies_at_13, SPACE, rstate=np.random.default_rng(7),
+            checkpoint=path, **kw
+        )
+    resumed = digest(asha(
+        budgeted_quad, SPACE, rstate=np.random.default_rng(7),
+        checkpoint=path, **kw
+    ))
+    assert resumed == ref
+
+
+def test_asha_checkpoint_guard_and_multiworker_invariants(tmp_path):
+    """A snapshot from a different ladder is refused; a multi-worker
+    kill/resume preserves the scheduler invariants (exact job count,
+    promotion chains) even though completion order is scheduling-
+    dependent."""
+    from hyperopt_tpu.hyperband import asha
+
+    path = str(tmp_path / "asha.ckpt")
+    calls = [0]
+
+    def dies_at_17(cfg, budget):
+        calls[0] += 1
+        if calls[0] == 17:
+            raise KeyboardInterrupt
+        return budgeted_quad(cfg, budget)
+
+    with pytest.raises(KeyboardInterrupt):
+        asha(
+            dies_at_17, SPACE, max_budget=9, eta=3, max_jobs=40,
+            workers=4, rstate=np.random.default_rng(0), checkpoint=path,
+        )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        asha(
+            budgeted_quad, SPACE, max_budget=4, eta=2, max_jobs=40,
+            workers=4, rstate=np.random.default_rng(0), checkpoint=path,
+        )
+    out = asha(
+        budgeted_quad, SPACE, max_budget=9, eta=3, max_jobs=40,
+        workers=4, rstate=np.random.default_rng(0), checkpoint=path,
+    )
+    trials = out["trials"]
+    assert len(trials) == 40  # total across kill + resume: exact budget
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert set(budgets) <= {1, 3, 9}
+    x_at = lambda b: {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == b
+    }
+    assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+    assert np.isfinite(out["best_loss"])
+
+
+def test_asha_checkpoint_requeues_in_flight_suggestion(tmp_path):
+    """A rung-0 suggestion whose evaluation is in flight at kill time
+    rides the snapshot (``pending``) and is RE-RUN on resume with its
+    exact suggested config -- not silently dropped with an orphaned
+    tid.  Two workers: the first call blocks until the other worker has
+    drained every remaining job (so the last snapshot written contains
+    the blocked job in ``pending``), then dies."""
+    import threading
+
+    from hyperopt_tpu.hyperband import asha
+
+    path = str(tmp_path / "asha.ckpt")
+    n_calls = [0]
+    blocked_x = []
+    drained = threading.Event()
+    call_lock = threading.Lock()
+
+    def blocker(cfg, budget):
+        with call_lock:
+            i = n_calls[0]
+            n_calls[0] += 1
+            if n_calls[0] >= 40:
+                drained.set()
+        if i == 0:
+            blocked_x.append(round(cfg["x"], 9))
+            assert drained.wait(timeout=120)
+            raise KeyboardInterrupt
+        return budgeted_quad(cfg, budget)
+
+    with pytest.raises(KeyboardInterrupt):
+        asha(
+            blocker, SPACE, max_budget=9, eta=3, max_jobs=40, workers=2,
+            rstate=np.random.default_rng(5), checkpoint=path,
+        )
+    out = asha(
+        budgeted_quad, SPACE, max_budget=9, eta=3, max_jobs=40,
+        workers=2, rstate=np.random.default_rng(5), checkpoint=path,
+    )
+    trials = out["trials"]
+    assert len(trials) == 40  # the lost job's budget was re-spent
+    xs = {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == 1
+    }
+    assert blocked_x[0] in xs  # the in-flight config itself was re-run
+    # tid sequence stays contiguous: the pending doc's tid was reused
+    tids = sorted(t["tid"] for t in trials.trials)
+    assert tids == list(range(tids[0], tids[0] + 40))
+
+
+def test_asha_space_fingerprint_stable_and_structural():
+    """The checkpoint guard's space hash must survive a process restart
+    (callable choice options print memory addresses via repr -- the
+    fingerprint normalizes them) yet refuse structural edits like
+    reordered options or changed bounds."""
+    from hyperopt_tpu.base import Domain
+    from hyperopt_tpu.hyperband import _space_fingerprint
+
+    def build(opts, hi=1.0):
+        # fresh lambdas each call: distinct object addresses, same
+        # structure -- the in-process stand-in for a process restart
+        space = {
+            "act": hp.choice("act", [(o, (lambda z: z)) for o in opts]),
+            "lr": hp.uniform("lr", 0.0, hi),
+        }
+        return Domain(lambda c: 0.0, space, pass_expr_memo_ctrl=False)
+
+    a = _space_fingerprint(build(["tanh", "relu"]).expr)
+    assert a == _space_fingerprint(build(["tanh", "relu"]).expr)
+    assert a != _space_fingerprint(build(["relu", "tanh"]).expr)
+    assert a != _space_fingerprint(build(["tanh", "relu"], hi=2.0).expr)
+
+    # numpy-valued bounds/options are VALUES to the guard, not opaque
+    # type names: changed contents must change the hash
+    def build_np(hi, opts):
+        space = {
+            "k": hp.choice("k", list(opts)),
+            "lr": hp.uniform("lr", 0.0, hi),
+        }
+        return Domain(lambda c: 0.0, space, pass_expr_memo_ctrl=False)
+
+    b = _space_fingerprint(build_np(np.int64(1), 2 ** np.arange(3)).expr)
+    assert b == _space_fingerprint(
+        build_np(np.int64(1), 2 ** np.arange(3)).expr
+    )
+    assert b != _space_fingerprint(
+        build_np(np.int64(5), 2 ** np.arange(3)).expr
+    )
+    assert b != _space_fingerprint(
+        build_np(np.int64(1), 3 ** np.arange(3)).expr
+    )
+
+
+def test_asha_checkpoint_every_validated(tmp_path):
+    from hyperopt_tpu.hyperband import asha
+
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        asha(
+            budgeted_quad, SPACE, max_budget=9, max_jobs=5, workers=1,
+            checkpoint=str(tmp_path / "c"), checkpoint_every=0,
+        )
+
+
 def test_compile_hyperband_on_device():
     """Full multi-bracket Hyperband as chained on-device ladders: the
     bracket spread (eta**s configs at rung-0 budget steps*eta**(s_max-s))
