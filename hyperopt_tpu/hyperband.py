@@ -24,6 +24,7 @@ Two execution modes, matching the rest of the framework:
 
 from __future__ import annotations
 
+import functools
 import logging
 import math
 import os
@@ -920,10 +921,17 @@ def asha(
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every!r}"
             )
+        # algo identity rides the guard too: resuming a TPE-driven run
+        # with the defaulted (random) algo would silently change the
+        # experiment.  partial(...) unwraps to its base suggest fn --
+        # tuned kwargs (gamma etc.) are not fingerprintable in general
+        a = algo.func if isinstance(algo, functools.partial) else algo
         ckpt_guard = (
             "asha", n_rungs, float(max_budget), float(min_budget),
             float(eta), int(max_jobs),
             type(rstate.bit_generator).__name__,
+            f"{getattr(a, '__module__', '?')}."
+            f"{getattr(a, '__qualname__', type(a).__name__)}",
             _space_fingerprint(domain.expr),
         )
     requeue = []  # restored in-flight rung-0 keys, re-assigned first

@@ -21,7 +21,12 @@ over the global mesh on identical seeded histories:
   over both processes (stage D) -- the survivor gathers between rungs
   move state across the process boundary, the replicated ranking drives
   identical promotions on every process, and the result must match the
-  single-process ladder exactly (round 5).
+  single-process ladder exactly (round 5);
+* a fused ``pbt.compile_pbt`` schedule whose population shards over
+  both processes (stage E) -- every exploit event's rank + bottom-
+  quantile-copies-top gather moves member state across the process
+  boundary, and the run must match the single-process schedule exactly
+  (round 5: the second scheduler-family collective over DCN).
 
 Process 0 checks winner distributions against the single-process
 unsharded path at equal total candidate count (two-sample KS per dim)
@@ -279,6 +284,48 @@ def main(argv=None):
     ]
     assert np.isfinite(sha_a["best_loss"])
 
+    # --- stage E: population-based training SPANNING processes ----------
+    # compile_pbt with its population axis over the 2-process mesh: each
+    # exploit event ranks the (replicated) losses and copies the top
+    # quantile's member state into the bottom quantile -- gathers whose
+    # source and destination members live on DIFFERENT processes, riding
+    # DCN.  Per-member train math is elementwise, so the sharded schedule
+    # must match the single-process one exactly and repeats must be
+    # deterministic.
+    from ..pbt import compile_pbt
+
+    def pbt_train_fn(state, hypers, key):
+        theta = state["theta"] - hypers["lr"] * 2.0 * (state["theta"] - 0.7)
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    P_pbt = n_global  # one member per global device
+    pbt_kw = dict(
+        hyper_bounds={"lr": (1e-3, 1.0)}, pop_size=P_pbt,
+        exploit_every=2, n_rounds=4,
+    )
+    pbt_sharded = compile_pbt(
+        pbt_train_fn, {"theta": jnp.full((P_pbt,), 5.0)},
+        mesh=pop_mesh, trial_axis="trial", **pbt_kw,
+    )
+    pbt_a = pbt_sharded(seed=13)
+    pbt_b = pbt_sharded(seed=13)
+    assert pbt_a["best_loss"] == pbt_b["best_loss"], (
+        "pbt-over-DCN nondeterministic"
+    )
+    assert np.array_equal(pbt_a["loss_history"], pbt_b["loss_history"])
+    pbt_plain = compile_pbt(
+        pbt_train_fn, {"theta": jnp.full((P_pbt,), 5.0)}, **pbt_kw,
+    )(seed=13)
+    assert pbt_a["best_loss"] == pbt_plain["best_loss"], (
+        "pbt-over-DCN diverges from the single-process schedule",
+        pbt_a["best_loss"], pbt_plain["best_loss"],
+    )
+    assert np.array_equal(
+        np.asarray(pbt_a["loss_history"]),
+        np.asarray(pbt_plain["loss_history"]),
+    ), "pbt-over-DCN loss history diverges from single-process"
+    assert np.isfinite(pbt_a["best_loss"])
+
     if pid == 0:
         # agreement vs the single-process path at equal TOTAL candidates
         # (local single-device jit -- no collectives, runs on pid 0 only)
@@ -334,7 +381,10 @@ def main(argv=None):
             f"best={loop_a['best_loss']:.5f} deterministic=True "
             f"sha_dcn={{trial: {n_global}, n_configs: {P_sha}}} "
             f"sha_best={sha_a['best_loss']:.5f} "
-            f"sha_matches_unsharded=True sha_deterministic=True",
+            f"sha_matches_unsharded=True sha_deterministic=True "
+            f"pbt_dcn={{trial: {n_global}, pop: {P_pbt}}} "
+            f"pbt_best={pbt_a['best_loss']:.5f} "
+            f"pbt_matches_unsharded=True pbt_deterministic=True",
             flush=True,
         )
     else:

@@ -24,6 +24,7 @@ __all__ = [
     "process_index",
     "process_count",
     "broadcast_configs",
+    "fetch_global",
     "shard_ids_for_host",
 ]
 
@@ -80,6 +81,31 @@ def broadcast_configs(values, active):
     values = multihost_utils.broadcast_one_to_all(values)
     active = multihost_utils.broadcast_one_to_all(active)
     return values, active
+
+
+def fetch_global(tree):
+    """Host-fetch a pytree whose leaves may be sharded across PROCESSES.
+
+    ``np.asarray``/``jax.device_get`` refuse arrays spanning
+    non-addressable devices (a population axis sharded over a
+    multi-host mesh); such leaves are assembled with
+    ``multihost_utils.process_allgather`` -- every process receives the
+    identical GLOBAL numpy array, so replicated host-side bookkeeping
+    (best-member selection, result dicts) stays consistent across
+    hosts.  Fully-addressable leaves (the single-process common case)
+    take the plain ``np.asarray`` path untouched.
+    """
+    import jax
+    import numpy as np
+
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return multihost_utils.process_allgather(x, tiled=True)
+        return np.asarray(x)
+
+    return jax.tree.map(fetch, tree)
 
 
 def shard_ids_for_host(new_ids, index=None, count=None):

@@ -236,8 +236,11 @@ def compile_pbt(
         return _package(state, log_h, loss_hist, best_i)
 
     def _package(state, log_h, loss_hist, best_i):
-        loss_hist = np.asarray(loss_hist)
-        log_h = np.asarray(log_h)
+        # multi-host population: loss_hist/log_h shard over processes
+        # and need the allgather fetch; single-process this is asarray
+        from .parallel.multihost import fetch_global
+
+        loss_hist, log_h = fetch_global((loss_hist, log_h))
         bi = int(best_i)
         hypers = {n: np.exp(log_h[:, i]) for i, n in enumerate(names)}
         return {

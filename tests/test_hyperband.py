@@ -664,6 +664,45 @@ def test_asha_space_fingerprint_stable_and_structural():
     )
 
 
+def test_asha_checkpoint_refuses_different_algo(tmp_path):
+    """Resuming a model-driven run with the defaulted (random) algo is
+    a silently different experiment -- the guard must refuse it; the
+    same algo under functools.partial tuning still matches."""
+    import functools
+
+    from hyperopt_tpu import rand
+    from hyperopt_tpu.hyperband import asha
+
+    path = str(tmp_path / "asha.ckpt")
+    calls = [0]
+
+    def dies_at_5(cfg, budget):
+        calls[0] += 1
+        if calls[0] == 5:
+            raise KeyboardInterrupt
+        return budgeted_quad(cfg, budget)
+
+    def my_algo(new_ids, domain, trials, seed):
+        return rand.suggest(new_ids, domain, trials, seed)
+
+    kw = dict(max_budget=9, eta=3, max_jobs=12, workers=1)
+    with pytest.raises(KeyboardInterrupt):
+        asha(
+            dies_at_5, SPACE, algo=my_algo,
+            rstate=np.random.default_rng(0), checkpoint=path, **kw
+        )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        asha(  # defaulted algo (rand.suggest) != my_algo
+            budgeted_quad, SPACE, rstate=np.random.default_rng(0),
+            checkpoint=path, **kw
+        )
+    out = asha(  # partial of the SAME algo unwraps to a match
+        budgeted_quad, SPACE, algo=functools.partial(my_algo),
+        rstate=np.random.default_rng(0), checkpoint=path, **kw
+    )
+    assert len(out["trials"]) == 12
+
+
 def test_asha_checkpoint_every_validated(tmp_path):
     from hyperopt_tpu.hyperband import asha
 

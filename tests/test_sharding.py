@@ -212,12 +212,15 @@ def test_two_process_dcn_sharded_suggest():
     MIXED space so the categorical EI sweep's hit-mask contraction and
     argmax-allgather cross DCN, (c) a population-sharded
     ``device_loop.compile_fmin`` whose trial axis spans both processes,
-    and (d, round 5) a fused ``compile_sha`` ladder whose rung
-    populations and survivor gathers span both processes, matching the
-    single-process ladder exactly.  Agreement with the single-process
-    path (two-sample KS per dim, n=256), loop determinism, and the
-    sha-over-DCN exact-match are asserted inside the process-0 worker;
-    this test asserts the run and its verdict line."""
+    (d, round 5) a fused ``compile_sha`` ladder whose rung populations
+    and survivor gathers span both processes, matching the
+    single-process ladder exactly, and (e, round 5) a fused
+    ``compile_pbt`` schedule whose exploit-event rank/copy gathers move
+    member state between processes, matching the single-process
+    schedule exactly.  Agreement with the single-process path
+    (two-sample KS per dim, n=256), loop determinism, and the
+    sha/pbt-over-DCN exact-matches are asserted inside the process-0
+    worker; this test asserts the run and its verdict line."""
     from hyperopt_tpu.parallel import dcn_check
 
     out = dcn_check.launch()
@@ -229,6 +232,9 @@ def test_two_process_dcn_sharded_suggest():
     assert "sha_dcn={trial: 8, n_configs: 8}" in out
     assert "sha_matches_unsharded=True" in out
     assert "sha_deterministic=True" in out
+    assert "pbt_dcn={trial: 8, pop: 8}" in out
+    assert "pbt_matches_unsharded=True" in out
+    assert "pbt_deterministic=True" in out
 
 
 def test_sharded_suggest_10k_candidates_nasbench():
