@@ -12,6 +12,11 @@ for the environments a TPU framework actually runs in:
                  reservation, reserve-timeout reaping, pickled-Domain
                  shipping and ERROR-state capture -- the MongoDB work-queue
                  role on the NFS/GCS-FUSE mounts TPU pods already have.
+``asha_queue``-- ``asha_filequeue``: the ASHA scheduler driving the
+                 filequeue backend -- promote-on-completion scheduling
+                 with evaluations farmed to ``hyperopt-tpu-worker``
+                 processes (budget rides the trial doc, the pickled
+                 ``BudgetedDomainFn`` hands it to the objective).
 ``mongo``     -- ``MongoTrials``: the reference's MongoDB protocol (CAS
                  reservation via find_one_and_modify, GridFS attachments);
                  requires pymongo, import-gated.
@@ -22,12 +27,21 @@ for the environments a TPU framework actually runs in:
 from .threads import ThreadTrials
 from .filequeue import FileTrials, FileJobQueue
 
-__all__ = ["ThreadTrials", "FileTrials", "FileJobQueue"]
+__all__ = [
+    "ThreadTrials", "FileTrials", "FileJobQueue",
+    "asha_filequeue", "BudgetedDomainFn",
+]
 
 
 def __getattr__(name):
     import importlib
 
+    if name in ("asha_queue", "asha_filequeue", "BudgetedDomainFn"):
+        # lazy: pulls in hyperband (and its numpy graph machinery) only
+        # when the ASHA-over-queue driver is actually used
+        mod = importlib.import_module(".asha_queue", __name__)
+        globals()["asha_queue"] = mod
+        return mod if name == "asha_queue" else getattr(mod, name)
     if name in ("mongo", "MongoTrials"):
         mod = importlib.import_module(".mongo", __name__)
         globals()["mongo"] = mod

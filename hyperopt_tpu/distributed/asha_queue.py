@@ -1,0 +1,228 @@
+"""ASHA over the shared-filesystem work queue: the async scheduler
+driving the async execution backend.
+
+The reference pairs its only async scheduler shape (the fmin driver's
+``asynchronous=True`` loop) with MongoDB task farming (SURVEY.md SS3.4);
+this module pairs the modern async scheduler -- :func:`hyperband.asha`'s
+promote-on-completion rule -- with the same farming model on the
+substrate TPU pods actually share (``filequeue``, the Mongo role over
+NFS/GCS-FUSE).  The division of labor:
+
+* the DRIVER runs the ASHA scheduler; each of its in-flight slots
+  publishes one ``(config, budget)`` job to the queue and blocks until
+  that job's ``done/<tid>.json`` appears -- promotion decisions never
+  wait for a rung barrier, exactly as in-process ASHA;
+* ``hyperopt-tpu-worker`` PROCESSES (any number, any host sharing the
+  mount) reserve jobs via the atomic-rename CAS and evaluate them
+  through the pickled :class:`BudgetedDomainFn` domain, which hands the
+  user objective the trial's ``budget`` alongside its decoded config;
+* crashed workers are reaped by mtime (``reserve_timeout``) and their
+  jobs re-reserved; a worker ERROR doc (traceback attached) records as
+  a failed evaluation that can never promote -- the same failure
+  contract as the in-process path.
+
+``asha(checkpoint=...)`` composes: the scheduler snapshot lives with
+the driver, the queue directory is the transport record.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import os
+import pickle
+import threading
+import time
+import uuid
+
+from ..base import Domain, JOB_STATE_DONE, JOB_STATE_NEW, SONify, STATUS_OK
+from .filequeue import FileJobQueue, _read_json
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BudgetedDomainFn", "asha_filequeue"]
+
+
+class BudgetedDomainFn:
+    """Picklable worker-side objective adapter: evaluates a budget-aware
+    ``fn(config, budget)`` from a queued trial doc.
+
+    Shipped to workers inside the pickled ``Domain`` (so ``fn`` must be
+    picklable, same contract as the reference's Domain shipping).  Uses
+    the ``pass_expr_memo_ctrl`` seam: the ``Ctrl``'s current trial doc
+    carries the rung budget in ``misc["budget"]``, and the config is
+    recovered by evaluating the space expression under the doc's pinned
+    parameter memo -- identical decoding to the sync driver's
+    ``space_eval``.
+    """
+
+    fmin_pass_expr_memo_ctrl = True
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, expr, memo, ctrl):
+        from ..pyll.base import rec_eval
+
+        budget = ctrl.current_trial["misc"]["budget"]
+        cfg = rec_eval(expr, memo=memo)
+        return self.fn(cfg, budget)
+
+
+
+
+def asha_filequeue(
+    fn,
+    space,
+    max_budget,
+    dirpath,
+    eta=3,
+    min_budget=1,
+    max_jobs=81,
+    inflight=8,
+    algo=None,
+    trials=None,
+    rstate=None,
+    checkpoint=None,
+    checkpoint_every=1,
+    exp_key=None,
+    poll_interval=0.05,
+    eval_timeout=None,
+    reserve_timeout=120.0,
+):
+    """Run ASHA with evaluations farmed to ``hyperopt-tpu-worker``
+    processes over a :class:`FileJobQueue` directory.
+
+    Args are :func:`hyperopt_tpu.hyperband.asha`'s, plus:
+
+      dirpath: the queue directory workers serve (``python -m
+        hyperopt_tpu.distributed.worker --dir DIR``).  The budget-aware
+        ``Domain`` is (re)published to its attachments at entry.
+      inflight: concurrent jobs in the queue (the driver's slot count;
+        actual parallelism is however many workers serve the mount).
+      poll_interval: driver's BASE done-file poll cadence per slot;
+        each slot backs off exponentially (x1.5, capped at >= 1 s) while
+        its job runs, so long evaluations do not hammer the mount.
+      eval_timeout: per-evaluation wall-clock bound; an expired job
+        records as a failed evaluation (it keeps its queue files for
+        post-mortem, but can never promote).
+      reserve_timeout: stale-claim reaping age, as in the worker CLI --
+        the driver reaps while polling, so a crashed worker's job
+        returns to ``new/`` even if every other worker is busy.
+
+    Returns the :func:`hyperband.asha` result dict; the scheduler's
+    trial store is driver-side, the queue directory holds the transport
+    record (every job's doc with owner/timings/tracebacks).
+    """
+    from ..hyperband import asha
+
+    if trials is not None and hasattr(trials, "queue"):
+        # a queue-backed store (FileTrials) would RE-publish every
+        # scheduler-recorded doc into new/ as a job -- workers would
+        # churn on budget-less garbage.  The scheduler store is
+        # driver-side bookkeeping; the queue directory is the transport
+        raise ValueError(
+            "asha_filequeue needs an in-memory Trials (or None) for "
+            "trials=; queue-backed stores like FileTrials re-publish "
+            "recorded docs as jobs"
+        )
+    queue = FileJobQueue(dirpath)
+    domain = Domain(BudgetedDomainFn(fn), space)
+    queue.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    # queue tids are namespaced per driver run: a resumed driver must
+    # never collide with the killed run's leftover files
+    run_tag = uuid.uuid4().hex[:8]
+    counter = itertools.count()
+    counter_lock = threading.Lock()
+    # reaping only matters on the reserve_timeout scale; one shared
+    # rate limit keeps ``inflight`` polling slots from issuing
+    # listdir+getmtime scans of running/ every tick on a network mount
+    reap_period = max(1.0, float(reserve_timeout or 0) / 10.0)
+    last_reap = [0.0]
+
+    def _maybe_reap():
+        with counter_lock:
+            now = time.monotonic()
+            if now - last_reap[0] < reap_period:
+                return
+            last_reap[0] = now
+        queue.reap(reserve_timeout)
+
+    def evaluator(vals, budget):
+        with counter_lock:
+            tid = f"{run_tag}-{next(counter)}"
+        doc = {
+            "tid": tid,
+            "state": JOB_STATE_NEW,
+            "spec": None,
+            "result": {"status": "new"},
+            "misc": {
+                "tid": tid,
+                "cmd": ("domain_attachment", "FMinIter_Domain"),
+                "workdir": None,
+                "idxs": {k: [tid] for k in vals},
+                # SONify: doc vals may be numpy scalars/0-d arrays and
+                # the queue serializes docs as JSON
+                "vals": SONify({k: [v] for k, v in vals.items()}),
+                "budget": SONify(budget),
+            },
+            "exp_key": exp_key,
+            "owner": None,
+            "version": 0,
+            "book_time": None,
+            "refresh_time": None,
+        }
+        queue.publish(doc)
+        done_path = os.path.join(queue.root, "done", f"{tid}.json")
+        deadline = (
+            None if eval_timeout is None else time.monotonic() + eval_timeout
+        )
+        # exponential backoff per slot: short evaluations see the
+        # responsive base cadence, long (TPU-training-scale) ones
+        # settle to ~1 Hz stats instead of hammering the mount's
+        # metadata path for hours
+        wait = float(poll_interval)
+        while True:
+            out = None
+            if os.path.exists(done_path):
+                try:
+                    out = _read_json(done_path)
+                except (ValueError, OSError):
+                    out = None  # mid-write on a non-atomic FS: retry,
+                    # but fall through to the deadline check -- a file
+                    # left permanently truncated by a killed worker
+                    # must not bypass eval_timeout
+            if out is not None:
+                result = out.get("result") or {}
+                if (
+                    out.get("state") == JOB_STATE_DONE
+                    and result.get("status") == STATUS_OK
+                ):
+                    return float(result["loss"])
+                logger.warning(
+                    "queued asha job %s failed: %s", tid,
+                    out.get("misc", {}).get("error"),
+                )
+                return float("nan")
+            if deadline is not None and time.monotonic() > deadline:
+                logger.warning("queued asha job %s timed out", tid)
+                return float("nan")
+            _maybe_reap()
+            time.sleep(wait)
+            wait = min(wait * 1.5, max(float(poll_interval), 1.0))
+
+    return asha(
+        fn,
+        space,
+        max_budget,
+        eta=eta,
+        min_budget=min_budget,
+        max_jobs=max_jobs,
+        workers=inflight,
+        algo=algo,
+        trials=trials,
+        rstate=rstate,
+        checkpoint=checkpoint,
+        checkpoint_every=checkpoint_every,
+        evaluator=evaluator,
+    )

@@ -37,20 +37,59 @@ class WorkerExit(Exception):
 
 def _load_domain(queue, cache={}):
     blob_key = "FMinIter_Domain"
-    if queue.root in cache:
-        return cache[queue.root]
     if blob_key not in queue.attachments:
         raise WorkerExit(
             f"no pickled Domain at {queue.root}/attachments -- is fmin running "
             "against this queue with an async FileTrials?"
         )
+    # cache keyed by the attachment file's identity, not forever: a new
+    # driver reusing the directory (e.g. asha_filequeue after an fmin
+    # run) RE-publishes the Domain, and a long-lived worker must pick
+    # the new objective up rather than silently evaluating the stale
+    # one.  Every publish is tmp+rename = a NEW inode, so st_ino moves
+    # even on mounts with coarse timestamps where two publishes can
+    # land inside one mtime tick; mtime+size ride along as backstops.
+    path = queue.attachments._path(blob_key)
+    try:
+        st = os.stat(path)
+    except FileNotFoundError:  # raced a re-publish; next loop retries
+        raise WorkerExit(f"domain attachment vanished under {queue.root}")
+    ident = (st.st_ino, st.st_mtime_ns, st.st_size)
+    hit = cache.get(queue.root)
+    if hit is not None and hit[0] == ident:
+        return hit[1]
     domain = pickle.loads(queue.attachments[blob_key])
-    cache[queue.root] = domain
+    cache[queue.root] = (ident, domain)
     return domain
 
 
-def run_one(queue, owner, exp_key=None, workdir=None, trials=None):
-    """Reserve and evaluate a single job; False if the queue was empty."""
+def _heartbeat(path, interval, stop):
+    """Refresh a running-file's mtime until ``stop`` is set: the claim
+    stays visibly alive through evaluations LONGER than the reserve
+    timeout, so reapers only recycle jobs whose worker actually died
+    (an untouched claim means a crashed/wedged process, not a long
+    objective)."""
+    while not stop.wait(interval):
+        try:
+            os.utime(path)
+        except FileNotFoundError:  # completed/reaped underneath us
+            return
+        except OSError as e:  # transient mount blip (ESTALE/EIO class):
+            # keep beating -- permanently exiting would freeze the
+            # mtime and get a LIVE job reaped and duplicated
+            logger.warning("heartbeat on %s failed transiently: %s", path, e)
+
+
+def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
+            heartbeat=None):
+    """Reserve and evaluate a single job; False if the queue was empty.
+
+    ``heartbeat`` (seconds) keeps the reserved job's claim fresh during
+    evaluation -- the worker CLI passes ``reserve_timeout / 3``.  None
+    disables it (unit-test mode / instant objectives).
+    """
+    import threading
+
     doc = queue.reserve(owner, exp_key=exp_key)
     if doc is None:
         return False
@@ -61,6 +100,17 @@ def run_one(queue, owner, exp_key=None, workdir=None, trials=None):
     # Ctrl.checkpoint asserts membership of the live store
     trials._dynamic_trials.append(doc)
     spec = spec_from_misc(doc["misc"])
+    stop = threading.Event()
+    beat = None
+    if heartbeat is not None:
+        running_path = os.path.join(
+            queue.root, "running", f"{doc['tid']}.json"
+        )
+        beat = threading.Thread(
+            target=_heartbeat, args=(running_path, float(heartbeat), stop),
+            daemon=True,
+        )
+        beat.start()
     try:
         if workdir:
             with working_dir(os.path.join(workdir, str(doc["tid"]))):
@@ -75,6 +125,10 @@ def run_one(queue, owner, exp_key=None, workdir=None, trials=None):
     else:
         doc["state"] = JOB_STATE_DONE
         doc["result"] = SONify(result)
+    finally:
+        stop.set()
+        if beat is not None:
+            beat.join(timeout=5)
     queue.complete(doc)
     return True
 
@@ -95,6 +149,10 @@ def main_worker_helper(options):
             ran = run_one(
                 queue, owner, exp_key=options.exp_key,
                 workdir=options.workdir, trials=trials,
+                heartbeat=(
+                    options.reserve_timeout / 3.0
+                    if options.reserve_timeout else None
+                ),
             )
         except WorkerExit as e:
             logger.info("worker exit: %s", e)

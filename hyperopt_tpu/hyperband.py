@@ -817,6 +817,7 @@ def asha(
     rstate=None,
     checkpoint=None,
     checkpoint_every=1,
+    evaluator=None,
 ):
     """Asynchronous successive halving (ASHA, Li et al., 2020).
 
@@ -866,6 +867,14 @@ def asha(
       checkpoint_every: snapshot cadence in recorded evaluations
         (default 1: every record; raise it if pickling a large trials
         store every record measures as the bottleneck).
+      evaluator: optional transport seam, ``evaluator(vals, budget) ->
+        loss`` where ``vals`` is the INDEX-form config dict (the
+        encoding trial docs carry) -- lets the scheduler dispatch
+        evaluations somewhere other than this process while the worker
+        threads become in-flight-job slots.
+        :func:`hyperopt_tpu.distributed.asha_filequeue` uses it to farm
+        evaluations to ``hyperopt-tpu-worker`` processes.  Default:
+        evaluate ``fn(space_eval(space, vals), budget)`` inline.
 
     Returns ``{"best": config, "best_loss", "rungs": [{"budget", "n"}],
     "trials"}`` where ``best`` is the best completed evaluation at the
@@ -1066,9 +1075,17 @@ def asha(
             if job is None:
                 return
             key, r = job
-            cfg = space_eval(space, configs[key])
+            # decode OUTSIDE the try: a space_eval failure is a
+            # deterministic framework/space bug that must surface
+            # immediately, not burn max_jobs NaN trials
+            cfg = None if evaluator is not None else space_eval(
+                space, configs[key]
+            )
             try:
-                loss = fn(cfg, rung_budget(r))
+                if evaluator is not None:
+                    loss = evaluator(dict(configs[key]), rung_budget(r))
+                else:
+                    loss = fn(cfg, rung_budget(r))
                 if isinstance(loss, dict):
                     loss = loss["loss"]
                 loss = float(loss)

@@ -330,6 +330,22 @@ def mixed_space_fn(cfg):
     return t
 
 
+def budgeted_quadratic_fn(cfg, budget):
+    """Multi-fidelity battery member for the scheduler family
+    (SHA/Hyperband/ASHA drivers and their distributed twins): a
+    quadratic whose observation noise shrinks with evaluation budget,
+    so promotion must pick genuinely good configs through rung-0 noise.
+    Deterministic per ``(config, budget)`` and module-level picklable --
+    the Domain-shipping backends (filequeue/Mongo) can send it to
+    worker processes."""
+    rng = np.random.default_rng(int(1e6 * (cfg["x"] % 1)) % 2**31)
+    return (cfg["x"] - 3.0) ** 2 + float(rng.normal(0.0, 1.0 / budget))
+
+
+def budgeted_quadratic_space():
+    return {"x": hp.uniform("x", -10.0, 10.0)}
+
+
 def mixed_space_fn_jax(cfg):
     """``mixed_space_fn`` as jnp math over ``[batch]`` value arrays -- the
     device-loop twin (``device_loop.compile_fmin`` needs a JAX-traceable

@@ -179,6 +179,204 @@ def test_fmin_with_subprocess_workers(tmp_path):
     assert os.getpid() not in pids
 
 
+def _objective_a(x):
+    return 10.0 + x
+
+
+def _objective_b(x):
+    return 20.0 + x
+
+
+def test_worker_reloads_republished_domain(tmp_path):
+    """A long-lived worker must pick up a RE-published Domain (a new
+    driver reusing the queue directory), not evaluate the stale cached
+    one forever -- the cache is keyed by the attachment's mtime."""
+    from hyperopt_tpu.base import Domain
+
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=None)
+    space = hp.uniform("x", 0, 1)
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(
+        Domain(_objective_a, space)
+    )
+    docs = rand.suggest(trials.new_trial_ids(1), Domain(_objective_a, space),
+                        trials, seed=0)
+    trials.insert_trial_docs(docs)
+    assert run_one(trials.queue, worker_owner())
+    time.sleep(0.02)  # distinct attachment mtime_ns
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(
+        Domain(_objective_b, space)
+    )
+    docs = rand.suggest(trials.new_trial_ids(1), Domain(_objective_b, space),
+                        trials, seed=1)
+    trials.insert_trial_docs(docs)
+    assert run_one(trials.queue, worker_owner())
+    trials.refresh()
+    losses = sorted(t["result"]["loss"] for t in trials.trials)
+    assert 10.0 <= losses[0] < 11.0  # first domain
+    assert 20.0 <= losses[1] < 21.0  # re-published domain, same worker cache
+
+
+def _slow_objective(x):
+    time.sleep(0.6)
+    return x
+
+
+def test_worker_heartbeat_defeats_reaping_of_live_jobs(tmp_path):
+    """An evaluation LONGER than the reserve timeout keeps its claim:
+    the heartbeat refreshes the running-file mtime, so reap() recycles
+    only genuinely dead workers' jobs (no duplicate evaluation of slow
+    objectives)."""
+    import threading
+
+    from hyperopt_tpu.base import Domain
+
+    dirpath = str(tmp_path / "q")
+    trials = FileTrials(dirpath, reserve_timeout=None)
+    space = hp.uniform("x", 0, 1)
+    trials.attachments["FMinIter_Domain"] = pickle.dumps(
+        Domain(_slow_objective, space)
+    )
+    docs = rand.suggest(
+        trials.new_trial_ids(1), Domain(_slow_objective, space), trials,
+        seed=0,
+    )
+    trials.insert_trial_docs(docs)
+    t = threading.Thread(
+        target=run_one,
+        args=(trials.queue, worker_owner()),
+        kwargs={"heartbeat": 0.05},
+    )
+    t.start()
+    time.sleep(0.35)  # well past a 0.15s reserve timeout, eval still going
+    assert trials.queue.reap(reserve_timeout=0.15) == 0  # claim is alive
+    t.join(timeout=10)
+    assert trials.queue.counts() == {"new": 0, "running": 0, "done": 1}
+
+
+# ---------------------------------------------------------------------------
+# ASHA over the filequeue (async scheduler x async backend)
+# ---------------------------------------------------------------------------
+
+
+def test_budgeted_domain_fn_worker_roundtrip(tmp_path):
+    """Worker-side budget plumbing: a queued doc carrying
+    misc['budget'] evaluates fn(config, budget) through the pickled
+    BudgetedDomainFn -- the in-process run_one twin of the subprocess
+    test below."""
+    from hyperopt_tpu.base import Domain
+    from hyperopt_tpu.distributed.asha_queue import BudgetedDomainFn
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    dirpath = str(tmp_path / "q")
+    q = FileJobQueue(dirpath)
+    domain = Domain(
+        BudgetedDomainFn(budgeted_quadratic_fn), budgeted_quadratic_space()
+    )
+    q.attachments["FMinIter_Domain"] = pickle.dumps(domain)
+    for tid, budget in (("t0", 1), ("t1", 9)):
+        doc = make_doc(0)
+        doc["tid"] = doc["misc"]["tid"] = tid
+        doc["misc"]["cmd"] = ("domain_attachment", "FMinIter_Domain")
+        doc["misc"]["idxs"] = {"x": [tid]}
+        doc["misc"]["vals"] = {"x": [0.5]}
+        doc["misc"]["budget"] = budget
+        q.publish(doc)
+    assert run_one(q, worker_owner())
+    assert run_one(q, worker_owner())
+    done = q.done_docs()
+    for tid, budget in (("t0", 1), ("t1", 9)):
+        want = budgeted_quadratic_fn({"x": 0.5}, budget)
+        assert done[tid]["result"]["loss"] == pytest.approx(want)
+    # the two budgets produced different losses: budget reached the fn
+    assert done["t0"]["result"]["loss"] != done["t1"]["result"]["loss"]
+
+
+@pytest.mark.slow
+def test_asha_filequeue_with_subprocess_workers(tmp_path):
+    """The async scheduler drives the async backend: ASHA promotion
+    decisions on the driver, evaluations farmed to real worker
+    SUBPROCESSES through the queue's atomic reservation.  Ladder
+    invariants hold and every result was computed out-of-process."""
+    from hyperopt_tpu.distributed import asha_filequeue
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    dirpath = str(tmp_path / "q")
+    workers = [_spawn_worker(dirpath) for _ in range(2)]
+    try:
+        out = asha_filequeue(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            max_budget=9, dirpath=dirpath, eta=3, max_jobs=30,
+            inflight=4, rstate=np.random.default_rng(0),
+            eval_timeout=120.0,
+        )
+    finally:
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            w.wait(timeout=10)
+    trials = out["trials"]
+    assert len(trials) == 30
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert set(budgets) <= {1, 3, 9}
+    assert budgets.count(1) > budgets.count(9) > 0
+    # promotion chain: every deeper-rung x was first seen at the rung below
+    x_at = lambda b: {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == b
+    }
+    assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+    assert np.isfinite(out["best_loss"])
+    # transport record: every queue job completed by a WORKER process
+    q = FileJobQueue(dirpath)
+    done = q.done_docs()
+    assert len(done) == 30
+    owners = {d["owner"] for d in done.values()}
+    assert owners and all(o and ":" in o for o in owners)
+    assert os.getpid() not in {int(o.split(":")[1]) for o in owners}
+    # every queue doc carried its rung budget to the worker
+    assert {d["misc"]["budget"] for d in done.values()} <= {1, 3, 9}
+
+
+def test_asha_filequeue_rejects_queue_backed_trials(tmp_path):
+    """Passing a FileTrials as the scheduler store would re-publish
+    every recorded doc into new/ as a budget-less job -- refused."""
+    from hyperopt_tpu.distributed import asha_filequeue
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    with pytest.raises(ValueError, match="in-memory Trials"):
+        asha_filequeue(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            max_budget=4, dirpath=str(tmp_path / "q"),
+            trials=FileTrials(str(tmp_path / "q2"), reserve_timeout=None),
+        )
+
+
+def test_asha_filequeue_no_workers_times_out(tmp_path):
+    """With nobody serving the queue, every evaluation expires into a
+    failed trial and the scheduler raises AllTrialsFailed rather than
+    hanging forever."""
+    from hyperopt_tpu.distributed import asha_filequeue
+    from hyperopt_tpu.exceptions import AllTrialsFailed
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    with pytest.raises(AllTrialsFailed):
+        asha_filequeue(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            max_budget=4, dirpath=str(tmp_path / "q"), eta=2, max_jobs=4,
+            inflight=2, rstate=np.random.default_rng(0),
+            eval_timeout=0.3, poll_interval=0.02,
+        )
+
+
 @pytest.mark.slow
 def test_filetrials_resume_across_instances(tmp_path):
     """The queue directory IS the experiment state (DB-as-state parity)."""
