@@ -54,6 +54,24 @@ def main():
         )
         print("best:", best)
         print("best loss:", min(trials.losses()))
+
+        # The async scheduler over the SAME worker pool: ASHA promotion
+        # decisions on the driver, budget-aware evaluations farmed
+        # through the queue (the workers pick up the re-published
+        # budget-aware Domain automatically).
+        from hyperopt_tpu.distributed import asha_filequeue
+        from hyperopt_tpu.models.synthetic import (
+            budgeted_quadratic_fn, budgeted_quadratic_space,
+        )
+
+        out = asha_filequeue(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            max_budget=9, dirpath=exp_dir, eta=3, max_jobs=30,
+            inflight=4, rstate=np.random.default_rng(0),
+            eval_timeout=300.0,
+        )
+        print("asha rungs:", [(r["budget"], r["n"]) for r in out["rungs"]])
+        print("asha best loss:", out["best_loss"])
     finally:
         for w in workers:
             w.terminate()

@@ -101,8 +101,9 @@ def asha_filequeue(
       inflight: concurrent jobs in the queue (the driver's slot count;
         actual parallelism is however many workers serve the mount).
       poll_interval: driver's BASE done-file poll cadence per slot;
-        each slot backs off exponentially (x1.5, capped at >= 1 s) while
-        its job runs, so long evaluations do not hammer the mount.
+        each slot backs off proportionally to its job's elapsed time
+        (~10%, capped at >= 1 s), so short jobs are detected within
+        ~poll_interval while long evaluations do not hammer the mount.
       eval_timeout: per-evaluation wall-clock bound; an expired job
         records as a failed evaluation (it keeps its queue files for
         post-mortem, but can never promote).
@@ -177,11 +178,13 @@ def asha_filequeue(
         deadline = (
             None if eval_timeout is None else time.monotonic() + eval_timeout
         )
-        # exponential backoff per slot: short evaluations see the
-        # responsive base cadence, long (TPU-training-scale) ones
-        # settle to ~1 Hz stats instead of hammering the mount's
-        # metadata path for hours
-        wait = float(poll_interval)
+        # proportional backoff per slot: poll at ~10% of the job's
+        # elapsed time, floored at the responsive base cadence and
+        # capped at 1 Hz -- short evaluations pay ~poll_interval of
+        # detection latency while long (TPU-training-scale) ones stop
+        # hammering the mount's metadata path (total polls grow
+        # logarithmically, then linearly at 1/s)
+        published = time.monotonic()
         while True:
             out = None
             if os.path.exists(done_path):
@@ -208,8 +211,11 @@ def asha_filequeue(
                 logger.warning("queued asha job %s timed out", tid)
                 return float("nan")
             _maybe_reap()
-            time.sleep(wait)
-            wait = min(wait * 1.5, max(float(poll_interval), 1.0))
+            elapsed = time.monotonic() - published
+            time.sleep(min(
+                max(float(poll_interval), 0.1 * elapsed),
+                max(float(poll_interval), 1.0),
+            ))
 
     return asha(
         fn,

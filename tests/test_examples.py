@@ -90,10 +90,14 @@ def test_example_speculative_sequential():
 
 @pytest.mark.slow
 def test_example_distributed_workers():
-    """Driver + two real worker subprocesses over the filequeue."""
+    """Driver + two real worker subprocesses over the filequeue, then
+    ASHA over the SAME workers (the re-published budget-aware Domain
+    is picked up by the live worker pool)."""
     out = run_example("04_distributed_workers.py", timeout=900)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "best loss:" in out.stdout
+    assert "asha rungs:" in out.stdout
+    assert "asha best loss:" in out.stdout
 
 
 @pytest.mark.slow
