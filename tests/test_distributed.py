@@ -378,6 +378,96 @@ def test_asha_filequeue_no_workers_times_out(tmp_path):
 
 
 @pytest.mark.slow
+def test_asha_filequeue_driver_kill_resume(tmp_path):
+    """SIGKILL the DRIVER process mid-run (workers stay alive), then
+    resume from its checkpoint in a fresh driver: the run completes to
+    the exact total budget over the same worker pool -- the
+    checkpoint x transport composition the module docstring claims."""
+    import signal
+
+    dirpath = str(tmp_path / "q")
+    ckpt = str(tmp_path / "asha.ckpt")
+    # ONE kwargs dict: the killed driver's code string and the resume
+    # call must not drift apart (the guard only catches some fields)
+    kw = dict(
+        max_budget=9, eta=3, max_jobs=60, inflight=2,
+        dirpath=dirpath, checkpoint=ckpt, eval_timeout=120.0,
+    )
+    code = (
+        "import numpy as np\n"
+        "from hyperopt_tpu.distributed import asha_filequeue\n"
+        "from hyperopt_tpu.models.synthetic import (\n"
+        "    budgeted_quadratic_fn, budgeted_quadratic_space)\n"
+        "asha_filequeue(budgeted_quadratic_fn, budgeted_quadratic_space(),\n"
+        f"    rstate=np.random.default_rng(3), **{kw!r})\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    workers = [_spawn_worker(dirpath) for _ in range(2)]
+    drv = None
+    drv_err = open(str(tmp_path / "driver.stderr"), "w+")
+    try:
+        # stderr to a FILE, not a pipe: an undrained pipe would block a
+        # chatty driver at ~64KB and masquerade as a worker stall
+        drv = subprocess.Popen(
+            [sys.executable, "-c", code], env=env,
+            stdout=subprocess.DEVNULL, stderr=drv_err,
+        )
+        q = FileJobQueue(dirpath)
+        deadline = time.time() + 300
+        while time.time() < deadline and len(q.done_docs()) < 8:
+            if drv.poll() is not None:  # driver crashed at startup:
+                # fail with ITS error, not a misleading worker blame
+                drv_err.seek(0)
+                raise AssertionError(
+                    f"driver exited rc={drv.returncode}: "
+                    f"{drv_err.read()[-2000:]}"
+                )
+            time.sleep(0.1)
+        assert len(q.done_docs()) >= 8, "workers never progressed"
+        drv.send_signal(signal.SIGKILL)  # a real kill, not an exception
+        drv.wait(timeout=10)
+        assert os.path.exists(ckpt), "no snapshot survived the kill"
+        # the kill must land MID-run, else resume has nothing to do and
+        # this test silently stops covering its subject (60 jobs at
+        # >=10ms each vs a signal in-flight for ms makes this robust)
+        from hyperopt_tpu.utils.checkpoint import load_trials
+
+        assert load_trials(ckpt)["recorded"] < 60, (
+            "driver finished before the kill; raise max_jobs"
+        )
+
+        from hyperopt_tpu.distributed import asha_filequeue
+        from hyperopt_tpu.models.synthetic import (
+            budgeted_quadratic_fn, budgeted_quadratic_space,
+        )
+
+        out = asha_filequeue(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            rstate=np.random.default_rng(3), **kw,
+        )
+    finally:
+        if drv is not None and drv.poll() is None:
+            drv.kill()  # never leak a driver past a failed assertion
+            drv.wait(timeout=10)
+        drv_err.close()
+        for w in workers:
+            w.terminate()
+        for w in workers:
+            w.wait(timeout=10)
+    trials = out["trials"]
+    assert len(trials) == 60  # exact total budget across kill + resume
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert set(budgets) <= {1, 3, 9}
+    x_at = lambda b: {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == b
+    }
+    assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+    assert np.isfinite(out["best_loss"])
+
+
+@pytest.mark.slow
 def test_filetrials_resume_across_instances(tmp_path):
     """The queue directory IS the experiment state (DB-as-state parity)."""
     from hyperopt_tpu.base import Domain
