@@ -77,9 +77,11 @@ __all__ = [
     "fmin_pass_expr_memo_ctrl",
     "generate_trials_to_calculate",
     "hp",
+    "hyperband",
     "mix",
     "no_progress_loss",
     "partial",
+    "pbt",
     "pyll",
     "rand",
     "space_eval",
@@ -122,6 +124,13 @@ def __getattr__(name):
         "parallel",
         "distributed",
         "models",
+        "hyperband",
+        "pbt",
+        # progress/utils resolve today via eager siblings' transitive
+        # imports; listing them makes the attribute a guarantee, not an
+        # accident of import order
+        "progress",
+        "utils",
         "atpe",
         "criteria",
         "plotting",
