@@ -57,8 +57,9 @@ def main():
 
         # The async scheduler over the SAME worker pool: ASHA promotion
         # decisions on the driver, budget-aware evaluations farmed
-        # through the queue (the workers pick up the re-published
-        # budget-aware Domain automatically).
+        # through the queue (each job doc names its own Domain
+        # attachment, so the fmin run's Domain above stays untouched
+        # and the live workers resolve the right objective per job).
         from hyperopt_tpu.distributed import asha_filequeue
         from hyperopt_tpu.models.synthetic import (
             budgeted_quadratic_fn, budgeted_quadratic_space,

@@ -29,14 +29,16 @@ from .filequeue import FileTrials, FileJobQueue
 
 __all__ = [
     "ThreadTrials", "FileTrials", "FileJobQueue",
-    "asha_filequeue", "BudgetedDomainFn",
+    "asha_filequeue", "asha_mongo", "BudgetedDomainFn",
 ]
 
 
 def __getattr__(name):
     import importlib
 
-    if name in ("asha_queue", "asha_filequeue", "BudgetedDomainFn"):
+    if name in (
+        "asha_queue", "asha_filequeue", "asha_mongo", "BudgetedDomainFn"
+    ):
         # lazy: pulls in hyperband (and its numpy graph machinery) only
         # when the ASHA-over-queue driver is actually used
         mod = importlib.import_module(".asha_queue", __name__)

@@ -1,0 +1,107 @@
+"""Shared mechanics of the worker backends (filequeue + Mongo).
+
+Both workers implement the same three contracts -- which Domain a job
+doc names, a cooldown set for jobs whose Domain would not load, and a
+small identity-validated Domain cache -- so the logic lives once here
+and cannot drift between transports.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import logging
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["blob_key_from_doc", "TTLSet", "lru_get", "claim_heartbeat"]
+
+DEFAULT_DOMAIN_KEY = "FMinIter_Domain"
+
+
+def blob_key_from_doc(doc):
+    """The Domain attachment a trial doc names (the reference's cmd
+    contract); drivers with different objectives share one queue, each
+    doc resolving its own."""
+    cmd = (doc.get("misc") or {}).get("cmd") or (None, None)
+    return cmd[1] if cmd[0] == "domain_attachment" else DEFAULT_DOMAIN_KEY
+
+
+class TTLSet:
+    """Keys on cooldown: ``add`` starts a member's TTL, ``current()``
+    prunes and returns the live members.  Used for poisoned-job tids --
+    excluded from reservation long enough to stop a livelock on the
+    lowest-tid job, retried afterwards in case the failure (a network
+    blip misread as a missing attachment) was transient."""
+
+    def __init__(self, ttl=300.0, clock=time.monotonic):
+        self.ttl = float(ttl)
+        self._clock = clock
+        self._seen = {}
+
+    def add(self, key):
+        self._seen[key] = self._clock()
+
+    def current(self):
+        now = self._clock()
+        self._seen = {
+            k: ts for k, ts in self._seen.items() if now - ts < self.ttl
+        }
+        return list(self._seen)
+
+
+@contextlib.contextmanager
+def claim_heartbeat(beat, interval):
+    """Run ``beat()`` every ``interval`` seconds on a daemon thread for
+    the duration of the with-block -- the shared scaffold keeping a
+    reserved job's claim visibly alive through evaluations LONGER than
+    the reserve timeout, so reapers only recycle jobs whose worker
+    actually died.  ``beat`` returns False to stop early (the claim is
+    gone: completed/reaped underneath us); exceptions are logged and
+    beating continues (a transient transport blip must not freeze the
+    claim and get a LIVE job reaped and duplicated).  ``interval=None``
+    disables the heartbeat entirely.
+    """
+    if interval is None:
+        yield
+        return
+    stop = threading.Event()
+
+    def loop():
+        while not stop.wait(float(interval)):
+            try:
+                if beat() is False:
+                    return
+            except Exception as e:
+                logger.warning("claim heartbeat failed transiently: %s", e)
+
+    th = threading.Thread(target=loop, daemon=True)
+    th.start()
+    try:
+        yield
+    finally:
+        stop.set()
+        th.join(timeout=5)
+
+
+def lru_get(cache, key, ident, load, cap=8):
+    """Identity-validated LRU lookup: return ``cache[key]``'s value if
+    its recorded identity equals ``ident``, else ``load()`` and store
+    ``(ident, value)``.  Evicts least-recently-used entries beyond
+    ``cap`` -- a long-lived worker serving many successive driver runs
+    (one unique attachment key each) must not hold every run's
+    unpickled Domain until OOM.
+
+    ``cache`` must be a ``collections.OrderedDict``.
+    """
+    assert isinstance(cache, collections.OrderedDict)
+    hit = cache.get(key)
+    if hit is None or hit[0] != ident:
+        hit = (ident, load())
+        cache[key] = hit
+    cache.move_to_end(key)
+    while len(cache) > cap:
+        cache.popitem(last=False)
+    return hit[1]

@@ -23,6 +23,12 @@ NFS/GCS-FUSE).  The division of labor:
 
 ``asha(checkpoint=...)`` composes: the scheduler snapshot lives with
 the driver, the queue directory is the transport record.
+
+:func:`asha_mongo` is the same driver/worker split over the MongoDB
+protocol (``hyperopt-tpu-mongo-worker`` processes, GridFS Domain
+shipping) -- both share :class:`_TransportDriver`, so transport
+behavior (tid namespacing, proportional-backoff polling, rate-limited
+reaping, timeout-as-failed-trial) is identical.
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ from .filequeue import FileJobQueue, _read_json
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BudgetedDomainFn", "asha_filequeue"]
+__all__ = ["BudgetedDomainFn", "asha_filequeue", "asha_mongo"]
 
 
 class BudgetedDomainFn:
@@ -115,107 +121,93 @@ def asha_filequeue(
     trial store is driver-side, the queue directory holds the transport
     record (every job's doc with owner/timings/tracebacks).
     """
-    from ..hyperband import asha
-
-    if trials is not None and hasattr(trials, "queue"):
-        # a queue-backed store (FileTrials) would RE-publish every
-        # scheduler-recorded doc into new/ as a job -- workers would
-        # churn on budget-less garbage.  The scheduler store is
-        # driver-side bookkeeping; the queue directory is the transport
-        raise ValueError(
-            "asha_filequeue needs an in-memory Trials (or None) for "
-            "trials=; queue-backed stores like FileTrials re-publish "
-            "recorded docs as jobs"
-        )
+    _reject_queue_backed_trials(trials, "asha_filequeue")
     queue = FileJobQueue(dirpath)
+    # per-run attachment key: a queue directory shared with a live fmin
+    # (or a previous asha run) keeps every driver's Domain intact --
+    # each job doc's cmd names the one to evaluate with
+    attachment_key = f"FMinIter_Domain.asha-{uuid.uuid4().hex[:8]}"
     domain = Domain(BudgetedDomainFn(fn), space)
-    queue.attachments["FMinIter_Domain"] = pickle.dumps(domain)
-    # queue tids are namespaced per driver run: a resumed driver must
-    # never collide with the killed run's leftover files
-    run_tag = uuid.uuid4().hex[:8]
-    counter = itertools.count()
-    counter_lock = threading.Lock()
-    # reaping only matters on the reserve_timeout scale; one shared
-    # rate limit keeps ``inflight`` polling slots from issuing
-    # listdir+getmtime scans of running/ every tick on a network mount
-    reap_period = max(1.0, float(reserve_timeout or 0) / 10.0)
-    last_reap = [0.0]
+    queue.attachments[attachment_key] = pickle.dumps(domain)
 
-    def _maybe_reap():
-        with counter_lock:
-            now = time.monotonic()
-            if now - last_reap[0] < reap_period:
-                return
-            last_reap[0] = now
-        queue.reap(reserve_timeout)
-
-    def evaluator(vals, budget):
-        with counter_lock:
-            tid = f"{run_tag}-{next(counter)}"
-        doc = {
-            "tid": tid,
-            "state": JOB_STATE_NEW,
-            "spec": None,
-            "result": {"status": "new"},
-            "misc": {
-                "tid": tid,
-                "cmd": ("domain_attachment", "FMinIter_Domain"),
-                "workdir": None,
-                "idxs": {k: [tid] for k in vals},
-                # SONify: doc vals may be numpy scalars/0-d arrays and
-                # the queue serializes docs as JSON
-                "vals": SONify({k: [v] for k, v in vals.items()}),
-                "budget": SONify(budget),
-            },
-            "exp_key": exp_key,
-            "owner": None,
-            "version": 0,
-            "book_time": None,
-            "refresh_time": None,
-        }
-        queue.publish(doc)
+    def fetch(tid):
         done_path = os.path.join(queue.root, "done", f"{tid}.json")
-        deadline = (
-            None if eval_timeout is None else time.monotonic() + eval_timeout
+        if not os.path.exists(done_path):
+            return None
+        try:
+            return _read_json(done_path)
+        except (ValueError, OSError):
+            return None  # mid-write on a non-atomic FS: retry, but the
+            # driver's deadline check still runs -- a file left
+            # permanently truncated by a killed worker must not bypass
+            # eval_timeout
+
+    transport = _TransportDriver(
+        publish=queue.publish,
+        fetch=fetch,
+        reap=queue.reap,
+        exp_key=exp_key,
+        poll_interval=poll_interval,
+        eval_timeout=eval_timeout,
+        reserve_timeout=reserve_timeout,
+        attachment_key=attachment_key,
+    )
+    try:
+        return _run_asha(
+            transport, fn, space, max_budget, eta, min_budget, max_jobs,
+            inflight, algo, trials, rstate, checkpoint, checkpoint_every,
         )
-        # proportional backoff per slot: poll at ~10% of the job's
-        # elapsed time, floored at the responsive base cadence and
-        # capped at 1 Hz -- short evaluations pay ~poll_interval of
-        # detection latency while long (TPU-training-scale) ones stop
-        # hammering the mount's metadata path (total polls grow
-        # logarithmically, then linearly at 1/s)
-        published = time.monotonic()
-        while True:
-            out = None
-            if os.path.exists(done_path):
-                try:
-                    out = _read_json(done_path)
-                except (ValueError, OSError):
-                    out = None  # mid-write on a non-atomic FS: retry,
-                    # but fall through to the deadline check -- a file
-                    # left permanently truncated by a killed worker
-                    # must not bypass eval_timeout
-            if out is not None:
-                result = out.get("result") or {}
-                if (
-                    out.get("state") == JOB_STATE_DONE
-                    and result.get("status") == STATUS_OK
-                ):
-                    return float(result["loss"])
-                logger.warning(
-                    "queued asha job %s failed: %s", tid,
-                    out.get("misc", {}).get("error"),
-                )
-                return float("nan")
-            if deadline is not None and time.monotonic() > deadline:
-                logger.warning("queued asha job %s timed out", tid)
-                return float("nan")
-            _maybe_reap()
-            elapsed = time.monotonic() - published
-            time.sleep(min(
-                max(float(poll_interval), 0.1 * elapsed),
-                max(float(poll_interval), 1.0),
-            ))
+    finally:
+        _cleanup_attachment(
+            transport, lambda: queue.attachments.__delitem__(attachment_key)
+        )
+
+
+def _cleanup_attachment(transport, delete):
+    """Run-scoped Domain blobs must not accumulate forever (one per
+    asha run on a shared queue/database) -- delete on the way out,
+    UNLESS any of this run's jobs may still be evaluated later: a
+    timed-out job's queue entry, or jobs left published-but-uncollected
+    by an aborted driver.  Deleting under those would turn them into
+    dangling-attachment poison pills; such runs keep their blob."""
+    if transport.expired or transport.collected < transport.published:
+        logger.warning(
+            "keeping Domain attachment %s: %d timed-out and %d "
+            "uncollected job(s) may still be evaluated",
+            transport.attachment_key, transport.expired,
+            transport.published - transport.collected,
+        )
+        return
+    try:
+        delete()
+    except KeyError:
+        pass
+    except Exception as e:  # cleanup must never mask the run's result
+        logger.warning(
+            "could not delete Domain attachment %s: %s",
+            transport.attachment_key, e,
+        )
+
+
+def _reject_queue_backed_trials(trials, caller):
+    """Both drivers need an IN-MEMORY scheduler store: an asynchronous
+    Trials (FileTrials, MongoTrials, ThreadTrials, SparkTrials -- every
+    store whose insert publishes or evaluates docs marks itself
+    ``asynchronous``) would re-process each scheduler-recorded doc as a
+    job, and workers would churn on budget-less garbage."""
+    if trials is not None and getattr(trials, "asynchronous", False):
+        raise ValueError(
+            f"{caller} needs an in-memory Trials (or None) for trials=; "
+            "queue-backed stores re-publish recorded docs as jobs"
+        )
+
+
+def _run_asha(transport, fn, space, max_budget, eta, min_budget,
+              max_jobs, inflight, algo, trials, rstate, checkpoint,
+              checkpoint_every):
+    """One shared asha() invocation for every transport driver -- a new
+    asha parameter threads through here once, not per transport."""
+    from ..hyperband import asha
 
     return asha(
         fn,
@@ -230,5 +222,201 @@ def asha_filequeue(
         rstate=rstate,
         checkpoint=checkpoint,
         checkpoint_every=checkpoint_every,
-        evaluator=evaluator,
+        evaluator=transport.evaluator,
     )
+
+
+class _TransportDriver:
+    """Driver-side transport shared by the filequeue and Mongo ASHA
+    drivers: per-run tid namespacing (a resumed driver must never
+    collide with a killed run's leftover jobs), trial-doc building,
+    result polling with proportional backoff, and rate-limited reaping.
+
+    ``publish(doc)`` enqueues a NEW job doc; ``fetch(tid)`` returns the
+    completed doc (state DONE or ERROR) or None while in flight --
+    transient read failures should surface as None so the deadline
+    check still runs; ``reap(reserve_timeout)`` recycles stale claims.
+    """
+
+    def __init__(self, publish, fetch, reap, exp_key, poll_interval,
+                 eval_timeout, reserve_timeout,
+                 attachment_key="FMinIter_Domain"):
+        self._publish = publish
+        self._fetch = fetch
+        self._reap = reap
+        self.exp_key = exp_key
+        self.attachment_key = attachment_key
+        self.poll_interval = float(poll_interval)
+        self.eval_timeout = eval_timeout
+        self.reserve_timeout = reserve_timeout
+        self._run_tag = uuid.uuid4().hex[:8]
+        self._counter = itertools.count()
+        self._lock = threading.Lock()
+        self.expired = 0  # timed-out jobs: their queue entries may
+        # still be evaluated later, so run-scoped cleanup must not
+        # delete the Domain from under them
+        self.published = 0  # publish/collect accounting: cleanup is
+        self.collected = 0  # safe only when every published job's
+        # result was collected (an aborted driver may leave jobs in
+        # the queue that still name this run's attachment)
+        # reaping only matters on the reserve_timeout scale; one shared
+        # rate limit keeps the polling slots from issuing full queue
+        # scans every tick on a network mount / remote database
+        self._reap_period = max(1.0, float(reserve_timeout or 0) / 10.0)
+        self._last_reap = 0.0
+
+    def _maybe_reap(self):
+        with self._lock:
+            now = time.monotonic()
+            if now - self._last_reap < self._reap_period:
+                return
+            self._last_reap = now
+        self._reap(self.reserve_timeout)
+
+    def evaluator(self, vals, budget):
+        """The :func:`hyperband.asha` ``evaluator=`` seam: one queued
+        job per call, blocking until its result lands (or expires)."""
+        with self._lock:
+            tid = f"{self._run_tag}-{next(self._counter)}"
+            self.published += 1
+        self._publish({
+            "tid": tid,
+            "state": JOB_STATE_NEW,
+            "spec": None,
+            "result": {"status": "new"},
+            "misc": {
+                "tid": tid,
+                # the doc NAMES its Domain attachment (the reference's
+                # cmd contract): drivers with different objectives can
+                # share one queue/database without clobbering each other
+                "cmd": ("domain_attachment", self.attachment_key),
+                "workdir": None,
+                "idxs": {k: [tid] for k in vals},
+                # SONify: doc vals may be numpy scalars/0-d arrays and
+                # transports serialize docs (JSON files / BSON)
+                "vals": SONify({k: [v] for k, v in vals.items()}),
+                "budget": SONify(budget),
+            },
+            "exp_key": self.exp_key,
+            "owner": None,
+            "version": 0,
+            "book_time": None,
+            "refresh_time": None,
+        })
+        deadline = (
+            None if self.eval_timeout is None
+            else time.monotonic() + self.eval_timeout
+        )
+        # proportional backoff per slot: poll at ~10% of the job's
+        # elapsed time, floored at the responsive base cadence and
+        # capped at 1 Hz -- short evaluations pay ~poll_interval of
+        # detection latency while long (TPU-training-scale) ones stop
+        # hammering the transport (total polls grow logarithmically,
+        # then linearly at 1/s)
+        published = time.monotonic()
+        while True:
+            out = self._fetch(tid)
+            if out is not None:
+                with self._lock:
+                    self.collected += 1
+                result = out.get("result") or {}
+                if (
+                    out.get("state") == JOB_STATE_DONE
+                    and result.get("status") == STATUS_OK
+                ):
+                    return float(result["loss"])
+                logger.warning(
+                    "queued asha job %s failed: %s", tid,
+                    (out.get("misc") or {}).get("error"),
+                )
+                return float("nan")
+            if deadline is not None and time.monotonic() > deadline:
+                logger.warning("queued asha job %s timed out", tid)
+                with self._lock:
+                    self.expired += 1
+                return float("nan")
+            self._maybe_reap()
+            elapsed = time.monotonic() - published
+            time.sleep(min(
+                max(self.poll_interval, 0.1 * elapsed),
+                max(self.poll_interval, 1.0),
+            ))
+
+
+def asha_mongo(
+    fn,
+    space,
+    max_budget,
+    mongo,
+    eta=3,
+    min_budget=1,
+    max_jobs=81,
+    inflight=8,
+    algo=None,
+    trials=None,
+    rstate=None,
+    checkpoint=None,
+    checkpoint_every=1,
+    exp_key=None,
+    poll_interval=0.05,
+    eval_timeout=None,
+    reserve_timeout=120.0,
+):
+    """Run ASHA with evaluations farmed to ``hyperopt-tpu-mongo-worker``
+    processes over the MongoDB protocol -- the same driver/worker split
+    as :func:`asha_filequeue` on the reference's own transport
+    (SURVEY.md SS3.4: CAS reservation via ``find_one_and_update``,
+    GridFS Domain shipping).
+
+    ``mongo`` is a connection string (``host:port/db``) or a live
+    ``MongoJobs``.  The budget-aware ``Domain`` is (re)published to
+    GridFS at entry; completed jobs are polled with ``find_one`` by
+    tid.  All other args as :func:`asha_filequeue`.
+    """
+    from ..base import JOB_STATE_ERROR
+    from .mongo import MongoJobs
+
+    _reject_queue_backed_trials(trials, "asha_mongo")
+    jobs = (
+        mongo if isinstance(mongo, MongoJobs)
+        else MongoJobs.new_from_connection_str(mongo)
+    )
+    try:
+        # each poll is a find_one({tid, state}); on a real mongod only
+        # _id is indexed by default, so every poll (and reserve's tid
+        # sort) would scan the collection.  Doubles without
+        # create_index just skip this.
+        jobs.coll.create_index([("tid", 1), ("state", 1)])
+    except AttributeError:
+        pass
+    # per-run attachment key (see asha_filequeue): a shared database's
+    # concurrent fmin keeps ITS Domain; docs name which one to load
+    attachment_key = f"FMinIter_Domain.asha-{uuid.uuid4().hex[:8]}"
+    domain = Domain(BudgetedDomainFn(fn), space)
+    jobs.set_attachment(attachment_key, pickle.dumps(domain))
+
+    def fetch(tid):
+        return jobs.coll.find_one({
+            "tid": tid,
+            "state": {"$in": [JOB_STATE_DONE, JOB_STATE_ERROR]},
+        })
+
+    transport = _TransportDriver(
+        publish=jobs.publish,
+        fetch=fetch,
+        reap=jobs.reap,
+        exp_key=exp_key,
+        poll_interval=poll_interval,
+        eval_timeout=eval_timeout,
+        reserve_timeout=reserve_timeout,
+        attachment_key=attachment_key,
+    )
+    try:
+        return _run_asha(
+            transport, fn, space, max_budget, eta, min_budget, max_jobs,
+            inflight, algo, trials, rstate, checkpoint, checkpoint_every,
+        )
+    finally:
+        _cleanup_attachment(
+            transport, lambda: jobs.delete_attachment(attachment_key)
+        )

@@ -135,8 +135,13 @@ class FileJobQueue:
         }
 
     # -- worker side -------------------------------------------------------
-    def reserve(self, owner, exp_key=None):
-        """Atomically claim one NEW job; None if queue empty/raced away."""
+    def reserve(self, owner, exp_key=None, exclude_tids=()):
+        """Atomically claim one NEW job; None if queue empty/raced away.
+
+        ``exclude_tids`` lets a worker skip jobs it has already proven
+        it cannot process (e.g. a dangling Domain attachment) -- the
+        sorted scan would otherwise hand the same poisoned job back on
+        every call and starve everything behind it."""
         names = sorted(n for n in os.listdir(self._p("new")) if n.endswith(".json"))
         for name in names:
             src = self._p("new", name)
@@ -146,6 +151,8 @@ class FileJobQueue:
             except (FileNotFoundError, json.JSONDecodeError):
                 continue
             if exp_key is not None and doc.get("exp_key") != exp_key:
+                continue
+            if doc.get("tid") in exclude_tids:
                 continue
             try:
                 os.rename(src, dst)  # the CAS: exactly one winner
@@ -157,6 +164,21 @@ class FileJobQueue:
             _write_atomic(dst, doc)
             return doc
         return None
+
+    def unreserve(self, doc):
+        """Return a reserved job to NEW (the reap transition) -- used by
+        a worker that cannot process it.  One atomic rename, content
+        untouched: the directory is the state (``refresh`` reads only
+        done/, ``reserve`` normalizes the doc when it claims).  The
+        mtime is refreshed first so the job does not reappear in new/
+        already looking reap-stale."""
+        name = f"{doc['tid']}.json"
+        path = self._p("running", name)
+        try:
+            os.utime(path)
+            os.rename(path, self._p("new", name))
+        except FileNotFoundError:
+            pass  # completed or reaped underneath us
 
     def complete(self, doc):
         """Publish a finished (DONE or ERROR) doc and release the claim."""
@@ -185,17 +207,25 @@ class FileJobQueue:
             if age < reserve_timeout:
                 continue
             try:
-                doc = _read_json(path)
+                _read_json(path)  # validity gate: don't recycle a
+                # mid-write/truncated claim into unreservable garbage
             except (FileNotFoundError, json.JSONDecodeError):
                 continue
-            doc["state"] = JOB_STATE_NEW
-            doc["owner"] = None
-            doc["book_time"] = None
             try:
+                # refresh the mtime BEFORE the rename: the recycled job
+                # must not reappear in new/ still carrying its expired
+                # timestamp, or the next reserver's claim would be
+                # instantly reap-stale (a second reaper could recycle
+                # the LIVE claim mid-reservation -- duplicated job).
+                # Then ONE atomic rename, no content rewrite: the
+                # directory IS the state (refresh reads only done/;
+                # reserve normalizes state/owner/book_time when it
+                # claims), and a rewrite here could race a reserver
+                # into a duplicate or recreate a completed job's file
+                os.utime(path)
                 os.rename(path, self._p("new", name))
             except FileNotFoundError:
                 continue
-            _write_atomic(self._p("new", name), doc)
             reaped += 1
             logger.warning("reaped stale job %s (age %.0fs)", name, age)
         return reaped

@@ -36,6 +36,7 @@ from ..base import (
     spec_from_misc,
 )
 from ..utils import coarse_utcnow
+from . import _common
 
 logger = logging.getLogger(__name__)
 
@@ -89,11 +90,18 @@ class MongoJobs:
         self.coll.insert_one(doc)
         return doc
 
-    def reserve(self, owner, exp_key=None):
-        """The CAS: atomically flip one NEW job to RUNNING with our owner."""
+    def reserve(self, owner, exp_key=None, exclude_tids=()):
+        """The CAS: atomically flip one NEW job to RUNNING with our owner.
+
+        ``exclude_tids`` lets a worker skip jobs it has already proven
+        it cannot process (e.g. a dangling Domain attachment) -- without
+        it, tid-ascending ordering would hand the same poisoned job back
+        on every iteration and starve everything behind it."""
         query = {"state": JOB_STATE_NEW}
         if exp_key is not None:
             query["exp_key"] = exp_key
+        if exclude_tids:
+            query["tid"] = {"$nin": list(exclude_tids)}
         return self.coll.find_one_and_update(
             query,
             {
@@ -117,6 +125,16 @@ class MongoJobs:
             update["result"] = SONify(result)
         self.coll.update_one({"_id": doc["_id"]}, {"$set": update})
 
+    def unreserve(self, doc):
+        """Return a reserved job to NEW (the reap transition) -- used by
+        a worker that cannot process it; the queue owns this state
+        machine so reap/give-back semantics cannot drift apart."""
+        self.coll.update_one(
+            {"_id": doc["_id"]},
+            {"$set": {"state": JOB_STATE_NEW, "owner": None,
+                      "book_time": None}},
+        )
+
     def reap(self, reserve_timeout):
         if reserve_timeout is None:
             return 0
@@ -130,17 +148,52 @@ class MongoJobs:
         return res.modified_count
 
     # attachments (GridFS) --------------------------------------------------
+    def _newest_file(self, key):
+        """Newest GridFS file for ``key``: real gridfs ``find_one`` has
+        NO ordering guarantee (natural order -- oldest first in
+        practice), so a replacement must be looked up via
+        ``get_last_version``; the in-memory double only has a
+        newest-first ``find_one``."""
+        try:
+            return self.gfs.get_last_version(key)
+        except AttributeError:  # double without get_last_version
+            return self.gfs.find_one({"filename": key})
+        except KeyError:  # the double's stand-in for NoFile
+            return None
+        except Exception as e:
+            # ONLY gridfs.NoFile means "missing"; a connection error
+            # must surface as itself, not masquerade as deleted data
+            # (callers put tids on cooldown / raise KeyError for None)
+            if type(e).__name__ == "NoFile":
+                return None
+            raise
+
     def set_attachment(self, key, blob):
-        old = self.gfs.find_one({"filename": key})
-        if old is not None:
-            self.gfs.delete(old._id)
-        self.gfs.put(blob, filename=key)
+        # put-then-sweep: the replacement window must never be EMPTY (a
+        # worker loading the Domain mid-republish would fail on a
+        # healthy queue); afterwards every file under the name EXCEPT
+        # the new one is deleted, so a crash between put and sweep
+        # leaves duplicates a later set_attachment cleans up, and
+        # readers (newest-first) converge immediately either way
+        new_id = self.gfs.put(blob, filename=key)
+        for obj in self.gfs.find({"filename": key}):
+            # sweep only files OLDER than ours (_ids are time-ordered):
+            # two concurrent writers must not delete each other's new
+            # file and leave the key empty -- the newest always survives
+            if obj._id != new_id and obj._id < new_id:
+                self.gfs.delete(obj._id)
 
     def get_attachment(self, key):
-        obj = self.gfs.find_one({"filename": key})
+        obj = self._newest_file(key)
         if obj is None:
             raise KeyError(key)
         return obj.read()
+
+    def delete_attachment(self, key):
+        """Remove every GridFS file under ``key`` (run-scoped Domain
+        cleanup); missing keys are a no-op."""
+        for obj in self.gfs.find({"filename": key}):
+            self.gfs.delete(obj._id)
 
     def has_attachment(self, key):
         return self.gfs.find_one({"filename": key}) is not None
@@ -203,9 +256,17 @@ class MongoTrials(Trials):
         super().refresh()
 
     def new_trial_ids(self, n):
-        # ids must be unique across every driver using the collection
-        last = self.handle.coll.find_one(sort=[("tid", -1)])
-        base = (last["tid"] + 1) if last else 0
+        # ids must be unique across every driver using the collection.
+        # Max over NUMERIC tids only, server-side: asha_mongo's
+        # transport jobs carry string tids ("<runtag>-<n>"), which BSON
+        # sorts above every number -- an unfiltered sort would hand
+        # back a string and `+ 1` would crash on a legitimately shared
+        # db.  The $type filter keeps this one indexed find_one instead
+        # of an O(collection) client-side scan.
+        last = self.handle.coll.find_one(
+            {"tid": {"$type": "number"}}, sort=[("tid", -1)]
+        )
+        base = (int(last["tid"]) + 1) if last else 0
         local_floor = max(self._ids, default=-1) + 1
         start = max(base, local_floor)
         rval = list(range(start, start + n))
@@ -221,30 +282,81 @@ class MongoTrials(Trials):
 class MongoWorker:
     """Evaluate reserved jobs (the ``hyperopt-mongo-worker`` role)."""
 
-    def __init__(self, jobs, exp_key=None, workdir=None):
+    def __init__(self, jobs, exp_key=None, workdir=None, heartbeat=None):
         self.jobs = jobs
         self.exp_key = exp_key
         self.workdir = workdir
-        self._domain = None
+        self.heartbeat = heartbeat
+        import collections
+
+        # attachment key -> (gridfs _id, Domain); identity-validated
+        # LRU (shared contract with the filequeue worker, _common)
+        self._domains = collections.OrderedDict()
+        # poisoned-job cooldown (shared TTLSet contract): a tid whose
+        # Domain failed to load is excluded from this worker's
+        # reservations for the TTL, then retried -- neither a livelock
+        # on the lowest tid nor a permanent exclusion on a transient
+        # failure
+        self._bad_tids = _common.TTLSet()
+
+    def _load_domain(self, doc):
+        # the doc's cmd names its Domain attachment (the reference's
+        # contract), so drivers with DIFFERENT objectives can share one
+        # database -- asha_mongo publishes under a per-run key and a
+        # concurrent fmin's jobs keep resolving their own.  Cache keyed
+        # by the GridFS file's _id: a re-publish under the same key
+        # (set_attachment puts a NEW file) invalidates, the same
+        # contract as the filequeue worker's inode check.
+        key = _common.blob_key_from_doc(doc)
+        obj = self.jobs._newest_file(key)
+        if obj is None:
+            raise KeyError(key)
+        return _common.lru_get(
+            self._domains, key, obj._id, lambda: pickle.loads(obj.read())
+        )
 
     def run_one(self, owner):
-        doc = self.jobs.reserve(owner, exp_key=self.exp_key)
+        doc = self.jobs.reserve(
+            owner, exp_key=self.exp_key,
+            exclude_tids=self._bad_tids.current(),
+        )
         if doc is None:
             return False
-        if self._domain is None:
-            self._domain = pickle.loads(
-                self.jobs.get_attachment("FMinIter_Domain")
-            )
+        try:
+            domain = self._load_domain(doc)
+        except Exception as e:
+            # give the job back and surface the error: a worker that
+            # cannot load the Domain (version skew, missing attachment)
+            # must not mark jobs failed -- healthy workers can run
+            # them.  The tid joins this worker's cooldown set so its
+            # next reserve moves PAST the poisoned job instead of
+            # re-reserving it forever
+            self._bad_tids.add(doc.get("tid"))
+            self.jobs.unreserve(doc)
+            e.failed_tid = doc.get("tid")
+            raise
         trials = Trials()
         trials._dynamic_trials.append(doc)
         ctrl = Ctrl(trials, current_trial=doc)
-        try:
-            result = self._domain.evaluate(spec_from_misc(doc["misc"]), ctrl)
-        except Exception as e:
-            logger.error("job %s failed: %s", doc.get("tid"), e)
-            self.jobs.complete(doc, error=(str(type(e)), str(e)))
-        else:
-            self.jobs.complete(doc, result=result)
+
+        def _beat():
+            # refresh book_time so reapers (driver-side asha_mongo,
+            # other workers' reap calls) never recycle a LIVE job whose
+            # evaluation outlives reserve_timeout -- the mtime-heartbeat
+            # contract of the filequeue worker, via the shared scaffold
+            self.jobs.coll.update_one(
+                {"_id": doc["_id"]},
+                {"$set": {"book_time": coarse_utcnow()}},
+            )
+
+        with _common.claim_heartbeat(_beat, self.heartbeat):
+            try:
+                result = domain.evaluate(spec_from_misc(doc["misc"]), ctrl)
+            except Exception as e:
+                logger.error("job %s failed: %s", doc.get("tid"), e)
+                self.jobs.complete(doc, error=(str(type(e)), str(e)))
+            else:
+                self.jobs.complete(doc, result=result)
         return True
 
 
@@ -265,12 +377,29 @@ def main_worker(argv=None):
     options = parser.parse_args(argv)
 
     jobs = MongoJobs.new_from_connection_str(options.mongo)
-    worker = MongoWorker(jobs, exp_key=options.exp_key, workdir=options.workdir)
+    worker = MongoWorker(
+        jobs, exp_key=options.exp_key, workdir=options.workdir,
+        heartbeat=(
+            options.reserve_timeout / 3.0
+            if options.reserve_timeout else None
+        ),
+    )
     owner = f"{socket.gethostname()}:{os.getpid()}"
     n = 0
     while options.max_jobs is None or n < options.max_jobs:
         jobs.reap(options.reserve_timeout)
-        if worker.run_one(owner):
+        try:
+            ran = worker.run_one(owner)
+        except Exception as e:
+            if getattr(e, "failed_tid", None) is None:
+                raise  # a real bug (reserve failure, auth): die loudly
+            # a job naming an unloadable Domain: run_one gave it back
+            # and put the tid on cooldown; cool off instead of
+            # crash-looping the process on the same lowest-tid doc
+            logger.error("job %s returned to queue: %s", e.failed_tid, e)
+            time.sleep(options.poll_interval)
+            continue
+        if ran:
             n += 1
         else:
             time.sleep(options.poll_interval)

@@ -15,6 +15,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import collections
 import logging
 import os
 import pickle
@@ -22,8 +23,15 @@ import sys
 import time
 import traceback
 
-from ..base import Ctrl, JOB_STATE_DONE, JOB_STATE_ERROR, SONify, spec_from_misc
+from ..base import (
+    Ctrl,
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    SONify,
+    spec_from_misc,
+)
 from ..utils import working_dir
+from . import _common
 from .filequeue import FileJobQueue, FileTrials, worker_owner
 
 logger = logging.getLogger(__name__)
@@ -35,12 +43,16 @@ class WorkerExit(Exception):
     pass
 
 
-def _load_domain(queue, cache={}):
-    blob_key = "FMinIter_Domain"
+def _load_domain(queue, blob_key="FMinIter_Domain",
+                 cache=collections.OrderedDict()):
+    """``blob_key`` comes from the job doc's cmd (the reference's
+    contract): drivers with different objectives (an fmin and an
+    asha_filequeue, say) share one queue directory, each doc naming the
+    Domain to evaluate with."""
     if blob_key not in queue.attachments:
         raise WorkerExit(
-            f"no pickled Domain at {queue.root}/attachments -- is fmin running "
-            "against this queue with an async FileTrials?"
+            f"no pickled Domain {blob_key!r} at {queue.root}/attachments -- "
+            "is a driver running against this queue?"
         )
     # cache keyed by the attachment file's identity, not forever: a new
     # driver reusing the directory (e.g. asha_filequeue after an fmin
@@ -55,80 +67,74 @@ def _load_domain(queue, cache={}):
     except FileNotFoundError:  # raced a re-publish; next loop retries
         raise WorkerExit(f"domain attachment vanished under {queue.root}")
     ident = (st.st_ino, st.st_mtime_ns, st.st_size)
-    hit = cache.get(queue.root)
-    if hit is not None and hit[0] == ident:
-        return hit[1]
-    domain = pickle.loads(queue.attachments[blob_key])
-    cache[queue.root] = (ident, domain)
-    return domain
+    return _common.lru_get(
+        cache, (queue.root, blob_key), ident,
+        lambda: pickle.loads(queue.attachments[blob_key]),
+    )
 
 
-def _heartbeat(path, interval, stop):
-    """Refresh a running-file's mtime until ``stop`` is set: the claim
-    stays visibly alive through evaluations LONGER than the reserve
-    timeout, so reapers only recycle jobs whose worker actually died
-    (an untouched claim means a crashed/wedged process, not a long
-    objective)."""
-    while not stop.wait(interval):
-        try:
-            os.utime(path)
-        except FileNotFoundError:  # completed/reaped underneath us
-            return
-        except OSError as e:  # transient mount blip (ESTALE/EIO class):
-            # keep beating -- permanently exiting would freeze the
-            # mtime and get a LIVE job reaped and duplicated
-            logger.warning("heartbeat on %s failed transiently: %s", path, e)
+def _beat_running_file(path):
+    """One heartbeat tick: refresh the running-file's mtime; False
+    (stop) once the claim is gone (completed/reaped underneath us).
+    Transient mount blips (ESTALE/EIO class) raise and are retried by
+    the shared scaffold."""
+    try:
+        os.utime(path)
+        return True
+    except FileNotFoundError:
+        return False
 
 
 def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
-            heartbeat=None):
+            heartbeat=None, exclude_tids=()):
     """Reserve and evaluate a single job; False if the queue was empty.
 
     ``heartbeat`` (seconds) keeps the reserved job's claim fresh during
     evaluation -- the worker CLI passes ``reserve_timeout / 3``.  None
-    disables it (unit-test mode / instant objectives).
+    disables it (unit-test mode / instant objectives).  ``exclude_tids``
+    skips jobs this worker already failed to load a Domain for (the CLI
+    maintains the cooldown set).
     """
-    import threading
-
-    doc = queue.reserve(owner, exp_key=exp_key)
+    doc = queue.reserve(owner, exp_key=exp_key, exclude_tids=exclude_tids)
     if doc is None:
         return False
-    domain = _load_domain(queue)
+    blob_key = _common.blob_key_from_doc(doc)
+    try:
+        domain = _load_domain(queue, blob_key)
+    except Exception as e:
+        # give the job back (the reap transition) and surface the
+        # error: a worker that cannot load the Domain must neither
+        # strand the reserved job in running/ nor mark it failed --
+        # another worker (or this one, once the attachment appears)
+        # can still evaluate it.  The tid rides the exception so the
+        # CLI loop can cool the job down instead of re-reserving it
+        queue.unreserve(doc)  # the queue owns the RUNNING->NEW machine
+        e.failed_tid = doc.get("tid")
+        raise
     if trials is None:
         trials = FileTrials(queue.root, exp_key=exp_key, refresh=False)
     ctrl = Ctrl(trials, current_trial=doc)
     # Ctrl.checkpoint asserts membership of the live store
     trials._dynamic_trials.append(doc)
     spec = spec_from_misc(doc["misc"])
-    stop = threading.Event()
-    beat = None
-    if heartbeat is not None:
-        running_path = os.path.join(
-            queue.root, "running", f"{doc['tid']}.json"
-        )
-        beat = threading.Thread(
-            target=_heartbeat, args=(running_path, float(heartbeat), stop),
-            daemon=True,
-        )
-        beat.start()
-    try:
-        if workdir:
-            with working_dir(os.path.join(workdir, str(doc["tid"]))):
+    running_path = os.path.join(queue.root, "running", f"{doc['tid']}.json")
+    with _common.claim_heartbeat(
+        lambda: _beat_running_file(running_path), heartbeat
+    ):
+        try:
+            if workdir:
+                with working_dir(os.path.join(workdir, str(doc["tid"]))):
+                    result = domain.evaluate(spec, ctrl)
+            else:
                 result = domain.evaluate(spec, ctrl)
+        except Exception as e:
+            logger.error("job %s failed: %s", doc["tid"], e)
+            doc["state"] = JOB_STATE_ERROR
+            doc["misc"]["error"] = (str(type(e)), str(e))
+            doc["misc"]["traceback"] = traceback.format_exc()
         else:
-            result = domain.evaluate(spec, ctrl)
-    except Exception as e:
-        logger.error("job %s failed: %s", doc["tid"], e)
-        doc["state"] = JOB_STATE_ERROR
-        doc["misc"]["error"] = (str(type(e)), str(e))
-        doc["misc"]["traceback"] = traceback.format_exc()
-    else:
-        doc["state"] = JOB_STATE_DONE
-        doc["result"] = SONify(result)
-    finally:
-        stop.set()
-        if beat is not None:
-            beat.join(timeout=5)
+            doc["state"] = JOB_STATE_DONE
+            doc["result"] = SONify(result)
     queue.complete(doc)
     return True
 
@@ -138,6 +144,11 @@ def main_worker_helper(options):
     owner = worker_owner()
     n_done = 0
     idle_since = time.time()
+    # jobs whose Domain failed to load are skipped on cooldown so one
+    # dangling-attachment job cannot monopolize the sorted reserve scan
+    # (other jobs and other drivers keep being served; the TTL retries
+    # eventually in case the failure was transient)
+    bad_tids = _common.TTLSet()
     trials = FileTrials(
         options.dir, exp_key=options.exp_key, refresh=False,
         reserve_timeout=options.reserve_timeout,
@@ -153,11 +164,23 @@ def main_worker_helper(options):
                     options.reserve_timeout / 3.0
                     if options.reserve_timeout else None
                 ),
+                exclude_tids=bad_tids.current(),
             )
-        except WorkerExit as e:
-            logger.info("worker exit: %s", e)
-            if time.time() - idle_since > (options.last_job_timeout or 30.0):
-                return 1
+        except Exception as e:
+            # ANY Domain-load failure carries the job's tid (run_one
+            # gave the job back) -- WorkerExit for a missing
+            # attachment, but also UnpicklingError/ImportError from
+            # version skew: all cool the tid down instead of crashing
+            # the worker into a supervisor restart loop on the same
+            # lowest-tid job.  A misconfigured queue (jobs but never a
+            # Domain) thus drains into the cooldown set, run_one starts
+            # returning False, and the normal idle path applies the
+            # last_job_timeout give-up
+            tid = getattr(e, "failed_tid", None)
+            if tid is None:
+                raise  # a real bug (not a per-job load failure): die loudly
+            logger.error("job %s returned to queue: %s", tid, e)
+            bad_tids.add(tid)
             time.sleep(options.poll_interval)
             continue
         if ran:

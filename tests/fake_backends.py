@@ -88,6 +88,20 @@ def _match(doc, query):
                 elif op == "$in":
                     if not present or val not in operand:
                         return False
+                elif op == "$nin":
+                    if present and val in operand:
+                        return False
+                elif op == "$type":
+                    # the slice the backends use: numeric-vs-string tids
+                    types = {
+                        "number": (int, float),
+                        "int": int,
+                        "string": str,
+                    }[operand]
+                    if not present or isinstance(val, bool) or (
+                        not isinstance(val, types)
+                    ):
+                        return False
                 elif op in ("$lt", "$gt", "$lte", "$gte"):
                     # mongo comparison semantics: a missing/None field
                     # never satisfies a range operator
@@ -192,14 +206,22 @@ class Collection:
             out.sort(key=lambda d: _get_path(d, key)[0], reverse=direction < 0)
         return out
 
-    def find(self, filter=None, sort=None):
+    def find(self, filter=None, projection=None, sort=None):
+        # projection sits in pymongo's positional slot between filter
+        # and sort -- modeling it (include-style only) keeps callers
+        # that pass find(filter, {"field": 1}) from silently binding a
+        # projection dict to sort
         with self._lock:
-            return [
+            docs = [
                 copy.deepcopy(d)
                 for d in self._sorted(
                     (d for d in self._docs if _match(d, filter)), sort
                 )
             ]
+        if projection:
+            keep = {k for k, v in projection.items() if v} | {"_id"}
+            docs = [{k: d[k] for k in keep if k in d} for d in docs]
+        return docs
 
     def find_one(self, filter=None, sort=None):
         res = self.find(filter, sort=sort)
@@ -279,6 +301,22 @@ class GridFS:
                 if fn == filename:
                     return _GridOut(file_id, data)
         return None
+
+    def find(self, query):
+        filename = query["filename"]
+        with self._lock:
+            return [
+                _GridOut(file_id, data)
+                for file_id in sorted(self._files)
+                for fn, data in [self._files[file_id]]
+                if fn == filename
+            ]
+
+    def get_last_version(self, filename):
+        obj = self.find_one({"filename": filename})
+        if obj is None:
+            raise KeyError(filename)  # stands in for gridfs.NoFile
+        return obj
 
     def delete(self, file_id):
         with self._lock:
@@ -435,15 +473,19 @@ class FileCollection:
             return DeleteResult(n)
 
     # -- reads --------------------------------------------------------------
-    def find(self, filter=None, sort=None):
+    def find(self, filter=None, projection=None, sort=None):
         with _FileLock(self._path):
             docs = self._load()["docs"]
-        return [
+        out = [
             copy.deepcopy(d)
             for d in Collection._sorted(
                 (d for d in docs if _match(d, filter)), sort
             )
         ]
+        if projection:
+            keep = {k for k, v in projection.items() if v} | {"_id"}
+            out = [{k: d[k] for k in keep if k in d} for d in out]
+        return out
 
     def find_one(self, filter=None, sort=None):
         res = self.find(filter, sort=sort)
@@ -510,6 +552,23 @@ class FileGridFS:
             if fn == filename:
                 return _GridOut(file_id, data)
         return None
+
+    def find(self, query):
+        filename = query["filename"]
+        with _FileLock(self._state):
+            files = self._load()["files"]
+        return [
+            _GridOut(file_id, data)
+            for file_id in sorted(files)
+            for fn, data in [files[file_id]]
+            if fn == filename
+        ]
+
+    def get_last_version(self, filename):
+        obj = self.find_one({"filename": filename})
+        if obj is None:
+            raise KeyError(filename)  # stands in for gridfs.NoFile
+        return obj
 
     def delete(self, file_id):
         with _FileLock(self._state):

@@ -222,6 +222,59 @@ def _slow_objective(x):
     return x
 
 
+def test_worker_gives_back_job_when_domain_missing(tmp_path):
+    """A worker that cannot load the doc's named Domain must give the
+    reserved job BACK to new/ (not strand it in running/ or mark it
+    failed) and surface the error -- another worker can still run it."""
+    from hyperopt_tpu.distributed.worker import WorkerExit
+
+    dirpath = str(tmp_path / "q")
+    q = FileJobQueue(dirpath)
+    doc = make_doc(0)
+    doc["misc"]["cmd"] = ("domain_attachment", "FMinIter_Domain.asha-dead")
+    q.publish(doc)
+    with pytest.raises(WorkerExit, match="asha-dead") as exc:
+        run_one(q, worker_owner())
+    assert exc.value.failed_tid == 0  # the CLI cools this tid down
+    assert q.counts() == {"new": 1, "running": 0, "done": 0}
+    assert not q.done_docs()  # and it was NOT marked failed
+    # a worker excluding the poisoned tid skips it (no livelock on the
+    # sorted scan) ...
+    assert not run_one(q, worker_owner(), exclude_tids=[0])
+    # ... while an unexcluded reserver can still claim it, tid intact
+    back = q.reserve("w2")
+    assert back is not None and back["tid"] == 0
+
+
+def test_worker_resolves_domain_per_doc_cmd(tmp_path):
+    """Two drivers sharing one queue directory: each doc's cmd names
+    its own Domain attachment, so a worker evaluates every job with
+    the right objective (no clobbering)."""
+    from hyperopt_tpu.base import Domain
+
+    dirpath = str(tmp_path / "q")
+    q = FileJobQueue(dirpath)
+    space = hp.uniform("x", 0, 1)
+    q.attachments["FMinIter_Domain"] = pickle.dumps(
+        Domain(_objective_a, space)
+    )
+    q.attachments["FMinIter_Domain.asha-x1"] = pickle.dumps(
+        Domain(_objective_b, space)
+    )
+    for tid, key in ((0, "FMinIter_Domain"), (1, "FMinIter_Domain.asha-x1")):
+        doc = make_doc(0)
+        doc["tid"] = doc["misc"]["tid"] = tid
+        doc["misc"]["cmd"] = ("domain_attachment", key)
+        doc["misc"]["idxs"] = {"x": [tid]}
+        doc["misc"]["vals"] = {"x": [0.5]}
+        q.publish(doc)
+    assert run_one(q, worker_owner())
+    assert run_one(q, worker_owner())
+    done = q.done_docs()
+    assert 10.0 <= done[0]["result"]["loss"] < 11.0  # _objective_a
+    assert 20.0 <= done[1]["result"]["loss"] < 21.0  # _objective_b
+
+
 def test_worker_heartbeat_defeats_reaping_of_live_jobs(tmp_path):
     """An evaluation LONGER than the reserve timeout keeps its claim:
     the heartbeat refreshes the running-file mtime, so reap() recycles

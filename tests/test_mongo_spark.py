@@ -25,6 +25,7 @@ from hyperopt_tpu.base import (
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
     Domain,
+    Trials,
 )
 from hyperopt_tpu.models.synthetic import DOMAINS
 
@@ -244,6 +245,200 @@ def test_mongo_worker_marks_failed_jobs_error(fake_mongo):
     t = trials.trials[0]
     assert t["state"] == JOB_STATE_ERROR
     assert "mongo kaboom" in t["misc"]["error"][1]
+
+
+def test_asha_mongo_end_to_end(fake_mongo):
+    """The async scheduler over the Mongo worker backend: ASHA
+    promotion decisions on the driver, budget-aware evaluations farmed
+    through the jobs collection's CAS to MongoWorker threads -- the
+    Mongo twin of asha_filequeue (shared _TransportDriver)."""
+    from hyperopt_tpu.distributed.asha_queue import asha_mongo
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    conn = "localhost:27017/db_asha"
+    stop = threading.Event()
+    workers = _worker_pool(conn, 2, stop)
+    try:
+        out = asha_mongo(
+            budgeted_quadratic_fn, budgeted_quadratic_space(),
+            max_budget=9, mongo=conn, eta=3, max_jobs=30, inflight=4,
+            rstate=np.random.default_rng(0), eval_timeout=120.0,
+            poll_interval=0.02,
+        )
+    finally:
+        stop.set()
+        for th in workers:
+            th.join(timeout=10)
+    trials = out["trials"]
+    assert len(trials) == 30
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert set(budgets) <= {1, 3, 9}
+    assert budgets.count(1) > budgets.count(9) > 0
+    x_at = lambda b: {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == b
+    }
+    assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+    assert np.isfinite(out["best_loss"])
+    # transport record: every job completed by a WORKER thread's owner,
+    # with its rung budget on the doc
+    jobs = MongoJobs.new_from_connection_str(conn)
+    done = list(jobs.coll.find({"state": JOB_STATE_DONE}))
+    assert len(done) == 30
+    assert {d["owner"] for d in done} <= {"host0:1000", "host1:1001"}
+    assert {d["misc"]["budget"] for d in done} <= {1, 3, 9}
+
+
+def test_asha_drivers_reject_any_queue_backed_trials(fake_mongo, tmp_path):
+    """Cross-backend foot-gun: each driver must refuse EVERY
+    queue-backed store (FileTrials to asha_mongo and vice versa), not
+    just its own backend's -- any store whose insert publishes or
+    evaluates docs corrupts the scheduler bookkeeping."""
+    from hyperopt_tpu.distributed import FileTrials, ThreadTrials
+    from hyperopt_tpu.distributed.asha_queue import asha_filequeue, asha_mongo
+    from hyperopt_tpu.distributed.mongo import MongoTrials
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    file_store = FileTrials(str(tmp_path / "other"), reserve_timeout=None)
+    mongo_store = MongoTrials("mongo://localhost:27017/db_guard/jobs")
+    for store in (file_store, mongo_store, ThreadTrials(parallelism=2)):
+        with pytest.raises(ValueError, match="in-memory Trials"):
+            asha_mongo(
+                budgeted_quadratic_fn, budgeted_quadratic_space(),
+                max_budget=4, mongo="localhost:27017/db_guard2",
+                trials=store,
+            )
+        with pytest.raises(ValueError, match="in-memory Trials"):
+            asha_filequeue(
+                budgeted_quadratic_fn, budgeted_quadratic_space(),
+                max_budget=4, dirpath=str(tmp_path / "q"), trials=store,
+            )
+
+
+def _mongo_objective_a(x):
+    return 10.0 + x
+
+
+def _mongo_objective_b(x):
+    return 20.0 + x
+
+
+def test_mongo_worker_gives_back_job_when_domain_missing(fake_mongo):
+    """A MongoWorker that cannot load the doc's named Domain returns
+    the job to NEW and raises (it must not drain the queue marking
+    healthy jobs ERROR)."""
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_giveback")
+    doc = _make_doc(0)
+    doc["misc"]["cmd"] = ("domain_attachment", "FMinIter_Domain.asha-dead")
+    jobs.publish(doc)
+    with pytest.raises(KeyError, match="asha-dead"):
+        MongoWorker(jobs).run_one("w:1")
+    stored = jobs.coll.find_one({"tid": 0})
+    assert stored["state"] == JOB_STATE_NEW and stored["owner"] is None
+    assert jobs.reserve("w:2") is not None  # reservable again
+
+
+def test_mongo_worker_resolves_domain_per_doc_cmd(fake_mongo):
+    """Two drivers sharing one database: each doc's cmd names its own
+    GridFS Domain, so a worker evaluates every job with the right
+    objective -- asha_mongo's per-run key never clobbers a concurrent
+    fmin's Domain."""
+    import pickle
+
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_percmd")
+    space = hp.uniform("x", 0, 1)
+    jobs.set_attachment(
+        "FMinIter_Domain", pickle.dumps(Domain(_mongo_objective_a, space))
+    )
+    jobs.set_attachment(
+        "FMinIter_Domain.asha-x1",
+        pickle.dumps(Domain(_mongo_objective_b, space)),
+    )
+    for tid, key in ((0, "FMinIter_Domain"), (1, "FMinIter_Domain.asha-x1")):
+        doc = _make_doc(tid)
+        doc["misc"]["cmd"] = ("domain_attachment", key)
+        doc["misc"]["idxs"] = {"x": [tid]}
+        doc["misc"]["vals"] = {"x": [0.5]}
+        jobs.publish(doc)
+    worker = MongoWorker(jobs)
+    assert worker.run_one("w:1") and worker.run_one("w:1")
+    by_tid = {
+        d["tid"]: d["result"]["loss"]
+        for d in jobs.coll.find({"state": JOB_STATE_DONE})
+    }
+    assert 10.0 <= by_tid[0] < 11.0  # fmin's Domain, untouched
+    assert 20.0 <= by_tid[1] < 21.0  # asha's per-run Domain
+
+
+def test_mongo_worker_heartbeat_defeats_reaping_of_live_jobs(fake_mongo):
+    """An evaluation longer than the reserve timeout keeps its claim:
+    the worker heartbeat refreshes book_time, so reap() (including the
+    asha_mongo driver's) recycles only genuinely dead workers' jobs."""
+    import pickle
+
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_beat")
+    space = hp.uniform("x", 0, 1)
+    jobs.set_attachment(
+        "FMinIter_Domain", pickle.dumps(Domain(_mongo_slow_objective, space))
+    )
+    doc = _make_doc(0)
+    doc["misc"]["idxs"] = {"x": [0]}
+    doc["misc"]["vals"] = {"x": [0.5]}
+    jobs.publish(doc)
+    worker = MongoWorker(jobs, heartbeat=0.05)
+    th = threading.Thread(target=worker.run_one, args=("w:1",))
+    th.start()
+    time.sleep(0.35)  # well past a 0.15s reserve timeout, eval running
+    assert jobs.reap(reserve_timeout=0.15) == 0  # claim stays alive
+    th.join(timeout=10)
+    assert jobs.coll.find_one({"tid": 0})["state"] == JOB_STATE_DONE
+
+
+def _mongo_slow_objective(x):
+    time.sleep(0.6)
+    return x
+
+
+def test_mongo_worker_reloads_republished_domain(fake_mongo):
+    """A long-lived MongoWorker must pick up a RE-published Domain (a
+    new driver reusing the database) -- the cache is keyed by the
+    GridFS file's _id, which set_attachment rotates."""
+    import pickle
+
+    from hyperopt_tpu.distributed.mongo import MongoJobs, MongoWorker
+
+    conn = "localhost:27017/db_redomain"
+    jobs = MongoJobs.new_from_connection_str(conn)
+    space = hp.uniform("x", 0, 1)
+    domain_a = Domain(_mongo_objective_a, space)
+    jobs.set_attachment("FMinIter_Domain", pickle.dumps(domain_a))
+    trials = Trials()
+    docs = rand.suggest(trials.new_trial_ids(1), domain_a, trials, seed=0)
+    jobs.publish(docs[0])
+    worker = MongoWorker(jobs)
+    assert worker.run_one("w:1")
+    domain_b = Domain(_mongo_objective_b, space)
+    jobs.set_attachment("FMinIter_Domain", pickle.dumps(domain_b))
+    docs = rand.suggest(trials.new_trial_ids(1), domain_b, trials, seed=1)
+    jobs.publish(docs[0])
+    assert worker.run_one("w:1")  # SAME worker instance, new domain
+    losses = sorted(
+        d["result"]["loss"]
+        for d in jobs.coll.find({"state": JOB_STATE_DONE})
+    )
+    assert 10.0 <= losses[0] < 11.0  # first domain
+    assert 20.0 <= losses[1] < 21.0  # re-published domain, same cache
 
 
 def test_mongo_refresh_reaps_with_reserve_timeout(fake_mongo):
