@@ -59,6 +59,56 @@ def _budgets_integral(max_budget, min_budget):
     )
 
 
+def _algo_identity(algo):
+    """Checkpoint-guard identity of a suggest algo: resuming a run
+    under a different algorithm silently changes the experiment.
+    ``functools.partial`` unwraps (fully -- wrappers stack) to its base
+    fn; tuned kwargs are not fingerprintable in general."""
+    a = algo
+    while isinstance(a, functools.partial):
+        a = a.func
+    return (
+        f"{getattr(a, '__module__', '?')}."
+        f"{getattr(a, '__qualname__', type(a).__name__)}"
+    )
+
+
+def _rstate_fingerprint(rstate):
+    """Checkpoint-guard identity of a generator's CURRENT position:
+    stale snapshot files from a run with a different seed (or a
+    different point in a shared stream) must be refused, not silently
+    resurrected -- while a re-run with the identical seed may resume,
+    because it would recompute the identical result.
+
+    The state is serialized canonically (sorted-key json, arrays via
+    tolist) -- ``repr`` would truncate array-state generators
+    (MT19937's 624-word state) under small ``np.printoptions``
+    thresholds, refusing valid same-seed resumes and colliding
+    genuinely different states."""
+    import hashlib
+    import json
+
+    def norm(v):
+        if isinstance(v, dict):
+            return {k: norm(v[k]) for k in sorted(v)}
+        if isinstance(v, np.ndarray):
+            return v.tolist()
+        if isinstance(v, np.generic):
+            return v.item()
+        return v
+
+    blob = json.dumps(norm(rstate.bit_generator.state), sort_keys=True)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _rung_budget(min_budget, eta, r, integral):
+    """Rung ``r``'s budget under the shared integral rule -- ONE
+    definition for every host driver (sha/hyperband/asha), so their
+    budget materialization cannot drift."""
+    b = float(min_budget) * eta**r
+    return int(round(b)) if integral else b
+
+
 def _vals_of(doc):
     """Index-form config of a suggested trial doc (single-valued labels
     only -- inactive conditional branches have empty vals lists)."""
@@ -89,6 +139,8 @@ def successive_halving(
     algo=None,
     trials=None,
     rstate=None,
+    checkpoint=None,
+    checkpoint_every=1,
 ):
     """One successive-halving bracket over a budget-aware objective.
 
@@ -107,6 +159,16 @@ def successive_halving(
       trials: optional ``Trials`` store; every evaluation is recorded as
         a completed trial whose ``result["budget"]`` is its rung budget.
       rstate: ``np.random.Generator`` (reproducibility contract).
+      checkpoint: optional path for durable kill/resume (the driver is
+        a serial loop over (rung, member), so the snapshot -- trials
+        store, rung bookkeeping, survivor tids, via the atomic-rename
+        pickle -- is written every ``checkpoint_every`` evaluations,
+        plus at every rung boundary, and resuming reproduces the
+        uninterrupted run bitwise).  A snapshot from a different
+        ladder/space/algo/seed is refused; the restored trials REPLACE
+        the ``trials=`` argument.  Raise ``checkpoint_every`` when
+        pickling a large shared trials store every evaluation measures
+        as the bottleneck (cheap objectives under :func:`hyperband`).
 
     Returns ``{"best": config, "best_loss": loss, "rungs": [...]}``.
     """
@@ -124,31 +186,92 @@ def successive_halving(
     if n_configs is None:
         n_configs = eta ** (n_rungs - 1)
     domain = Domain(fn, space, pass_expr_memo_ctrl=False)
+    integral = _budgets_integral(max_budget, min_budget)
 
+    # generator position BEFORE the seed draw: the guard must identify
+    # the run (a stale snapshot from a different seed is refused; the
+    # identical seed would recompute the identical result, so resuming
+    # it is sound -- which also requires fingerprinting fn and algo:
+    # an edited objective resumed at the same seed would otherwise
+    # silently return the OLD objective's answer)
+    rs_fp = _rstate_fingerprint(rstate)
+    snap = None
+    ck_guard = None
+    if checkpoint is not None:
+        if int(checkpoint_every) < 1:
+            raise ValueError(
+                f"checkpoint_every must be >= 1, got {checkpoint_every!r}"
+            )
+        ck_guard = (
+            "sha", n_rungs, float(max_budget), float(min_budget),
+            float(eta), int(n_configs), _algo_identity(algo),
+            _algo_identity(fn), _space_fingerprint(domain.expr), rs_fp,
+        )
+        if os.path.exists(checkpoint):
+            # refuse BEFORE the seed draw: a refused resume must not
+            # mutate the caller's generator as a side effect
+            from .utils.checkpoint import load_guarded
+
+            snap = load_guarded(checkpoint, ck_guard)
+    # ALWAYS drawn, resuming or not: a caller sharing one rstate across
+    # brackets (hyperband) must see the same stream either way
     seed = int(rstate.integers(0, 2**31 - 1))
-    ids = trials.new_trial_ids(n_configs)
-    docs = algo(ids, domain, trials, seed)
-    trials.insert_trial_docs(docs)
-    trials.refresh()
-    # mutate the STORED docs (insert may copy) so results land in the
-    # trials store, not in dead suggestion copies
-    tids = {d["tid"] for d in docs}
-    live = [t for t in trials._dynamic_trials if t["tid"] in tids]
 
     def config_of(doc):
         return space_eval(space, _vals_of(doc))
 
+    def _docs_by_tid(wanted):
+        m = {t["tid"]: t for t in trials._dynamic_trials}
+        return [m[t] for t in wanted]
+
+    if snap is None:
+        ids = trials.new_trial_ids(n_configs)
+        docs = algo(ids, domain, trials, seed)
+        trials.insert_trial_docs(docs)
+        trials.refresh()
+        # mutate the STORED docs (insert may copy) so results land in
+        # the trials store, not in dead suggestion copies
+        tids = {d["tid"] for d in docs}
+        live = [t for t in trials._dynamic_trials if t["tid"] in tids]
+        r0, j0, rungs, new_ids, scored_tids = 0, 0, [], None, []
+    else:
+        trials = snap["trials"]
+        live = _docs_by_tid(snap["live_tids"])
+        r0, j0 = snap["r"], snap["j"]
+        rungs = snap["rungs"]
+        new_ids = snap["new_ids"]
+        scored_tids = snap["scored"]  # [(loss, tid)] of the partial rung
+
+    def _write(r, j, scored, live, rungs, new_ids):
+        from .utils.checkpoint import save_trials
+
+        save_trials({
+            "guard": ck_guard,
+            "trials": trials,
+            "r": r,
+            "j": j,
+            "scored": [(l, d["tid"]) for l, d in scored],
+            "live_tids": [d["tid"] for d in live],
+            "rungs": rungs,
+            "new_ids": new_ids,
+        }, checkpoint)
+
     import copy as _copy
 
-    rungs = []
-    budget = float(min_budget)
-    integral = _budgets_integral(max_budget, min_budget)
-    for r in range(n_rungs):
-        b = int(round(budget)) if integral else budget
-        new_ids = trials.new_trial_ids(len(live)) if r > 0 else None
-        scored = []
-        appended = []
-        for j, doc in enumerate(live):
+    scored = None  # stays None when resuming an already-finished run
+    for r in range(r0, n_rungs):
+        b = _rung_budget(min_budget, eta, r, integral)
+        if r > 0 and new_ids is None:
+            new_ids = trials.new_trial_ids(len(live))
+        if r == r0 and scored_tids:
+            restored = _docs_by_tid([t for _, t in scored_tids])
+            scored = [
+                (l, d) for (l, _), d in zip(scored_tids, restored)
+            ]
+        else:
+            scored = []
+        for j in range(j0 if r == r0 else 0, len(live)):
+            doc = live[j]
             loss = fn(config_of(doc), b)
             if isinstance(loss, dict):
                 loss = loss["loss"]
@@ -172,10 +295,22 @@ def successive_halving(
                     [tid], [None], [result], [misc]
                 )
                 rec["state"] = 2
-                appended.append(rec)
+                trials.insert_trial_docs([rec])
+                # the STORED copy is the record scored/promoted from;
+                # insert appends, so scan from the END (O(1) here, not
+                # O(store) per evaluation under a shared hyperband store)
+                for t in reversed(trials._dynamic_trials):
+                    if t["tid"] == tid:
+                        rec = t
+                        break
             scored.append((float(loss), rec))
-        if appended:
-            trials.insert_trial_docs(appended)
+            if (
+                checkpoint is not None
+                and (j + 1) % int(checkpoint_every) == 0
+                and j + 1 < len(live)  # the rung-boundary write is
+                # about to supersede a last-evaluation snapshot
+            ):
+                _write(r, j + 1, scored, live, rungs, new_ids)
         trials.refresh()
         scored.sort(key=lambda t: (not np.isfinite(t[0]), t[0]))
         rungs.append({
@@ -185,8 +320,15 @@ def successive_halving(
         })
         n_keep = max(1, len(scored) // eta)
         live = [doc for _, doc in scored[:n_keep]]
-        budget *= eta
-    best_loss, best_doc = scored[0]
+        new_ids = None
+        if checkpoint is not None:
+            _write(r + 1, 0, [], live, rungs, None)
+    if scored is None:
+        # resumed a checkpoint written at the FINAL rung boundary: the
+        # run had already finished; its answer is the last rung's best
+        best_loss, best_doc = rungs[-1]["best_loss"], live[0]
+    else:
+        best_loss, best_doc = scored[0]
     return {
         "best": config_of(best_doc),
         "best_loss": best_loss,
@@ -196,7 +338,8 @@ def successive_halving(
 
 
 def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
-              rstate=None, trials=None):
+              rstate=None, trials=None, checkpoint=None,
+              checkpoint_every=1):
     """Full Hyperband: every bracket of successive halving from the most
     exploratory (many configs, tiny budget) to a single full-budget
     bracket, sharing one ``Trials`` store.  Returns the overall best.
@@ -208,6 +351,14 @@ def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
     every rung program, so K bracket results cost roughly one bracket's
     wall-clock on an underutilized chip (measured -- BASELINE.md SHA
     row).
+
+    ``checkpoint`` makes the spread durable (the
+    ``compile_hyperband``-shaped contract): a bracket-boundary snapshot
+    at ``checkpoint`` (trials, generator state, completed brackets,
+    incumbent) plus per-bracket :func:`successive_halving` snapshots at
+    ``checkpoint + ".s<s>"``; resuming skips completed brackets,
+    continues the in-flight one mid-rung, and reproduces the
+    uninterrupted run bitwise.
     """
     from .base import Trials
 
@@ -218,7 +369,47 @@ def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
     s_max = _int_log(max_budget / min_budget, eta)
     best = None
     brackets = []
-    for s in range(s_max, -1, -1):
+    s0 = s_max
+    ck_guard = None
+    if checkpoint is not None:
+        from .base import Domain
+        from . import rand as rand_mod
+
+        algo_id = _algo_identity(
+            algo if algo is not None else rand_mod.suggest
+        )
+        ck_guard = (
+            "hyperband", s_max, float(max_budget), float(min_budget),
+            float(eta), type(rstate.bit_generator).__name__, algo_id,
+            _algo_identity(fn),
+            _space_fingerprint(
+                Domain(fn, space, pass_expr_memo_ctrl=False).expr
+            ),
+            # run identity: the generator's ENTRY position -- a
+            # completed snapshot resumed under a different seed must be
+            # refused, not silently returned as the old run's answer
+            _rstate_fingerprint(rstate),
+        )
+        if os.path.exists(checkpoint):
+            from .utils.checkpoint import load_guarded
+
+            snap = load_guarded(checkpoint, ck_guard)
+            trials = snap["trials"]
+            brackets = snap["brackets"]
+            best = snap["best"]
+            s0 = snap["next_s"]
+            rstate = np.random.Generator(type(rstate.bit_generator)())
+            rstate.bit_generator.state = snap["rstate"]
+            # sweep .s files of brackets the main snapshot already
+            # subsumes: a kill between the main write and the .s
+            # removal must not leave a stale file that blocks a later
+            # fresh run at this path
+            for s in range(s_max, s0, -1):
+                try:
+                    os.remove(f"{checkpoint}.s{s}")
+                except FileNotFoundError:
+                    pass
+    for s in range(s0, -1, -1):
         n = int(math.ceil((s_max + 1) * eta**s / (s + 1)))
         out = successive_halving(
             fn, space,
@@ -229,10 +420,34 @@ def hyperband(fn, space, max_budget, eta=3, min_budget=1, algo=None,
             algo=algo,
             trials=trials,
             rstate=rstate,
+            checkpoint=(
+                None if checkpoint is None else f"{checkpoint}.s{s}"
+            ),
+            checkpoint_every=checkpoint_every,
         )
+        trials = out["trials"]  # a resumed bracket restored its own store
         brackets.append({"s": s, **{k: out[k] for k in ("rungs",)}})
         if best is None or out["best_loss"] < best["best_loss"]:
-            best = out
+            best = {"best": out["best"], "best_loss": out["best_loss"]}
+        if checkpoint is not None:
+            from .utils.checkpoint import save_trials
+
+            save_trials({
+                "guard": ck_guard,
+                "trials": trials,
+                "brackets": brackets,
+                "best": best,
+                "next_s": s - 1,
+                "rstate": rstate.bit_generator.state,
+            }, checkpoint)
+            # the bracket is fully subsumed by the main snapshot now;
+            # leaving its .s file would permanently block a FRESH run
+            # at this path after the main checkpoint is removed (the
+            # stale guard mismatches and refuses)
+            try:
+                os.remove(f"{checkpoint}.s{s}")
+            except FileNotFoundError:
+                pass
     return {
         "best": best["best"],
         "best_loss": best["best_loss"],
@@ -898,8 +1113,7 @@ def asha(
     integral = _budgets_integral(max_budget, min_budget)
 
     def rung_budget(r):
-        b = float(min_budget) * eta**r
-        return int(round(b)) if integral else b
+        return _rung_budget(min_budget, eta, r, integral)
 
     domain = Domain(fn, space, pass_expr_memo_ctrl=False)
     lock = threading.Lock()
@@ -932,15 +1146,14 @@ def asha(
             )
         # algo identity rides the guard too: resuming a TPE-driven run
         # with the defaulted (random) algo would silently change the
-        # experiment.  partial(...) unwraps to its base suggest fn --
-        # tuned kwargs (gamma etc.) are not fingerprintable in general
-        a = algo.func if isinstance(algo, functools.partial) else algo
+        # experiment.  No rstate fingerprint here (unlike sha/
+        # hyperband): asha RESTORES the generator state from the
+        # snapshot, so resuming under any entry rstate is sound
         ckpt_guard = (
             "asha", n_rungs, float(max_budget), float(min_budget),
             float(eta), int(max_jobs),
             type(rstate.bit_generator).__name__,
-            f"{getattr(a, '__module__', '?')}."
-            f"{getattr(a, '__qualname__', type(a).__name__)}",
+            _algo_identity(algo),
             _space_fingerprint(domain.expr),
         )
     requeue = []  # restored in-flight rung-0 keys, re-assigned first
@@ -967,14 +1180,9 @@ def asha(
         }, checkpoint)
 
     if checkpoint is not None and os.path.exists(checkpoint):
-        from .utils.checkpoint import load_trials
+        from .utils.checkpoint import load_guarded
 
-        snap = load_trials(checkpoint)
-        if snap["guard"] != ckpt_guard:
-            raise ValueError(
-                f"checkpoint {checkpoint!r} was written by schedule "
-                f"{snap['guard']}; refusing to resume {ckpt_guard}"
-            )
+        snap = load_guarded(checkpoint, ckpt_guard)
         configs = snap["configs"]
         done = snap["done"]
         # attempted (record-time marks), not assignment-time claims: a

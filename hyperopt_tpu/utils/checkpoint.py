@@ -24,6 +24,7 @@ __all__ = [
     "load_obs_buffer_orbax",
     "save_trials",
     "load_trials",
+    "load_guarded",
     "save_pytree",
     "load_pytree",
 ]
@@ -244,3 +245,18 @@ def load_trials(path):
 
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+def load_guarded(path, guard):
+    """Load a pickled scheduler snapshot and refuse one whose recorded
+    ``guard`` differs -- the shared contract of every host scheduler's
+    checkpoint (asha / successive_halving / hyperband): a snapshot from
+    a different schedule, space, algo, or seed must be REFUSED, never
+    silently reinterpreted."""
+    snap = load_trials(path)
+    if snap.get("guard") != guard:
+        raise ValueError(
+            f"checkpoint {path!r} was written by schedule "
+            f"{snap.get('guard')}; refusing to resume {guard}"
+        )
+    return snap

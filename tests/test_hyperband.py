@@ -485,6 +485,139 @@ def test_asha_concurrency_fuzz():
         assert sum(r["n"] for r in out["rungs"]) == 60
 
 
+class _KillableQuad:
+    """budgeted_quad with an optional kill switch at call N.  A CLASS,
+    not a per-test closure: the checkpoint guard fingerprints the
+    objective's identity, so the killed run and the resumed run must
+    present the SAME fn (kill_at=None) -- exactly how a real caller
+    resumes with their unchanged objective."""
+
+    def __init__(self, kill_at=None):
+        self.kill_at = kill_at
+        self.calls = 0
+
+    def __call__(self, cfg, budget):
+        self.calls += 1
+        if self.kill_at is not None and self.calls == self.kill_at:
+            raise KeyboardInterrupt
+        return budgeted_quad(cfg, budget)
+
+
+def _sha_digest(out):
+    return (
+        out["best_loss"], out["best"]["x"], out["rungs"],
+        [(d["tid"], d["result"]["budget"], d["result"]["loss"])
+         for d in out["trials"].trials],
+    )
+
+
+def test_successive_halving_checkpoint_resume_bitwise(tmp_path):
+    """The host SHA driver is a serial (rung, member) loop: kill it at
+    any evaluation, resume from the per-evaluation snapshot, and the
+    result is bitwise the uninterrupted run's -- completing the resume
+    family for the HOST drivers too."""
+    from hyperopt_tpu.hyperband import successive_halving
+
+    kw = dict(max_budget=9, eta=3)
+    ref = _sha_digest(successive_halving(
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(5), **kw
+    ))
+    # checkpoint_every > 1 exercises the snapshot-lags-evaluations
+    # replay (several evaluations re-run deterministically on resume)
+    for kill_at, every in ((4, 1), (11, 1), (11, 3)):
+        path = str(tmp_path / f"sha-{kill_at}-{every}.ckpt")
+        with pytest.raises(KeyboardInterrupt):
+            successive_halving(
+                _KillableQuad(kill_at), SPACE,
+                rstate=np.random.default_rng(5),
+                checkpoint=path, checkpoint_every=every, **kw
+            )
+        resumed = _sha_digest(successive_halving(
+            _KillableQuad(), SPACE, rstate=np.random.default_rng(5),
+            checkpoint=path, checkpoint_every=every, **kw
+        ))
+        assert resumed == ref, (kill_at, every)
+
+
+def test_successive_halving_checkpoint_guard(tmp_path):
+    """A snapshot from a different ladder OR a different seed is
+    refused -- a stale file must never silently resurrect an old run's
+    results for a new request (same seed may resume: it would
+    recompute the identical result)."""
+    from hyperopt_tpu.hyperband import successive_halving
+
+    path = str(tmp_path / "sha.ckpt")
+    out = successive_halving(
+        budgeted_quad, SPACE, max_budget=4, eta=2,
+        rstate=np.random.default_rng(0), checkpoint=path,
+    )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        successive_halving(  # different ladder
+            budgeted_quad, SPACE, max_budget=9, eta=3,
+            rstate=np.random.default_rng(0), checkpoint=path,
+        )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        successive_halving(  # same ladder, DIFFERENT seed
+            budgeted_quad, SPACE, max_budget=4, eta=2,
+            rstate=np.random.default_rng(1), checkpoint=path,
+        )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        successive_halving(  # same ladder+seed, DIFFERENT objective
+            _KillableQuad(), SPACE, max_budget=4, eta=2,
+            rstate=np.random.default_rng(0), checkpoint=path,
+        )
+    again = successive_halving(  # same seed: sound to resume
+        budgeted_quad, SPACE, max_budget=4, eta=2,
+        rstate=np.random.default_rng(0), checkpoint=path,
+    )
+    assert again["best_loss"] == out["best_loss"]
+
+
+def test_hyperband_checkpoint_resume_bitwise(tmp_path):
+    """Kill the host Hyperband spread mid-bracket; the bracket-boundary
+    snapshot plus the in-flight bracket's own snapshot resume to the
+    uninterrupted result exactly (completed brackets are skipped, the
+    shared rstate stream stays aligned)."""
+    from hyperopt_tpu.hyperband import hyperband
+
+    kw = dict(max_budget=9, eta=3)
+
+    def digest(out):
+        return (
+            out["best_loss"], out["best"]["x"],
+            [(b["s"], b["rungs"]) for b in out["brackets"]],
+            [(d["tid"], d["result"]["budget"], d["result"]["loss"])
+             for d in out["trials"].trials],
+        )
+
+    ref = digest(hyperband(
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(9), **kw
+    ))
+    path = str(tmp_path / "hb.ckpt")
+    with pytest.raises(KeyboardInterrupt):
+        hyperband(  # killed inside the second bracket
+            _KillableQuad(15), SPACE, rstate=np.random.default_rng(9),
+            checkpoint=path, **kw
+        )
+    resumed = digest(hyperband(
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(9),
+        checkpoint=path, **kw
+    ))
+    assert resumed == ref
+    # completed brackets' .s files were cleaned up: removing the main
+    # snapshot leaves nothing stale to block a FRESH different-seed run
+    import glob
+    import os
+
+    os.remove(path)
+    assert not glob.glob(path + ".s*")
+    fresh = hyperband(
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(10),
+        checkpoint=path, **kw
+    )
+    assert np.isfinite(fresh["best_loss"])
+
+
 def test_asha_checkpoint_resume_bitwise(tmp_path):
     """Kill mid-run, resume from the snapshot, and reproduce the
     uninterrupted run EXACTLY (workers=1: the snapshot's generator state
