@@ -29,7 +29,7 @@ from .filequeue import FileTrials, FileJobQueue
 
 __all__ = [
     "ThreadTrials", "FileTrials", "FileJobQueue",
-    "asha_filequeue", "asha_mongo", "BudgetedDomainFn",
+    "asha_filequeue", "asha_mongo", "asha_spark", "BudgetedDomainFn",
 ]
 
 
@@ -37,7 +37,8 @@ def __getattr__(name):
     import importlib
 
     if name in (
-        "asha_queue", "asha_filequeue", "asha_mongo", "BudgetedDomainFn"
+        "asha_queue", "asha_filequeue", "asha_mongo", "asha_spark",
+        "BudgetedDomainFn",
     ):
         # lazy: pulls in hyperband (and its numpy graph machinery) only
         # when the ASHA-over-queue driver is actually used

@@ -46,7 +46,9 @@ from .filequeue import FileJobQueue, _read_json
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["BudgetedDomainFn", "asha_filequeue", "asha_mongo"]
+__all__ = [
+    "BudgetedDomainFn", "asha_filequeue", "asha_mongo", "asha_spark",
+]
 
 
 class BudgetedDomainFn:
@@ -154,8 +156,9 @@ def asha_filequeue(
     )
     try:
         return _run_asha(
-            transport, fn, space, max_budget, eta, min_budget, max_jobs,
-            inflight, algo, trials, rstate, checkpoint, checkpoint_every,
+            transport.evaluator, fn, space, max_budget, eta, min_budget,
+            max_jobs, inflight, algo, trials, rstate, checkpoint,
+            checkpoint_every,
         )
     finally:
         _cleanup_attachment(
@@ -202,7 +205,7 @@ def _reject_queue_backed_trials(trials, caller):
         )
 
 
-def _run_asha(transport, fn, space, max_budget, eta, min_budget,
+def _run_asha(evaluator, fn, space, max_budget, eta, min_budget,
               max_jobs, inflight, algo, trials, rstate, checkpoint,
               checkpoint_every):
     """One shared asha() invocation for every transport driver -- a new
@@ -222,7 +225,7 @@ def _run_asha(transport, fn, space, max_budget, eta, min_budget,
         rstate=rstate,
         checkpoint=checkpoint,
         checkpoint_every=checkpoint_every,
-        evaluator=transport.evaluator,
+        evaluator=evaluator,
     )
 
 
@@ -273,9 +276,12 @@ class _TransportDriver:
             self._last_reap = now
         self._reap(self.reserve_timeout)
 
-    def evaluator(self, vals, budget):
+    def evaluator(self, vals, cfg, budget):
         """The :func:`hyperband.asha` ``evaluator=`` seam: one queued
-        job per call, blocking until its result lands (or expires)."""
+        job per call, blocking until its result lands (or expires).
+        ``cfg`` (the decoded config) is unused here -- workers decode
+        from the doc's index-form vals themselves."""
+        del cfg
         with self._lock:
             tid = f"{self._run_tag}-{next(self._counter)}"
             self.published += 1
@@ -413,10 +419,75 @@ def asha_mongo(
     )
     try:
         return _run_asha(
-            transport, fn, space, max_budget, eta, min_budget, max_jobs,
-            inflight, algo, trials, rstate, checkpoint, checkpoint_every,
+            transport.evaluator, fn, space, max_budget, eta, min_budget,
+            max_jobs, inflight, algo, trials, rstate, checkpoint,
+            checkpoint_every,
         )
     finally:
         _cleanup_attachment(
             transport, lambda: jobs.delete_attachment(attachment_key)
         )
+
+
+def asha_spark(
+    fn,
+    space,
+    max_budget,
+    spark=None,
+    eta=3,
+    min_budget=1,
+    max_jobs=81,
+    inflight=4,
+    algo=None,
+    trials=None,
+    rstate=None,
+    checkpoint=None,
+    checkpoint_every=1,
+):
+    """Run ASHA with each evaluation dispatched as a 1-task Spark job --
+    the :class:`~.spark.SparkTrials` execution model (SURVEY.md SS3.5)
+    driven by the async scheduler.  Each in-flight slot submits
+    ``fn(config, budget)`` through ``sc.parallelize([...], 1)`` under
+    its own job group and blocks on ``collect``; promotion decisions
+    never wait at a rung barrier, and up to ``inflight`` Spark jobs run
+    concurrently (cluster parallelism is Spark's to schedule).
+
+    Args as :func:`hyperband.asha`, plus ``spark``: a ``SparkSession``
+    (default ``SparkSession.builder.getOrCreate()``).  ``fn`` ships to
+    executors via Spark's closure serialization, the same contract as
+    ``SparkTrials`` objectives; a task exception records as a failed
+    evaluation that can never promote.  There is no ``eval_timeout``
+    here -- bound task time with Spark's own scheduler configs, as the
+    reference's SparkTrials users do.
+    """
+    from .spark import _require_pyspark, submit_one_task
+
+    _reject_queue_backed_trials(trials, "asha_spark")
+    if spark is None:
+        pyspark = _require_pyspark()  # curated error names alternatives
+        spark = pyspark.sql.SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+    run_tag = uuid.uuid4().hex[:8]
+    counter = itertools.count()
+    counter_lock = threading.Lock()
+
+    def evaluator(vals, cfg, budget):
+        del vals  # the decoded cfg ships in the task closure
+        with counter_lock:
+            i = next(counter)
+
+        def task(_):
+            return fn(cfg, budget)
+
+        # per-evaluation job group (observable in the Spark UI;
+        # reliably cancellable under pinned threads -- see
+        # submit_one_task), through the dispatch SparkTrials shares
+        return submit_one_task(
+            sc, task, f"hyperopt_tpu-asha-{run_tag}-{i}",
+            f"asha eval {i} (budget {budget})",
+        )  # float or {"loss": ...}; asha normalizes
+
+    return _run_asha(
+        evaluator, fn, space, max_budget, eta, min_budget, max_jobs,
+        inflight, algo, trials, rstate, checkpoint, checkpoint_every,
+    )

@@ -53,6 +53,24 @@ def _spark_supports_job_cancelling(sc):
     return hasattr(sc, "cancelJobGroup")
 
 
+def submit_one_task(sc, task, group, description, interrupt=True):
+    """Run ``task`` as a single-task Spark job under ``group`` and
+    return its result -- the 1-trial dispatch idiom shared by
+    ``SparkTrials`` and ``asha_spark`` (one definition, so fixes to the
+    dispatch cannot drift).  The job group is set only when the context
+    supports cancellation (the same capability gate SparkTrials uses).
+
+    NOTE on concurrency: job groups are per-JVM-thread; without
+    PySpark pinned-thread mode (``PYSPARK_PIN_THREAD``, default on
+    since Spark 3.2) concurrent driver threads can attach a group to
+    the wrong job, so external per-group cancellation is only reliable
+    under pinned threads."""
+    if _spark_supports_job_cancelling(sc):
+        sc.setJobGroup(group, description, interrupt)
+    [result] = sc.parallelize([0], 1).map(task).collect()
+    return result
+
+
 class SparkTrials(Trials):
     """Trials whose evaluation fans out as single-task Spark jobs."""
 
@@ -107,9 +125,9 @@ class SparkTrials(Trials):
             return domain.evaluate(spec, ctrl, attach_attachments=False)
 
         try:
-            if self._supports_cancel:
-                sc.setJobGroup(group, f"trial {trial['tid']}", True)
-            [result] = sc.parallelize([0], 1).map(task).collect()
+            result = submit_one_task(
+                sc, task, group, f"trial {trial['tid']}", True
+            )
         except Exception as e:
             with self._lock:
                 if trial["state"] == JOB_STATE_RUNNING:

@@ -1082,14 +1082,18 @@ def asha(
       checkpoint_every: snapshot cadence in recorded evaluations
         (default 1: every record; raise it if pickling a large trials
         store every record measures as the bottleneck).
-      evaluator: optional transport seam, ``evaluator(vals, budget) ->
-        loss`` where ``vals`` is the INDEX-form config dict (the
-        encoding trial docs carry) -- lets the scheduler dispatch
-        evaluations somewhere other than this process while the worker
-        threads become in-flight-job slots.
-        :func:`hyperopt_tpu.distributed.asha_filequeue` uses it to farm
-        evaluations to ``hyperopt-tpu-worker`` processes.  Default:
-        evaluate ``fn(space_eval(space, vals), budget)`` inline.
+      evaluator: optional transport seam, ``evaluator(vals, cfg,
+        budget) -> loss`` where ``vals`` is the INDEX-form config dict
+        (the encoding trial docs carry) and ``cfg`` its decoded form --
+        lets the scheduler dispatch evaluations somewhere other than
+        this process while the worker threads become in-flight-job
+        slots.  The decode happens OUTSIDE the failure-tolerant region
+        for every path, so a deterministic space bug surfaces at the
+        first job instead of burning ``max_jobs`` failed trials.
+        :func:`hyperopt_tpu.distributed.asha_filequeue` /
+        ``asha_mongo`` / ``asha_spark`` use it to farm evaluations to
+        worker processes / Spark tasks.  Default: evaluate
+        ``fn(cfg, budget)`` inline.
 
     Returns ``{"best": config, "best_loss", "rungs": [{"budget", "n"}],
     "trials"}`` where ``best`` is the best completed evaluation at the
@@ -1111,6 +1115,20 @@ def asha(
         trials = Trials()
     n_rungs = _int_log(max_budget / min_budget, eta) + 1
     integral = _budgets_integral(max_budget, min_budget)
+    if evaluator is not None:
+        # arity check up front: a mismatched evaluator (e.g. one
+        # written against an older (vals, budget) seam) would otherwise
+        # raise TypeError inside the failure-tolerant worker and burn
+        # every job as a failed trial
+        import inspect
+
+        try:
+            inspect.signature(evaluator).bind({}, {}, 1)
+        except TypeError:
+            raise TypeError(
+                "evaluator must accept (vals, cfg, budget); got "
+                f"signature {inspect.signature(evaluator)}"
+            )
 
     def rung_budget(r):
         return _rung_budget(min_budget, eta, r, integral)
@@ -1283,15 +1301,15 @@ def asha(
             if job is None:
                 return
             key, r = job
-            # decode OUTSIDE the try: a space_eval failure is a
-            # deterministic framework/space bug that must surface
-            # immediately, not burn max_jobs NaN trials
-            cfg = None if evaluator is not None else space_eval(
-                space, configs[key]
-            )
+            # decode OUTSIDE the try, for BOTH paths: a space_eval
+            # failure is a deterministic framework/space bug that must
+            # surface immediately, not burn max_jobs NaN trials
+            cfg = space_eval(space, configs[key])
             try:
                 if evaluator is not None:
-                    loss = evaluator(dict(configs[key]), rung_budget(r))
+                    loss = evaluator(
+                        dict(configs[key]), cfg, rung_budget(r)
+                    )
                 else:
                     loss = fn(cfg, rung_budget(r))
                 if isinstance(loss, dict):

@@ -836,6 +836,19 @@ def test_asha_checkpoint_refuses_different_algo(tmp_path):
     assert len(out["trials"]) == 12
 
 
+def test_asha_evaluator_arity_validated():
+    """A mismatched evaluator (e.g. written against a 2-arg seam) must
+    fail fast at entry, not burn every job as a failed trial inside the
+    failure-tolerant worker."""
+    from hyperopt_tpu.hyperband import asha
+
+    with pytest.raises(TypeError, match="vals, cfg, budget"):
+        asha(
+            budgeted_quad, SPACE, max_budget=4, max_jobs=2, workers=1,
+            evaluator=lambda vals, budget: 0.0,
+        )
+
+
 def test_asha_checkpoint_every_validated(tmp_path):
     from hyperopt_tpu.hyperband import asha
 

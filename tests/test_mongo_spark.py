@@ -292,6 +292,40 @@ def test_asha_mongo_end_to_end(fake_mongo):
     assert {d["misc"]["budget"] for d in done} <= {1, 3, 9}
 
 
+def test_asha_spark_end_to_end(fake_spark):
+    """The async scheduler over the SparkTrials execution model: each
+    evaluation a 1-task Spark job under its own job group, promotion
+    decisions on the driver -- the third transport sharing the asha
+    seam (filequeue / Mongo / Spark)."""
+    from pyspark.sql import SparkSession
+
+    from hyperopt_tpu.distributed.asha_queue import asha_spark
+    from hyperopt_tpu.models.synthetic import (
+        budgeted_quadratic_fn, budgeted_quadratic_space,
+    )
+
+    spark = SparkSession.builder.getOrCreate()
+    out = asha_spark(
+        budgeted_quadratic_fn, budgeted_quadratic_space(),
+        max_budget=9, spark=spark, eta=3, max_jobs=30, inflight=4,
+        rstate=np.random.default_rng(0),
+    )
+    trials = out["trials"]
+    assert len(trials) == 30
+    budgets = [t["result"]["budget"] for t in trials.trials]
+    assert set(budgets) <= {1, 3, 9}
+    assert budgets.count(1) > budgets.count(9) > 0
+    x_at = lambda b: {
+        round(t["misc"]["vals"]["x"][0], 9)
+        for t in trials.trials if t["result"]["budget"] == b
+    }
+    assert x_at(3) <= x_at(1) and x_at(9) <= x_at(3)
+    assert np.isfinite(out["best_loss"])
+    # every evaluation went THROUGH the Spark dispatch (one 1-task job
+    # per evaluation)
+    assert spark.sparkContext.parallelize_calls == 30
+
+
 def test_asha_drivers_reject_any_queue_backed_trials(fake_mongo, tmp_path):
     """Cross-backend foot-gun: each driver must refuse EVERY
     queue-backed store (FileTrials to asha_mongo and vice versa), not
