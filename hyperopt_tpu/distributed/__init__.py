@@ -12,11 +12,14 @@ for the environments a TPU framework actually runs in:
                  reservation, reserve-timeout reaping, pickled-Domain
                  shipping and ERROR-state capture -- the MongoDB work-queue
                  role on the NFS/GCS-FUSE mounts TPU pods already have.
-``asha_queue``-- ``asha_filequeue``: the ASHA scheduler driving the
-                 filequeue backend -- promote-on-completion scheduling
-                 with evaluations farmed to ``hyperopt-tpu-worker``
-                 processes (budget rides the trial doc, the pickled
-                 ``BudgetedDomainFn`` hands it to the objective).
+``asha_queue``-- the ASHA scheduler driving every execution backend:
+                 ``asha_filequeue`` (jobs to ``hyperopt-tpu-worker``
+                 processes over the shared-FS queue), ``asha_mongo``
+                 (the MongoDB protocol itself), and ``asha_spark``
+                 (each evaluation a 1-task Spark job).  Budget rides
+                 the trial doc; the pickled ``BudgetedDomainFn`` hands
+                 it to the objective; per-run Domain attachment keys
+                 let the drivers share a queue/database with fmin.
 ``mongo``     -- ``MongoTrials``: the reference's MongoDB protocol (CAS
                  reservation via find_one_and_modify, GridFS attachments);
                  requires pymongo, import-gated.
