@@ -539,6 +539,33 @@ def test_successive_halving_checkpoint_resume_bitwise(tmp_path):
         assert resumed == ref, (kill_at, every)
 
 
+def test_successive_halving_resume_bitwise_at_every_kill_point(tmp_path):
+    """Exhaustive kill-point sweep: killing at EVERY evaluation of the
+    bracket (including before the first snapshot exists -- resume then
+    replays the seeded suggestion from scratch) resumes to the bitwise
+    uninterrupted result."""
+    from hyperopt_tpu.hyperband import successive_halving
+
+    kw = dict(max_budget=9, eta=3)
+    ref = _sha_digest(successive_halving(
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(5), **kw
+    ))
+    total_evals = len(ref[3])  # every recorded trial is one evaluation
+    assert total_evals == 13  # 9 + 3 + 1
+    for kill_at in range(1, total_evals + 1):
+        path = str(tmp_path / f"sweep-{kill_at}.ckpt")
+        with pytest.raises(KeyboardInterrupt):
+            successive_halving(
+                _KillableQuad(kill_at), SPACE,
+                rstate=np.random.default_rng(5), checkpoint=path, **kw
+            )
+        resumed = _sha_digest(successive_halving(
+            _KillableQuad(), SPACE, rstate=np.random.default_rng(5),
+            checkpoint=path, **kw
+        ))
+        assert resumed == ref, kill_at
+
+
 def test_successive_halving_checkpoint_guard(tmp_path):
     """A snapshot from a different ladder OR a different seed is
     refused -- a stale file must never silently resurrect an old run's
