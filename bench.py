@@ -94,8 +94,14 @@ def bench_host_tpe(domain, trials, n_calls=15, native=False):
     return n_calls / dt
 
 
-def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
-    """TPU path: one compiled program suggests the whole batch."""
+def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30,
+                  above_cap=None):
+    """TPU path: one compiled program suggests the whole batch.
+
+    ``above_cap`` is :func:`tpe_jax.build_suggest_fn`'s above-model
+    compaction knob (None = framework default, 0 = full-width scoring);
+    the obs-scaling sweep measures both settings at each history size.
+    """
     import jax
 
     from hyperopt_tpu import tpe_jax
@@ -103,8 +109,11 @@ def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
 
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
-    fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
-    arrays = buf.device_arrays()
+    fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0,
+                                  above_cap=above_cap)
+    arrays = buf.device_arrays(
+        pow2_cap=tpe_jax._resolve_above_cap(above_cap)
+    )
     key = jax.random.key(0)
 
     out = fn(key, *arrays, batch=batch)  # compile
@@ -119,6 +128,41 @@ def bench_jax_tpe(domain, trials, batch=64, n_cand=128, n_calls=30):
     jax.block_until_ready(out)
     dt = time.perf_counter() - t0
     return batch * n_calls / dt, out
+
+
+def bench_obs_scaling(space, batch, n_cand, sizes):
+    """Suggestion-throughput sweep over history sizes (VERDICT r5 item
+    2): the high-observation cliff, tracked round over round.  At each
+    observation count the jitted batched suggest is timed twice --
+    compacted (the default above-model cap) and full-width
+    (``above_cap=0``, the pre-round-6 behavior) -- so the JSON carries
+    both the absolute curve and the compaction speedup.
+
+    Returns a list of {n_obs, suggestions_per_sec,
+    full_width_suggestions_per_sec, compaction_speedup_x} rows.
+    """
+    rows = []
+    for n_obs in sizes:
+        domain, trials = build_history(n_obs, space, seed=n_obs)
+        # fewer timed calls at the big sizes: the full-width run is the
+        # pre-fix cliff being measured, no need to soak in it
+        n_calls = 8 if n_obs <= 2500 else 4
+        rate, _ = bench_jax_tpe(
+            domain, trials, batch=batch, n_cand=n_cand, n_calls=n_calls
+        )
+        full_rate, _ = bench_jax_tpe(
+            domain, trials, batch=batch, n_cand=n_cand, n_calls=n_calls,
+            above_cap=0,
+        )
+        rows.append({
+            "n_obs": n_obs,
+            "suggestions_per_sec": round(rate, 1),
+            "full_width_suggestions_per_sec": round(full_rate, 1),
+            "compaction_speedup_x": (
+                round(rate / full_rate, 2) if full_rate else None
+            ),
+        })
+    return rows
 
 
 def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
@@ -417,6 +461,15 @@ def main():
 
     platform = jax.devices()[0].platform
     jax_rate, _ = bench_jax_tpe(domain, trials, batch=batch, n_cand=n_cand)
+    # obs-scaling sweep (VERDICT r5 item 2): 500 / 2.5k / 10k obs,
+    # compacted vs full-width, env-overridable for CI smoke sizing
+    obs_sweep_sizes = [
+        int(s) for s in os.environ.get(
+            "BENCH_OBS_SWEEP", "500,2500,10000"
+        ).split(",") if s.strip()
+    ]
+    obs_scaling = bench_obs_scaling(space, batch, n_cand, obs_sweep_sizes)
+    from hyperopt_tpu.ops.kernels import DEFAULT_ABOVE_CAP as above_cap_default
     latency_rate, latency_sync_rate = bench_jax_latency(
         domain, trials, n_cand=n_cand
     )
@@ -513,6 +566,8 @@ def main():
                     round(sha_sync_best, 4)
                     if sha_sync_best is not None else None
                 ),
+                "obs_scaling": obs_scaling,
+                "above_cap": above_cap_default,
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,

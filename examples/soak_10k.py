@@ -35,6 +35,10 @@ def main():
     ap.add_argument("--batch", type=int, default=1024)
     ap.add_argument("--n-cand", type=int, default=128)
     ap.add_argument("--n-calls", type=int, default=8)
+    ap.add_argument("--above-cap", type=int, default=None,
+                    help="above-model compaction cap (default: framework "
+                    "default; 0 = full-width scoring, the pre-round-6 "
+                    "behavior this soak originally measured)")
     args = ap.parse_args()
     if os.environ.get("HYPEROPT_TPU_COMPILATION_CACHE", "1") != "0":
         from hyperopt_tpu.utils import enable_compilation_cache
@@ -76,13 +80,17 @@ def main():
             n_have += chunk
         buf = obs_buffer_for(domain, trials)
         assert buf.count == target, (buf.count, target)
-        bucket = buf._device_bucket()
-        arrays = buf.device_arrays()
+        # with compaction active the bucket schedule coarsens past the
+        # cap (fewer recompiles -- the round-6 'stop re-bucketing' rule)
+        a_cap = tpe_jax._resolve_above_cap(args.above_cap)
+        bucket = buf._device_bucket(pow2_cap=a_cap)
+        arrays = buf.device_arrays(pow2_cap=a_cap)
 
         fn = fn_cache.get(bucket)
         if fn is None:
             fn = fn_cache[bucket] = tpe_jax.build_suggest_fn(
-                buf.space, args.n_cand, 0.25, 25.0, 1.0, n_cand_cat=24
+                buf.space, args.n_cand, 0.25, 25.0, 1.0, n_cand_cat=24,
+                above_cap=args.above_cap,
             )
         key = jax.random.key(target)
         out = fn(key, *arrays, batch=args.batch)
@@ -101,6 +109,7 @@ def main():
             "n_obs": target,
             "capacity": buf.capacity,
             "device_bucket": bucket,
+            "above_cap": 0 if a_cap is None else a_cap,
             "suggest_per_sec_B1024": round(sugg_rate, 1),
             "buffer_mb": round(buf_mb, 2),
             "rss_delta_mb": round(rss_mb() - rss0, 1),

@@ -31,7 +31,7 @@ _MAX_ADAPTIVE_GAMMA = 0.35
 
 def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
                          lock_fraction=0.5, base_n_ei=None, n_cand_cat=None,
-                         mesh=None, cand_axis=None):
+                         mesh=None, cand_axis=None, above_cap=None):
     """Compile the ADAPTIVE TPE suggest step for a PackedSpace -- the
     on-device counterpart of :class:`hyperopt_tpu.atpe.ATPEOptimizer`,
     traceable under ``device_loop.compile_fmin``'s scan (VERDICT r3
@@ -63,6 +63,11 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
       conditional activity is re-derived so locked choice arms re-route
       their subtrees -- exactly the host path's semantics.
 
+    ``above_cap`` follows :func:`tpe_jax.build_suggest_fn`'s knob (None
+    = the framework default cap, 0 = full-width scoring): the adaptive
+    path shares the compacted above model, so its suggest cost is also
+    flat past the cap.
+
     ``mesh``/``cand_axis`` shard the EI candidate sweep over the mesh
     (per-device slabs + argmax-allgather via
     :func:`hyperopt_tpu.parallel.sharded.build_sharded_sweep`); the
@@ -82,6 +87,7 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
         base_n_ei = tpe_jax._default_n_EI_candidates
     if n_cand_cat is None:
         n_cand_cat = tpe_jax._default_n_EI_candidates_cat
+    a_cap = tpe_jax._resolve_above_cap(above_cap)
     c = ps._consts
     D = ps.n_dims
     Dc = len(ps.cont_idx)
@@ -244,7 +250,7 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
             gamma, pw, explore_frac, ok, n = settings(losses, valid)
         fits = K.fit_all_dims(
             c, values, active, losses, valid, gamma, lf_f, pw,
-            pad_gamma=_MAX_ADAPTIVE_GAMMA,
+            pad_gamma=_MAX_ADAPTIVE_GAMMA, above_cap=a_cap,
         )
 
         if sharded_sweep is not None:
@@ -331,7 +337,7 @@ def _sharded_dense(domain, trials, seed, batch, mesh, kw, linear_forgetting):
         per_device_count(kw["n_EI_candidates"], n_dev),
         kw["gamma"], linear_forgetting, kw["prior_weight"],
         per_device_count(kw["n_EI_candidates_cat"], n_dev),
-        key, batch,
+        key, batch, above_cap=kw.get("above_cap"),
     )
 
 

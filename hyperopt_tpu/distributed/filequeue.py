@@ -155,6 +155,17 @@ class FileJobQueue:
             if doc.get("tid") in exclude_tids:
                 continue
             try:
+                # refresh the mtime BEFORE the CAS rename: a job that
+                # waited in new/ longer than reserve_timeout would carry
+                # its stale mtime into running/ and be reap-eligible
+                # until _write_atomic below rewrites it -- a concurrent
+                # reaper in that window could move it back to new/ while
+                # this worker recreates the running file, duplicating
+                # the evaluation (mirrors the utime-before-rename fix in
+                # reap()/unreserve(); ADVICE r5).  Touching src is safe
+                # under contention: whoever wins the rename gets a fresh
+                # claim timestamp either way.
+                os.utime(src)
                 os.rename(src, dst)  # the CAS: exactly one winner
             except FileNotFoundError:
                 continue  # another worker won this job

@@ -93,9 +93,17 @@ class MongoJobs:
     def reserve(self, owner, exp_key=None, exclude_tids=()):
         """The CAS: atomically flip one NEW job to RUNNING with our owner.
 
+        Ordered by ``_id`` (insertion order), NOT by tid: BSON sorts all
+        numbers before all strings, so a tid sort would starve
+        ``asha_mongo``'s string tids ('<runtag>-<n>') behind any
+        concurrent fmin's numeric tids on a shared collection (ADVICE
+        r5).  ``_id`` is type-neutral and insertion-ordered for both the
+        real ObjectId and the test doubles' counters; for a single
+        driver publishing in tid order the two orderings coincide.
+
         ``exclude_tids`` lets a worker skip jobs it has already proven
         it cannot process (e.g. a dangling Domain attachment) -- without
-        it, tid-ascending ordering would hand the same poisoned job back
+        it, the stable ordering would hand the same poisoned job back
         on every iteration and starve everything behind it."""
         query = {"state": JOB_STATE_NEW}
         if exp_key is not None:
@@ -111,7 +119,7 @@ class MongoJobs:
                     "book_time": coarse_utcnow(),
                 }
             },
-            sort=[("tid", 1)],
+            sort=[("_id", 1)],
             return_document=True,
         )
 

@@ -73,6 +73,31 @@ def _algo_identity(algo):
     )
 
 
+def _check_evaluator_arity(evaluator):
+    """Fail fast on a mismatched evaluator (e.g. one written against an
+    older ``(vals, budget)`` seam): inside the failure-tolerant worker
+    the TypeError would burn every job as a failed trial instead.
+
+    ``inspect.signature`` itself raises ValueError (TypeError on some
+    older CPythons) for C-implemented callables without introspectable
+    signatures -- those are ACCEPTED, not rejected: a valid evaluator
+    without a signature must not crash the driver with an unrelated
+    error (ADVICE r5), and a genuinely mismatched one still surfaces at
+    its first call."""
+    import inspect
+
+    try:
+        sig = inspect.signature(evaluator)
+    except (ValueError, TypeError):
+        return
+    try:
+        sig.bind({}, {}, 1)
+    except TypeError:
+        raise TypeError(
+            f"evaluator must accept (vals, cfg, budget); got signature {sig}"
+        )
+
+
 def _rstate_fingerprint(rstate):
     """Checkpoint-guard identity of a generator's CURRENT position:
     stale snapshot files from a run with a different seed (or a
@@ -1116,19 +1141,7 @@ def asha(
     n_rungs = _int_log(max_budget / min_budget, eta) + 1
     integral = _budgets_integral(max_budget, min_budget)
     if evaluator is not None:
-        # arity check up front: a mismatched evaluator (e.g. one
-        # written against an older (vals, budget) seam) would otherwise
-        # raise TypeError inside the failure-tolerant worker and burn
-        # every job as a failed trial
-        import inspect
-
-        try:
-            inspect.signature(evaluator).bind({}, {}, 1)
-        except TypeError:
-            raise TypeError(
-                "evaluator must accept (vals, cfg, budget); got "
-                f"signature {inspect.signature(evaluator)}"
-            )
+        _check_evaluator_arity(evaluator)
 
     def rung_budget(r):
         return _rung_budget(min_budget, eta, r, integral)
@@ -1162,16 +1175,20 @@ def asha(
             raise ValueError(
                 f"checkpoint_every must be >= 1, got {checkpoint_every!r}"
             )
-        # algo identity rides the guard too: resuming a TPE-driven run
-        # with the defaulted (random) algo would silently change the
-        # experiment.  No rstate fingerprint here (unlike sha/
-        # hyperband): asha RESTORES the generator state from the
-        # snapshot, so resuming under any entry rstate is sound
+        # algo AND objective identity ride the guard: resuming a
+        # TPE-driven run with the defaulted (random) algo, or an asha
+        # snapshot with an EDITED objective, would silently change the
+        # experiment -- the latter mixing the old objective's recorded
+        # losses with new evaluations of the new one (ADVICE r5; sha/
+        # hyperband already fingerprint fn).  No rstate fingerprint here
+        # (unlike sha/hyperband): asha RESTORES the generator state from
+        # the snapshot, so resuming under any entry rstate is sound
         ckpt_guard = (
             "asha", n_rungs, float(max_budget), float(min_budget),
             float(eta), int(max_jobs),
             type(rstate.bit_generator).__name__,
             _algo_identity(algo),
+            _algo_identity(fn),
             _space_fingerprint(domain.expr),
         )
     requeue = []  # restored in-flight rung-0 keys, re-assigned first

@@ -167,7 +167,7 @@ class ObsBuffer:
         """The four dense arrays at current (bucketed) capacity."""
         return self.values, self.active, self.losses, self.valid
 
-    def _device_bucket(self):
+    def _device_bucket(self, pow2_cap=None):
         """Static width handed to jit: the smallest power-of-2 >= count
         (floored at MIN_CAPACITY, capped at capacity).
 
@@ -177,19 +177,29 @@ class ObsBuffer:
         EVERY suggest (measured in the round-2 soak: trials/s dropped
         ~40% after the 2048->8192 growth).  Slicing uploads to a pow2
         bucket of the live count bounds padding at 2x while keeping
-        retraces logarithmic."""
+        retraces logarithmic.
+
+        ``pow2_cap`` (the caller's above-model compaction cap, round 6):
+        with compaction active the scoring width is STATIC past the cap
+        -- only the cheap O(n log n) fit still sees the buffer width --
+        so the 2x-padding argument above stops applying there and the
+        bucket stops re-bucketing at every pow2 crossing: past the cap
+        it grows by GROWTH_FACTOR steps, aligned with the host capacity
+        schedule, halving the retrace count at large histories."""
+        coarse = GROWTH_FACTOR.bit_length() - 1
         b = MIN_CAPACITY
         while b < self.count:
-            b <<= 1
+            b <<= 1 if (pow2_cap is None or b < pow2_cap) else coarse
         return min(b, self.capacity)
 
-    def device_arrays(self):
+    def device_arrays(self, pow2_cap=None):
         """The four arrays on the default device -- sliced to the pow2
         bucket of the live count (see :meth:`_device_bucket`) and cached
         by (generation, bucket): repeated suggest calls against
         unchanged history transfer nothing (the 'on-device history'
-        contract of the north star)."""
-        b = self._device_bucket()
+        contract of the north star).  ``pow2_cap`` coarsens the bucket
+        schedule past a compaction cap (see :meth:`_device_bucket`)."""
+        b = self._device_bucket(pow2_cap)
         key = (self._generation, b)
         if self._device_cache is None or self._device_cache[0] != key:
             import jax

@@ -22,7 +22,9 @@ import numpy as np
 from jax.scipy.special import ndtr, ndtri
 
 __all__ = [
+    "DEFAULT_ABOVE_CAP",
     "check_prior_weight",
+    "compact_gmm",
     "forgetting_weights",
     "parzen_fit",
     "quantize_nat",
@@ -78,6 +80,98 @@ def _below_pad(lf, cap=None, gamma=None):
     return max(8, (bound + 7) // 8 * 8)
 
 
+# Default component cap for the ABOVE Parzen model (the single knob the
+# suggest builders resolve their ``above_cap=None`` against).  The above
+# model's component count tracks the observation count, so full-width
+# scoring is the linear term that collapsed suggest throughput 109k/s ->
+# 3.8k/s between 500 and 10k obs (BASELINE.md 10k-soak row); 512 keeps
+# the <= 500-obs headline configs bitwise untouched (their live component
+# count never reaches the cap, so compaction is the identity) while
+# pinning the scoring width flat past it.
+DEFAULT_ABOVE_CAP = 512
+
+
+def _above_pad(above_cap):
+    """Static padded width of the compacted above model: the cap rounded
+    up to a multiple of 8 sublanes (floored at one sublane row)."""
+    return max(8, (int(above_cap) + 7) // 8 * 8)
+
+
+def compact_gmm(weights, mus, sigmas, out_width):
+    """Merge a sorted Parzen mixture into a fixed-width component buffer.
+
+    Input is one :func:`parzen_fit` output row: components sorted by mu
+    with the live ones (weight > 0, prior included) a PREFIX and padded
+    slots (weight 0) behind them.  The ``out_width`` output groups the
+    live prefix into ``out_width`` contiguous runs of near-equal size --
+    adjacent in mu, so every merge is of near-duplicate neighbors -- and
+    moment-matches each run: group weight is the weight sum (total
+    mixture mass is preserved), group mu the weighted mean, group sigma
+    the mixture standard deviation ``sqrt(E[s^2 + mu^2] - mu_g^2)``
+    computed as within-variance + spread so float cancellation can only
+    shrink the (non-negative) spread term, never the variance itself.
+    The linear-forgetting weights thus decide what survives: a heavy
+    (recent) component dominates its group's moments, near-zero-weight
+    (oldest) components fold into their neighbors' mass.
+
+    PARITY CONTRACT: a group holding exactly ONE live component passes
+    its (w, mu, sigma) through UNTOUCHED -- and when the live count is
+    <= ``out_width`` the grouping is the identity, so the compacted
+    mixture equals the full one slot-for-slot and downstream scoring is
+    bitwise identical (padded tails only append exact-zero terms to the
+    score reductions).  Above the cap, scoring cost drops from O(n_obs)
+    to O(out_width) per candidate.
+
+    Group sums come from exclusive-prefix cumsums differenced at the
+    group boundaries -- O(K) elementwise + one [out_width]-row gather --
+    instead of a [K, out_width] one-hot contraction, which would cost
+    more than the scoring it saves at B=1 (the sequential device-loop /
+    latency path must stay cheap at every width).
+    """
+    k = weights.shape[0]
+    live = weights > 0
+    n_live = jnp.sum(live.astype(jnp.int32))
+    # group(i) = floor(i * W / scale): identity while n_live <= out_width
+    scale = jnp.maximum(n_live, out_width)
+    g = jnp.arange(out_width + 1, dtype=jnp.int32)
+    bounds = jnp.clip((g * scale + out_width - 1) // out_width, 0, k)
+
+    sig2 = sigmas * sigmas
+    cols = jnp.stack(
+        [
+            weights,
+            weights * mus,
+            weights * mus * mus,
+            weights * sig2,
+            live.astype(weights.dtype),
+        ],
+        axis=-1,
+    )  # [K, 5]
+    p = jnp.concatenate(
+        [jnp.zeros((1, 5), weights.dtype), jnp.cumsum(cols, axis=0)], axis=0
+    )
+    seg = p[bounds[1:]] - p[bounds[:-1]]  # [out_width, 5]
+
+    w_g = seg[:, 0]
+    cnt = seg[:, 4]
+    live_g = cnt > 0
+    single = cnt == 1.0  # float cumsums of 0/1 are exact below 2^24
+    w_safe = jnp.maximum(w_g, F32_TINY)
+    mu_g = seg[:, 1] / w_safe
+    spread = jnp.maximum(seg[:, 2] / w_safe - mu_g * mu_g, 0.0)
+    sigma_g = jnp.sqrt(seg[:, 3] / w_safe + spread)
+
+    # singleton groups gather the ORIGINAL component (bitwise parity);
+    # prefix-sum differencing would round its last bits
+    orig = jnp.stack([weights, mus, sigmas], axis=-1)[
+        jnp.clip(bounds[:-1], 0, k - 1)
+    ]  # [out_width, 3]
+    w_out = jnp.where(single, orig[:, 0], jnp.where(live_g, w_g, 0.0))
+    mu_out = jnp.where(single, orig[:, 1], jnp.where(live_g, mu_g, 0.0))
+    s_out = jnp.where(single, orig[:, 2], jnp.where(live_g, sigma_g, 1.0))
+    return w_out, mu_out, s_out
+
+
 def compact_below(obs_row, below_row, lf_pad):
     """Gather the (few) below-set slots of one dim into a small buffer.
 
@@ -100,19 +194,29 @@ def compact_below(obs_row, below_row, lf_pad):
 
 
 def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf,
-                 prior_weight, pad_gamma=None):
+                 prior_weight, pad_gamma=None, above_cap=None):
     """Shared front half of a TPE suggest step: good/bad split + vmapped
     Parzen/categorical fits for every dimension.
 
     Args mirror the ObsBuffer arrays; ``ps_consts`` is PackedSpace._consts.
     Returns a dict with continuous fits (below compacted to [Dc, lf_pad+1],
-    above full [Dc, cap+1]) and categorical posteriors (pb/pa: [Dk, k_max]);
-    entries are None for absent families.
+    above full [Dc, cap+1] or compacted to [Dc, above_pad]) and
+    categorical posteriors (pb/pa: [Dk, k_max]); entries are None for
+    absent families.
 
     ``gamma`` may be a TRACED scalar (the adaptive on-device path tunes
     it per step); the static below-buffer width then needs a host-level
     upper bound -- pass ``pad_gamma`` = the largest gamma the trace can
     produce (None = ``gamma`` itself is static).
+
+    ``above_cap`` (host int, None = full width) caps the ABOVE Parzen
+    model at a fixed component width via :func:`compact_gmm` whenever
+    the buffer would exceed it -- the below model is already compacted
+    (``compact_below``) and the categorical posteriors are [k_max] by
+    construction, so the above model is the only fit whose width (and
+    therefore every [S, K] scoring loop) grows with the observation
+    count.  Identity (bitwise) while the live above components fit
+    under the cap; see :func:`compact_gmm` for the merge contract.
     """
     below, above, _ = split_below_above(losses, valid, gamma, lf)
     out = {"cont": None, "cat": None}
@@ -145,6 +249,12 @@ def fit_all_dims(ps_consts, values, active, losses, valid, gamma, lf,
             lat, act_c & above[None, :],
             ps_consts["prior_mu"], ps_consts["prior_sigma"], pw_v, lf_v,
         )
+        if above_cap is not None:
+            a_pad = _above_pad(above_cap)
+            if wa.shape[1] > a_pad:
+                wa, ma, sa = jax.vmap(
+                    compact_gmm, in_axes=(0, 0, 0, None)
+                )(wa, ma, sa, a_pad)
         out["cont"] = (wb, mb, sb, wa, ma, sa)
 
     cat_idx = ps_consts["cat_idx"]

@@ -151,7 +151,7 @@ def build_sharded_sweep(ps, mesh, n_cand_per_device, axis=CAND_AXIS,
 
 def build_sharded_suggest_fn(
     ps, mesh, n_cand_per_device, gamma, lf, prior_weight, axis=CAND_AXIS,
-    n_cand_cat_per_device=None,
+    n_cand_cat_per_device=None, above_cap=None,
 ):
     """Compile the mesh-sharded TPE step for a PackedSpace.
 
@@ -165,30 +165,38 @@ def build_sharded_suggest_fn(
     categorical EI argmax saturates into pure exploitation once that
     total covers every option (measured -- BASELINE.md NAS table), so
     callers keep the TOTAL categorical draw near the reference's 24.
+
+    ``above_cap`` follows :func:`tpe_jax.build_suggest_fn`'s knob (None
+    = framework default, 0 = full width): the fits are replicated but
+    every device's slab scores against them, so compaction shrinks the
+    per-device sweep the same way it shrinks the unsharded one.
     """
     import jax
 
     from ..ops import kernels as K
+    from ..tpe_jax import _resolve_above_cap
 
     K.check_prior_weight(prior_weight)
     c = ps._consts
     gamma = float(gamma)
     lf_f = float(lf)
     pw = float(prior_weight)
+    a_cap = _resolve_above_cap(above_cap)
     sweep = build_sharded_sweep(
         ps, mesh, n_cand_per_device, axis=axis,
         n_cand_cat_per_device=n_cand_cat_per_device,
     )
 
     def fn(key, values, active, losses, valid, batch):
-        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f,
+                              pw, above_cap=a_cap)
         return sweep(key, fits, batch)
 
     return jax.jit(fn, static_argnames=("batch",))
 
 
 def sharded_draw(domain, buf, mesh, cache_attr, n_per_dev, gamma, lf,
-                 prior_weight, cat_per_dev, key, batch):
+                 prior_weight, cat_per_dev, key, batch, above_cap=None):
     """One warm-path mesh-sharded draw: the cache-keyed builder +
     history placement + device fetch sequence, shared by
     :func:`sharded_suggest` and the adaptive path
@@ -196,18 +204,26 @@ def sharded_draw(domain, buf, mesh, cache_attr, n_per_dev, gamma, lf,
     multi-process placement contracts live in one place."""
     import jax
 
+    from ..tpe_jax import _resolve_above_cap
+
+    a_cap = _resolve_above_cap(above_cap)
     fn = cached_suggest_fn(
         domain, cache_attr,
         (id(mesh), int(n_per_dev), float(gamma), float(lf),
-         float(prior_weight), cat_per_dev),
-        lambda ps_, _mid, n_pd, g, lf_, pw_, cpd: build_sharded_suggest_fn(
-            ps_, mesh, n_pd, g, lf_, pw_, n_cand_cat_per_device=cpd
+         float(prior_weight), cat_per_dev, a_cap),
+        lambda ps_, _mid, n_pd, g, lf_, pw_, cpd, ac: (
+            build_sharded_suggest_fn(
+                ps_, mesh, n_pd, g, lf_, pw_, n_cand_cat_per_device=cpd,
+                above_cap=0 if ac is None else ac,
+            )
         ),
     )
-    return jax.device_get(fn(key, *_history_inputs(buf), batch=batch))
+    return jax.device_get(
+        fn(key, *_history_inputs(buf, pow2_cap=a_cap), batch=batch)
+    )
 
 
-def _history_inputs(buf):
+def _history_inputs(buf, pow2_cap=None):
     """History buffers placed for the current process span.
 
     Single-process (the common case): the ObsBuffer's cached default-
@@ -223,10 +239,10 @@ def _history_inputs(buf):
     import jax
 
     if jax.process_count() == 1:
-        return buf.device_arrays()
+        return buf.device_arrays(pow2_cap=pow2_cap)
     import numpy as np
 
-    b = buf._device_bucket()
+    b = buf._device_bucket(pow2_cap)
     return tuple(np.ascontiguousarray(a[..., :b]) for a in buf.arrays())
 
 
@@ -261,13 +277,15 @@ def sharded_suggest(
     linear_forgetting=_default_linear_forgetting,
     speculative=0,
     max_stale=None,
+    above_cap=None,
 ):
     """``algo=parallel.sharded_suggest``: TPE with the candidate sweep
     sharded over every visible device.  ``n_EI_cat_total`` caps the
     TOTAL categorical draw (split across devices); None follows
     ``n_EI_per_device`` on every device.  ``speculative=k`` serves k
     sequential asks from one mesh-wide dispatch (same cache semantics
-    as :func:`hyperopt_tpu.tpe_jax.suggest`)."""
+    as :func:`hyperopt_tpu.tpe_jax.suggest`).  ``above_cap`` follows
+    :func:`hyperopt_tpu.tpe_jax.suggest`'s above-model compaction knob."""
     import jax
 
     ps = packed_space_for(domain)
@@ -292,6 +310,7 @@ def sharded_suggest(
         return sharded_draw(
             domain, buf, mesh, "_sharded_tpe_cache", n_EI_per_device,
             gamma, linear_forgetting, prior_weight, cat_per_dev, key, batch,
+            above_cap=above_cap,
         )
 
     if speculative and B == 1:
@@ -308,7 +327,7 @@ def sharded_suggest(
             speculative = 0
 
     if speculative and B == 1:
-        from ..tpe_jax import _speculative_cols
+        from ..tpe_jax import _resolve_above_cap, _speculative_cols
 
         params = (
             "sharded", id(mesh), int(n_EI_per_device), cat_per_dev,
@@ -316,6 +335,7 @@ def sharded_suggest(
             int(n_startup_jobs), id(trials), int(speculative),
             # resolved staleness budget (see tpe_jax.suggest's key)
             int(speculative) - 1 if max_stale is None else int(max_stale),
+            _resolve_above_cap(above_cap),
         )
         values, active = _speculative_cols(
             domain, trials, seed, int(speculative), max_stale, params,

@@ -47,8 +47,23 @@ _default_n_startup_jobs = 20
 _default_linear_forgetting = 25
 
 
+def _resolve_above_cap(above_cap):
+    """Resolve the above-model compaction knob shared by every suggest
+    builder: ``None`` -> the framework default
+    (:data:`hyperopt_tpu.ops.kernels.DEFAULT_ABOVE_CAP`), ``0`` (or any
+    non-positive value) -> disabled (full-width scoring), an int -> that
+    cap.  Returns the host int handed to ``fit_all_dims`` (None when
+    disabled)."""
+    if above_cap is None:
+        from .ops import kernels as K
+
+        return int(K.DEFAULT_ABOVE_CAP)
+    cap = int(above_cap)
+    return cap if cap > 0 else None
+
+
 def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
-                     n_cand_cat=None):
+                     n_cand_cat=None, above_cap=None):
     """Compile the full TPE suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
@@ -63,6 +78,16 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
     reference's 24 preserves draw-randomness exploration; continuous
     dims, whose llr landscape is continuous, do benefit from more.
     Ignored under ``joint_ei`` (joint scoring needs one S across dims).
+
+    ``above_cap`` (None = :data:`ops.kernels.DEFAULT_ABOVE_CAP`, 0 =
+    disabled) caps the ABOVE Parzen model at a fixed component width
+    (:func:`ops.kernels.compact_gmm`): the above model is the only fit
+    whose width tracks the observation count, so full-width scoring is
+    the linear term that collapsed suggest throughput ~28x between 500
+    and 10k observations (BASELINE.md 10k-soak row).  Below the cap the
+    compaction is the identity and the suggestion stream is bitwise
+    unchanged; above it, merged near-duplicate components approximate
+    the same density at O(above_cap) scoring cost.
 
     ``joint_ei=False`` (default) keeps the reference's factorized
     posterior: each hyperparameter's EI argmax is taken independently
@@ -100,9 +125,11 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
     lf_f = float(lf)
     pw = float(prior_weight)
     n_cat = int(n_cand) if n_cand_cat is None else max(1, int(n_cand_cat))
+    a_cap = _resolve_above_cap(above_cap)
 
     def fn_factorized(key, values, active, losses, valid, batch):
-        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f,
+                              pw, above_cap=a_cap)
         new_values = jnp.zeros((D, batch), dtype=jnp.float32)
 
         n_keys = batch * (Dc + Dk)
@@ -126,7 +153,8 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
         return new_values, ps.active_fn(new_values)
 
     def fn_joint(key, values, active, losses, valid, batch):
-        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f, pw)
+        fits = K.fit_all_dims(c, values, active, losses, valid, gamma, lf_f,
+                              pw, above_cap=a_cap)
         n_keys = batch * (Dc + Dk)
         keys = jax.random.split(key, max(n_keys, 1))
 
@@ -187,6 +215,7 @@ def suggest_dense(
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
     n_EI_candidates_cat=_default_n_EI_candidates_cat,
+    above_cap=None,
 ):
     """Dense draws for a batch: (values [D, batch], active [D, batch]) as
     host numpy -- one device program (prior during startup, TPE after).
@@ -204,15 +233,22 @@ def suggest_dense(
         n_cat = (
             None if n_EI_candidates_cat is None else int(n_EI_candidates_cat)
         )
+        a_cap = _resolve_above_cap(above_cap)
         fn = cached_suggest_fn(
             domain, "_tpe_jax_cache",
             (int(n_EI_candidates), float(gamma), float(linear_forgetting),
-             float(prior_weight), bool(joint_ei), n_cat),
-            lambda ps_, nc, g, lf, pw, je, ncc: build_suggest_fn(
-                ps_, nc, g, lf, pw, joint_ei=je, n_cand_cat=ncc
+             float(prior_weight), bool(joint_ei), n_cat, a_cap),
+            lambda ps_, nc, g, lf, pw, je, ncc, ac: build_suggest_fn(
+                ps_, nc, g, lf, pw, joint_ei=je, n_cand_cat=ncc,
+                above_cap=0 if ac is None else ac,
             ),
         )
-        values, active = fn(key, *buf.device_arrays(), batch=batch)
+        # with compaction active the scoring width is static, so the
+        # device view stops pow2 re-bucketing past the cap (fewer
+        # retraces; only the cheap fit pays the coarser padding)
+        values, active = fn(
+            key, *buf.device_arrays(pow2_cap=a_cap), batch=batch
+        )
 
     return jax.device_get((values, active))
 
@@ -229,6 +265,7 @@ def suggest_batch(
     linear_forgetting=_default_linear_forgetting,
     joint_ei=False,
     n_EI_candidates_cat=_default_n_EI_candidates_cat,
+    above_cap=None,
 ):
     """Sparse (idxs, vals) for a batch of ids -- one device program for the
     whole batch (B trials x D dims x n_EI_candidates candidates)."""
@@ -242,6 +279,7 @@ def suggest_batch(
         linear_forgetting=linear_forgetting,
         joint_ei=joint_ei,
         n_EI_candidates_cat=n_EI_candidates_cat,
+        above_cap=above_cap,
     )
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     return _cast_vals(ps, idxs, vals)
@@ -353,6 +391,7 @@ def suggest(
     n_EI_candidates_cat=_default_n_EI_candidates_cat,
     speculative=0,
     max_stale=None,
+    above_cap=None,
 ):
     """The TPU plugin-boundary entry point: ``algo=tpe_jax.suggest``.
 
@@ -388,6 +427,7 @@ def suggest(
         linear_forgetting=linear_forgetting,
         joint_ei=joint_ei,
         n_EI_candidates_cat=n_EI_candidates_cat,
+        above_cap=above_cap,
     )
     if speculative and len(new_ids) == 1:
         ps = packed_space_for(domain)
@@ -413,6 +453,9 @@ def suggest(
             # the RESOLVED staleness budget: partials differing only in
             # max_stale must not pop each other's cached columns
             int(speculative) - 1 if max_stale is None else int(max_stale),
+            # resolved compaction cap: different caps trace different
+            # programs, so their columns must never be served across
+            _resolve_above_cap(above_cap),
         )
         values, active = _speculative_cols(
             domain, trials, seed, int(speculative), max_stale, params,

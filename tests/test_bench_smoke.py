@@ -19,6 +19,7 @@ def test_bench_prints_one_json_line():
     env["BENCH_N_CAND"] = "16"
     env["BENCH_N_OBS"] = "60"
     env["BENCH_N_TRIALS"] = "40"
+    env["BENCH_OBS_SWEEP"] = "60,120"  # CI-sized obs-scaling sweep
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=900, env=env,
@@ -46,3 +47,11 @@ def test_bench_prints_one_json_line():
     # exist (None off-accelerator)
     assert d["compilation_cache"] in (True, False)
     assert "asha_device_seconds" in d and "asha_device_speedup_x" in d
+    # round-6: the obs-scaling sweep stamps compacted + full-width
+    # throughput per history size, plus the active compaction cap
+    assert d["above_cap"] > 0
+    assert [r["n_obs"] for r in d["obs_scaling"]] == [60, 120]
+    for r in d["obs_scaling"]:
+        assert r["suggestions_per_sec"] > 0
+        assert r["full_width_suggestions_per_sec"] > 0
+        assert r["compaction_speedup_x"] > 0

@@ -73,6 +73,37 @@ def test_queue_complete_and_reap(tmp_path):
     assert q.counts() == {"new": 1, "running": 0, "done": 1}
 
 
+def test_queue_reserve_refreshes_stale_mtime_before_rename(tmp_path):
+    """ADVICE r5: a job that waited in new/ longer than reserve_timeout
+    must NOT carry its stale mtime through the CAS rename into running/
+    -- in the window before _write_atomic rewrites the claim, a
+    concurrent reaper would see an already-expired RUNNING file and
+    recycle the live claim (duplicated evaluation).  The _write_atomic
+    rewrite is stubbed out to hold the window open, so the test sees
+    exactly the mtime the rename carried."""
+    from hyperopt_tpu.distributed import filequeue
+
+    q = FileJobQueue(str(tmp_path / "q"))
+    q.publish(make_doc(0))
+    src = os.path.join(str(tmp_path / "q"), "new", "0.json")
+    stale = time.time() - 3600  # waited an hour in new/
+    os.utime(src, (stale, stale))
+
+    real_write = filequeue._write_atomic
+    try:
+        filequeue._write_atomic = lambda path, doc: None  # hold the window
+        d = q.reserve("w1")
+    finally:
+        filequeue._write_atomic = real_write
+    assert d is not None and d["tid"] == 0
+    dst = os.path.join(str(tmp_path / "q"), "running", "0.json")
+    # the rename itself carried a fresh claim timestamp
+    assert time.time() - os.path.getmtime(dst) < 60
+    # and a reaper inside the window leaves the live claim alone
+    assert q.reap(reserve_timeout=120) == 0
+    assert q.counts()["running"] == 1
+
+
 def test_attachments_roundtrip(tmp_path):
     q = FileJobQueue(str(tmp_path / "q"))
     q.attachments["blob/with:odd chars"] = b"\x00\x01\x02"

@@ -503,6 +503,35 @@ class _KillableQuad:
         return budgeted_quad(cfg, budget)
 
 
+class _BlockerQuad:
+    """budgeted_quad whose FIRST call (when armed) blocks until every
+    other job drained, then dies -- so the last snapshot written holds
+    the blocked job in ``pending``.  A CLASS for the same reason as
+    :class:`_KillableQuad`: the asha guard fingerprints the objective,
+    so the killed and resumed runs must present the same identity."""
+
+    def __init__(self, arm=False):
+        import threading
+
+        self.arm = arm
+        self.n_calls = 0
+        self.blocked_x = []
+        self.drained = threading.Event()
+        self.call_lock = threading.Lock()
+
+    def __call__(self, cfg, budget):
+        with self.call_lock:
+            i = self.n_calls
+            self.n_calls += 1
+            if self.n_calls >= 40:
+                self.drained.set()
+        if self.arm and i == 0:
+            self.blocked_x.append(round(cfg["x"], 9))
+            assert self.drained.wait(timeout=120)
+            raise KeyboardInterrupt
+        return budgeted_quad(cfg, budget)
+
+
 def _sha_digest(out):
     return (
         out["best_loss"], out["best"]["x"], out["rungs"],
@@ -664,26 +693,21 @@ def test_asha_checkpoint_resume_bitwise(tmp_path):
         )
 
     ref = digest(asha(
-        budgeted_quad, SPACE, rstate=np.random.default_rng(7), **kw
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(7), **kw
     ))
-
-    calls = [0]
-
-    def dies_at_13(cfg, budget):
-        calls[0] += 1
-        if calls[0] == 13:
-            raise KeyboardInterrupt  # BaseException: not caught as a
-            # failed eval; surfaces through the worker future like a kill
-        return budgeted_quad(cfg, budget)
 
     path = str(tmp_path / "asha.ckpt")
     with pytest.raises(KeyboardInterrupt):
+        # KeyboardInterrupt is a BaseException: not caught as a failed
+        # eval; surfaces through the worker future like a kill.  A
+        # _KillableQuad (stable class identity), not a closure: the
+        # guard now fingerprints the objective like sha/hyperband do
         asha(
-            dies_at_13, SPACE, rstate=np.random.default_rng(7),
+            _KillableQuad(13), SPACE, rstate=np.random.default_rng(7),
             checkpoint=path, **kw
         )
     resumed = digest(asha(
-        budgeted_quad, SPACE, rstate=np.random.default_rng(7),
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(7),
         checkpoint=path, **kw
     ))
     assert resumed == ref
@@ -697,26 +721,19 @@ def test_asha_checkpoint_guard_and_multiworker_invariants(tmp_path):
     from hyperopt_tpu.hyperband import asha
 
     path = str(tmp_path / "asha.ckpt")
-    calls = [0]
-
-    def dies_at_17(cfg, budget):
-        calls[0] += 1
-        if calls[0] == 17:
-            raise KeyboardInterrupt
-        return budgeted_quad(cfg, budget)
 
     with pytest.raises(KeyboardInterrupt):
         asha(
-            dies_at_17, SPACE, max_budget=9, eta=3, max_jobs=40,
+            _KillableQuad(17), SPACE, max_budget=9, eta=3, max_jobs=40,
             workers=4, rstate=np.random.default_rng(0), checkpoint=path,
         )
     with pytest.raises(ValueError, match="refusing to resume"):
         asha(
-            budgeted_quad, SPACE, max_budget=4, eta=2, max_jobs=40,
+            _KillableQuad(), SPACE, max_budget=4, eta=2, max_jobs=40,
             workers=4, rstate=np.random.default_rng(0), checkpoint=path,
         )
     out = asha(
-        budgeted_quad, SPACE, max_budget=9, eta=3, max_jobs=40,
+        _KillableQuad(), SPACE, max_budget=9, eta=3, max_jobs=40,
         workers=4, rstate=np.random.default_rng(0), checkpoint=path,
     )
     trials = out["trials"]
@@ -738,35 +755,19 @@ def test_asha_checkpoint_requeues_in_flight_suggestion(tmp_path):
     tid.  Two workers: the first call blocks until the other worker has
     drained every remaining job (so the last snapshot written contains
     the blocked job in ``pending``), then dies."""
-    import threading
-
     from hyperopt_tpu.hyperband import asha
 
     path = str(tmp_path / "asha.ckpt")
-    n_calls = [0]
-    blocked_x = []
-    drained = threading.Event()
-    call_lock = threading.Lock()
-
-    def blocker(cfg, budget):
-        with call_lock:
-            i = n_calls[0]
-            n_calls[0] += 1
-            if n_calls[0] >= 40:
-                drained.set()
-        if i == 0:
-            blocked_x.append(round(cfg["x"], 9))
-            assert drained.wait(timeout=120)
-            raise KeyboardInterrupt
-        return budgeted_quad(cfg, budget)
+    armed = _BlockerQuad(arm=True)
 
     with pytest.raises(KeyboardInterrupt):
         asha(
-            blocker, SPACE, max_budget=9, eta=3, max_jobs=40, workers=2,
+            armed, SPACE, max_budget=9, eta=3, max_jobs=40, workers=2,
             rstate=np.random.default_rng(5), checkpoint=path,
         )
+    blocked_x = armed.blocked_x
     out = asha(
-        budgeted_quad, SPACE, max_budget=9, eta=3, max_jobs=40,
+        _BlockerQuad(), SPACE, max_budget=9, eta=3, max_jobs=40,
         workers=2, rstate=np.random.default_rng(5), checkpoint=path,
     )
     trials = out["trials"]
@@ -834,13 +835,6 @@ def test_asha_checkpoint_refuses_different_algo(tmp_path):
     from hyperopt_tpu.hyperband import asha
 
     path = str(tmp_path / "asha.ckpt")
-    calls = [0]
-
-    def dies_at_5(cfg, budget):
-        calls[0] += 1
-        if calls[0] == 5:
-            raise KeyboardInterrupt
-        return budgeted_quad(cfg, budget)
 
     def my_algo(new_ids, domain, trials, seed):
         return rand.suggest(new_ids, domain, trials, seed)
@@ -848,17 +842,45 @@ def test_asha_checkpoint_refuses_different_algo(tmp_path):
     kw = dict(max_budget=9, eta=3, max_jobs=12, workers=1)
     with pytest.raises(KeyboardInterrupt):
         asha(
-            dies_at_5, SPACE, algo=my_algo,
+            _KillableQuad(5), SPACE, algo=my_algo,
             rstate=np.random.default_rng(0), checkpoint=path, **kw
         )
     with pytest.raises(ValueError, match="refusing to resume"):
         asha(  # defaulted algo (rand.suggest) != my_algo
-            budgeted_quad, SPACE, rstate=np.random.default_rng(0),
+            _KillableQuad(), SPACE, rstate=np.random.default_rng(0),
             checkpoint=path, **kw
         )
     out = asha(  # partial of the SAME algo unwraps to a match
-        budgeted_quad, SPACE, algo=functools.partial(my_algo),
+        _KillableQuad(), SPACE, algo=functools.partial(my_algo),
         rstate=np.random.default_rng(0), checkpoint=path, **kw
+    )
+    assert len(out["trials"]) == 12
+
+
+def test_asha_checkpoint_refuses_different_objective(tmp_path):
+    """ADVICE r5 medium: the asha guard must fingerprint the OBJECTIVE
+    like the sha/hyperband guards already do -- resuming a snapshot with
+    an edited objective would silently mix the old objective's recorded
+    losses with new evaluations of the new one.  Same stable-class
+    protocol as the sha tests: the unchanged objective resumes, a
+    different class is refused."""
+    from hyperopt_tpu.hyperband import asha
+
+    path = str(tmp_path / "asha.ckpt")
+    kw = dict(max_budget=9, eta=3, max_jobs=12, workers=1)
+    with pytest.raises(KeyboardInterrupt):
+        asha(
+            _KillableQuad(5), SPACE, rstate=np.random.default_rng(3),
+            checkpoint=path, **kw
+        )
+    with pytest.raises(ValueError, match="refusing to resume"):
+        asha(  # a DIFFERENT objective class: refused
+            _BlockerQuad(), SPACE, rstate=np.random.default_rng(3),
+            checkpoint=path, **kw
+        )
+    out = asha(  # the unchanged objective (same class): resumes
+        _KillableQuad(), SPACE, rstate=np.random.default_rng(3),
+        checkpoint=path, **kw
     )
     assert len(out["trials"]) == 12
 
@@ -874,6 +896,23 @@ def test_asha_evaluator_arity_validated():
             budgeted_quad, SPACE, max_budget=4, max_jobs=2, workers=1,
             evaluator=lambda vals, budget: 0.0,
         )
+
+
+def test_evaluator_arity_check_accepts_uninspectable_builtins():
+    """ADVICE r5: ``inspect.signature`` raises ValueError for some
+    C-implemented callables (``min`` on this CPython) -- the pre-check
+    must SKIP those, not crash the driver with an unrelated error, while
+    still rejecting introspectable mismatches."""
+    import inspect
+
+    from hyperopt_tpu.hyperband import _check_evaluator_arity
+
+    with pytest.raises(ValueError):
+        inspect.signature(min)  # the premise: min is un-introspectable
+    _check_evaluator_arity(min)  # must not raise
+    _check_evaluator_arity(lambda vals, cfg, budget: 0.0)
+    with pytest.raises(TypeError, match="vals, cfg, budget"):
+        _check_evaluator_arity(lambda vals, budget: 0.0)
 
 
 def test_asha_checkpoint_every_validated(tmp_path):

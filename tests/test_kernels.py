@@ -315,6 +315,155 @@ def test_ei_sweep_fused_b1_matches_grouped():
     assert np.array_equal(np.asarray(s1[0]), np.asarray(s2[0]))
 
 
+# -- above-model compaction (round 6) ---------------------------------------
+
+
+def _wide_parzen_fit(n_live, width, seed=0, spread=2.0):
+    """One parzen_fit row with ``n_live - 1`` observations (+ prior) in a
+    ``width - 1``-slot buffer -- the raw material compact_gmm consumes."""
+    rng = np.random.default_rng(seed)
+    obs = np.zeros(width - 1, np.float32)
+    mask = np.zeros(width - 1, bool)
+    obs[: n_live - 1] = rng.normal(0, spread, n_live - 1)
+    mask[: n_live - 1] = True
+    return K.parzen_fit(
+        f32(obs), jnp.asarray(mask), f32(0.0), f32(8.0), f32(1.0), f32(25.0)
+    )
+
+
+def test_compact_gmm_identity_below_cap_bitwise():
+    """PARITY CONTRACT: while the live component count fits under the
+    cap, compaction is the identity -- the output slots are BITWISE the
+    input's first ``cap`` slots (live prefix + zero-weight padding), so
+    every downstream score reduction sees the same live terms."""
+    for n_live, width, cap in ((50, 257, 64), (64, 1025, 64), (2, 129, 8)):
+        w, m, s = _wide_parzen_fit(n_live, width, seed=n_live)
+        wo, mo, so = K.compact_gmm(w, m, s, cap)
+        assert np.array_equal(np.asarray(wo), np.asarray(w)[:cap])
+        assert np.array_equal(np.asarray(mo), np.asarray(m)[:cap])
+        assert np.array_equal(np.asarray(so), np.asarray(s)[:cap])
+
+
+def test_compact_gmm_preserves_mixture_moments():
+    """Above the cap, moment-matched merging preserves the mixture's
+    total mass, mean, and second moment -- the compacted above model is
+    the same density coarse-grained, not a reweighted one."""
+    w, m, s = _wide_parzen_fit(801, 1025, seed=3)
+    wo, mo, so = K.compact_gmm(w, m, s, 64)
+    w_, m_, s_ = (np.asarray(a) for a in (w, m, s))
+    wo_, mo_, so_ = (np.asarray(a) for a in (wo, mo, so))
+    assert (wo_ > 0).sum() == 64  # full cap utilized
+    np.testing.assert_allclose(wo_.sum(), w_.sum(), rtol=1e-6)
+    np.testing.assert_allclose(
+        (wo_ * mo_).sum(), (w_ * m_).sum(), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        (wo_ * (mo_**2 + so_**2)).sum(),
+        (w_ * (m_**2 + s_**2)).sum(), rtol=1e-5,
+    )
+    # zero-weight output slots carry the padded-slot convention
+    # (mu 0, sigma 1) every consumer already handles
+    w2, m2, s2 = _wide_parzen_fit(5, 257, seed=4)
+    wo2, mo2, so2 = K.compact_gmm(w2, m2, s2, 64)
+    pad = np.asarray(wo2) == 0
+    assert pad.any()
+    assert np.array_equal(np.asarray(mo2)[pad], np.zeros(pad.sum()))
+    assert np.array_equal(np.asarray(so2)[pad], np.ones(pad.sum()))
+
+
+def test_compact_gmm_density_stays_close():
+    """The compacted mixture must score like the full one: its density
+    is a locally-averaged version of the full density (adjacent-in-mu
+    merges), so pointwise agreement should be tight relative to the
+    density scale even at a ~12x merge ratio."""
+    w, m, s = _wide_parzen_fit(801, 1025, seed=5)
+    wo, mo, so = K.compact_gmm(w, m, s, 64)
+    x = f32(np.linspace(-8, 8, 201))
+    args = (f32(-jnp.inf), f32(jnp.inf), jnp.asarray(False), f32(0.0))
+    full = np.exp(np.asarray(K.trunc_gmm_logpdf(x, w, m, s, *args)))
+    comp = np.exp(np.asarray(K.trunc_gmm_logpdf(x, wo, mo, so, *args)))
+    assert np.abs(full - comp).max() < 0.35 * full.max()
+    assert np.abs(full - comp).mean() < 0.02 * full.max()
+
+
+def test_fit_all_dims_above_cap_scoring_parity():
+    """ACCEPTANCE PIN (round 6): whenever the live above-model component
+    count is <= the compaction cap, compacted scoring must match
+    full-width scoring -- the compacted fit is bitwise the full fit
+    (identity grouping) and the EI sweep's drawn candidates are bitwise
+    identical.  The per-candidate float scores agree to the reduction's
+    last ulp (XLA associates the sum differently across widths; the live
+    terms and the padded exact-zero terms are identical either way)."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.ops.compile import compile_space
+
+    space = {
+        "u": hp.uniform("u", -5.0, 5.0),
+        "qu": hp.quniform("qu", 0.0, 20.0, 1.0),
+        "lu": hp.loguniform("lu", -4.0, 2.0),
+    }
+    ps = compile_space(space)
+    c = ps._consts
+    cap = 512
+    rng = np.random.default_rng(0)
+    values, active = jax.device_get(ps.sample_prior(jax.random.key(0), cap))
+    valid = np.zeros(cap, bool)
+    valid[:50] = True  # ~47 above obs + prior: far under the cap of 64
+    losses = rng.uniform(0, 10, cap).astype(np.float32)
+    args = (
+        c, jnp.asarray(values), jnp.asarray(active), jnp.asarray(losses),
+        jnp.asarray(valid), 0.25, 25.0, 1.0,
+    )
+    f_full = K.fit_all_dims(*args)
+    f_comp = K.fit_all_dims(*args, above_cap=64)
+    assert f_full["cont"][3].shape[1] == cap + 1
+    assert f_comp["cont"][3].shape[1] == 64
+    for full_a, comp_a in zip(f_full["cont"][3:], f_comp["cont"][3:]):
+        assert np.array_equal(np.asarray(full_a)[:, :64], np.asarray(comp_a))
+    # below-model fits are untouched by the above cap
+    for full_b, comp_b in zip(f_full["cont"][:3], f_comp["cont"][:3]):
+        assert np.array_equal(np.asarray(full_b), np.asarray(comp_b))
+
+    dc = len(ps.cont_idx)
+    keys = jax.random.split(jax.random.key(1), 3 * dc).reshape(3, dc)
+    v1, s1 = K.ei_sweep_cont(ps.q, c, keys, f_full["cont"], 16)
+    v2, s2 = K.ei_sweep_cont(ps.q, c, keys, f_comp["cont"], 16)
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(s2), rtol=0, atol=1e-5
+    )
+
+
+def test_fit_all_dims_above_cap_engages_past_cap():
+    """Past the cap the above model really is capped (width AND live
+    count), the below split is untouched, and the sweep still returns
+    in-bounds draws."""
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.ops.compile import compile_space
+
+    ps = compile_space({"x": hp.uniform("x", -5.0, 5.0)})
+    c = ps._consts
+    cap = 1024
+    rng = np.random.default_rng(1)
+    values = rng.uniform(-5, 5, (1, cap)).astype(np.float32)
+    active = np.ones((1, cap), bool)
+    losses = rng.uniform(0, 10, cap).astype(np.float32)
+    valid = np.ones(cap, bool)
+    fits = K.fit_all_dims(
+        c, jnp.asarray(values), jnp.asarray(active), jnp.asarray(losses),
+        jnp.asarray(valid), 0.25, 25.0, 1.0, above_cap=128,
+    )
+    wa = np.asarray(fits["cont"][3])
+    assert wa.shape == (1, 128)
+    assert (wa > 0).sum() == 128
+    np.testing.assert_allclose(wa.sum(), 1.0, rtol=1e-5)
+    keys = jax.random.split(jax.random.key(2), 1).reshape(1, 1)
+    v, s = K.ei_sweep_cont(ps.q, c, keys, fits["cont"], 32)
+    v = np.asarray(v)
+    assert np.isfinite(v).all() and (v >= -5).all() and (v <= 5).all()
+    assert np.isfinite(np.asarray(s)).all()
+
+
 def test_ei_sweep_single_group_batch_rows_independent():
     """Regression (round 5): the identity-group fast path must never
     collapse a B > 1 batch onto row 0's keys -- every row draws with its

@@ -68,18 +68,37 @@ def _make_doc(tid, exp_key=None):
     }
 
 
-def test_reserve_cas_orders_by_tid_and_is_exclusive(fake_mongo):
+def test_reserve_cas_orders_by_insertion_and_is_exclusive(fake_mongo):
+    """Reservation order is INSERTION order (``_id``), not tid order:
+    type-neutral across numeric and string tids (ADVICE r5 -- a tid
+    sort would starve asha_mongo's string tids behind numerics)."""
     from hyperopt_tpu.distributed.mongo import MongoJobs
 
     jobs = MongoJobs.new_from_connection_str("localhost:27017/db_cas")
     for tid in (2, 0, 1):
         jobs.publish(_make_doc(tid))
     d = jobs.reserve("w1")
-    assert d["tid"] == 0 and d["state"] == JOB_STATE_RUNNING
+    assert d["tid"] == 2 and d["state"] == JOB_STATE_RUNNING
     assert d["owner"] == "w1" and d["book_time"] is not None
-    assert jobs.reserve("w2")["tid"] == 1
-    assert jobs.reserve("w3")["tid"] == 2
+    assert jobs.reserve("w2")["tid"] == 0
+    assert jobs.reserve("w3")["tid"] == 1
     assert jobs.reserve("w4") is None  # drained
+
+
+def test_reserve_mixed_tid_types_no_starvation(fake_mongo):
+    """ADVICE r5: numeric-tid (fmin) and string-tid (asha_mongo) jobs
+    coexisting on one collection are served in publication order -- BSON
+    orders every number before every string, so the old tid sort would
+    hand out 1, 2 first and starve the string tids behind any numeric
+    backlog."""
+    from hyperopt_tpu.distributed.mongo import MongoJobs
+
+    jobs = MongoJobs.new_from_connection_str("localhost:27017/db_mixed")
+    for tid in ("asha-0", 1, "asha-1", 2):
+        jobs.publish(_make_doc(tid))
+    order = [jobs.reserve(f"w{i}")["tid"] for i in range(4)]
+    assert order == ["asha-0", 1, "asha-1", 2]
+    assert jobs.reserve("w") is None
 
 
 def test_reserve_contention_each_job_taken_once(fake_mongo):

@@ -896,6 +896,92 @@ def test_device_arrays_bucket_by_live_count():
     )
 
 
+def test_device_bucket_stops_pow2_rebucketing_past_compaction_cap():
+    """Round 6: with an above-model compaction cap, the scoring width is
+    static past the cap, so the device bucket stops growing at every
+    pow2 crossing there and rides GROWTH_FACTOR steps instead -- fewer
+    retraces at large histories, identical schedule below the cap."""
+    ps = compile_space(SPACE)
+    buf = ObsBuffer(ps)
+    seen_plain, seen_capped = [], []
+    for i in range(12_000):
+        buf.add({"x": float(i % 7)}, float(i % 11))
+        for seen, cap in ((seen_plain, None), (seen_capped, 512)):
+            b = buf._device_bucket(pow2_cap=cap)
+            if not seen or seen[-1] != b:
+                seen.append(b)
+    assert seen_plain == [128, 256, 512, 1024, 2048, 4096, 8192, 16384]
+    # below the cap: identical; past it: one 4x step per growth
+    assert seen_capped == [128, 256, 512, 2048, 8192, 32768]
+    # the device view follows the capped bucket
+    arrs = buf.device_arrays(pow2_cap=512)
+    assert arrs[0].shape[1] == 32768
+
+
+def _mixed_history(n_obs, seed=0):
+    """A completed synthetic history on the 20-dim mixed space."""
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+
+    domain = Domain(mixed_space_fn, mixed_space())
+    trials = Trials()
+    rng = np.random.default_rng(seed)
+    ids = trials.new_trial_ids(n_obs)
+    docs = rand.suggest(ids, domain, trials, seed=seed)
+    for doc in docs:
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(rng.uniform(0, 10))}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    return domain, trials
+
+
+def test_suggest_dense_above_cap_parity_below_cap():
+    """ACCEPTANCE PIN (round 6): on a history whose above set fits under
+    the compaction cap, the full suggest program (compacted) emits a
+    BITWISE identical suggestion stream to full-width scoring -- the
+    end-to-end form of the kernel-level parity pin.  50 obs in a
+    128-wide bucket with cap 64: compaction is compiled in (width 129 >
+    pad 64) but mathematically the identity."""
+    domain, trials = _mixed_history(50)
+    v_comp, a_comp = tpe_jax.suggest_dense(domain, trials, 7, 4,
+                                           above_cap=64)
+    v_full, a_full = tpe_jax.suggest_dense(domain, trials, 7, 4,
+                                           above_cap=0)
+    assert np.array_equal(np.asarray(v_comp), np.asarray(v_full))
+    assert np.array_equal(np.asarray(a_comp), np.asarray(a_full))
+    # the two settings trace distinct cached programs (the cap is part
+    # of the compile-cache key: serving one for the other would be a
+    # silent width mismatch)
+    assert len(domain._tpe_jax_cache) == 2
+
+
+def test_suggest_dense_compaction_past_cap_quality_sane():
+    """Past the cap the stream may differ from full-width, but the
+    draws must stay in-bounds, finite, and the posterior must still
+    steer: on a quadratic with 700 completed obs, compacted TPE's
+    suggestions concentrate far tighter around the optimum than the
+    prior does."""
+    from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+
+    domain = Domain(quad, SPACE)
+    trials = Trials()
+    rng = np.random.default_rng(3)
+    ids = trials.new_trial_ids(700)
+    docs = rand.suggest(ids, domain, trials, seed=0)
+    for doc in docs:
+        x = doc["misc"]["vals"]["x"][0]
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(quad(x))}
+    trials.insert_trial_docs(docs)
+    trials.refresh()
+    v, a = tpe_jax.suggest_dense(domain, trials, 11, 64, above_cap=128)
+    xs = np.asarray(v)[0]
+    assert np.isfinite(xs).all() and (xs >= -10).all() and (xs <= 10).all()
+    # TPE spread around the optimum far under the prior's ~5.0
+    assert float(np.median(np.abs(xs - 3.0))) < 2.0
+
+
 def test_async_plus_speculative_combination():
     """The production mode for remote-attached chips: async evaluation
     (ThreadTrials) with speculative k-ahead suggests. Must complete,
