@@ -25,13 +25,20 @@ for the environments a TPU framework actually runs in:
                  requires pymongo, import-gated.
 ``spark``     -- ``SparkTrials``: dispatcher-thread + one-task Spark jobs;
                  requires pyspark, import-gated.
+``faults``    -- ``FaultPlan``: seeded deterministic fault injection for
+                 the filesystem seam the queue/worker stack runs on
+                 (transient errno faults, latency, partial writes, named
+                 crash points) -- the chaos suite's substrate.
+``fsck``      -- recovery audit/repair for a queue directory
+                 (``python -m hyperopt_tpu.distributed.fsck --dir D``).
 """
 
 from .threads import ThreadTrials
 from .filequeue import FileTrials, FileJobQueue
+from .faults import FaultPlan, REAL_FS
 
 __all__ = [
-    "ThreadTrials", "FileTrials", "FileJobQueue",
+    "ThreadTrials", "FileTrials", "FileJobQueue", "FaultPlan", "REAL_FS",
     "asha_filequeue", "asha_mongo", "asha_spark", "BudgetedDomainFn",
 ]
 
@@ -39,6 +46,10 @@ __all__ = [
 def __getattr__(name):
     import importlib
 
+    if name in ("fsck",):
+        mod = importlib.import_module(".fsck", __name__)
+        globals()["fsck"] = mod
+        return mod
     if name in (
         "asha_queue", "asha_filequeue", "asha_mongo", "asha_spark",
         "BudgetedDomainFn",

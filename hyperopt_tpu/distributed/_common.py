@@ -10,15 +10,90 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import errno
 import logging
 import threading
 import time
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["blob_key_from_doc", "TTLSet", "lru_get", "claim_heartbeat"]
+__all__ = [
+    "blob_key_from_doc", "TTLSet", "lru_get", "claim_heartbeat",
+    "with_retries", "is_transient", "TRANSIENT_ERRNOS",
+]
 
 DEFAULT_DOMAIN_KEY = "FMinIter_Domain"
+
+# The errno classes a flaky network mount (NFS / GCS FUSE) emits for
+# operations that are perfectly retryable: the handle went stale under
+# a server restart (ESTALE), the transport hiccuped (EIO/ETIMEDOUT/
+# ECONNRESET), or the kernel asked us to try again (EAGAIN/EINTR/EBUSY).
+# ENOENT is deliberately ABSENT: FileNotFoundError is a protocol signal
+# in the queue (a lost CAS race, a reaped claim), never a blip.
+TRANSIENT_ERRNOS = frozenset({
+    errno.ESTALE, errno.EIO, errno.EAGAIN, errno.EINTR, errno.EBUSY,
+    errno.ETIMEDOUT, errno.ECONNRESET, errno.ENOBUFS, errno.EREMOTEIO,
+})
+
+# pymongo's retryable family, matched by mro NAME because pymongo is an
+# optional (import-gated) dependency: AutoReconnect covers primary
+# stepdowns and dropped sockets, NetworkTimeout subclasses it, and the
+# test doubles can participate by naming an exception class the same.
+_TRANSIENT_MONGO_NAMES = frozenset({
+    "AutoReconnect", "NetworkTimeout", "NotPrimaryError",
+})
+
+
+def is_transient(exc):
+    """Classify an exception as a retryable transport blip vs a real
+    failure -- the transient-vs-fatal contract of
+    :class:`hyperopt_tpu.exceptions.BackendError`."""
+    from ..exceptions import FatalBackendError, TransientBackendError
+
+    if isinstance(exc, FatalBackendError):
+        return False
+    if isinstance(exc, TransientBackendError):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in TRANSIENT_ERRNOS
+    return any(
+        c.__name__ in _TRANSIENT_MONGO_NAMES for c in type(exc).__mro__
+    )
+
+
+def with_retries(fn, attempts=10, base_delay=0.005, max_delay=0.05,
+                 sleep=time.sleep, classify=is_transient, label=None):
+    """Call ``fn()``; on a transient failure (per ``classify``) retry
+    with exponential backoff, up to ``attempts`` total calls.
+
+    ``attempts=10`` covers the worst compound case a burst-bounded
+    fault schedule can produce: a 4-primitive composite (open + write +
+    fsync + rename) with up to 2 consecutive failures per primitive
+    needs 9 calls to converge.
+
+    The shared hardening scaffold both queue backends thread through
+    reserve/complete/reap/refresh/heartbeat: an ESTALE from a bounced
+    NFS server or an AutoReconnect from a mongo stepdown costs a few
+    milliseconds of backoff instead of a dead worker.  Non-transient
+    exceptions (FileNotFoundError CAS losses, JSON decode errors,
+    FatalBackendError) propagate immediately -- retrying a protocol
+    signal would only mask bugs.  Delays are capped at ``max_delay``
+    (50 ms default) so the deterministic chaos suite never waits on a
+    real-world backoff schedule.
+    """
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt == attempts - 1 or not classify(e):
+                raise
+            delay = min(max_delay, base_delay * (2 ** attempt))
+            logger.debug(
+                "transient failure in %s (attempt %d/%d), retrying in "
+                "%.0f ms: %s", label or getattr(fn, "__name__", "op"),
+                attempt + 1, attempts, delay * 1e3, e,
+            )
+            sleep(delay)
 
 
 def blob_key_from_doc(doc):

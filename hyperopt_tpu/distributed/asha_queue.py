@@ -42,6 +42,7 @@ import time
 import uuid
 
 from ..base import Domain, JOB_STATE_DONE, JOB_STATE_NEW, SONify, STATUS_OK
+from . import _common
 from .filequeue import FileJobQueue, _read_json
 
 logger = logging.getLogger(__name__)
@@ -97,6 +98,7 @@ def asha_filequeue(
     poll_interval=0.05,
     eval_timeout=None,
     reserve_timeout=120.0,
+    fs=None,
 ):
     """Run ASHA with evaluations farmed to ``hyperopt-tpu-worker``
     processes over a :class:`FileJobQueue` directory.
@@ -106,6 +108,9 @@ def asha_filequeue(
       dirpath: the queue directory workers serve (``python -m
         hyperopt_tpu.distributed.worker --dir DIR``).  The budget-aware
         ``Domain`` is (re)published to its attachments at entry.
+      fs: injectable filesystem seam for the DRIVER side (see
+        :mod:`.faults`); None uses the real ``os``.  Workers inject
+        their own.
       inflight: concurrent jobs in the queue (the driver's slot count;
         actual parallelism is however many workers serve the mount).
       poll_interval: driver's BASE done-file poll cadence per slot;
@@ -124,7 +129,7 @@ def asha_filequeue(
     record (every job's doc with owner/timings/tracebacks).
     """
     _reject_queue_backed_trials(trials, "asha_filequeue")
-    queue = FileJobQueue(dirpath)
+    queue = FileJobQueue(dirpath, fs=fs)
     # per-run attachment key: a queue directory shared with a live fmin
     # (or a previous asha run) keeps every driver's Domain intact --
     # each job doc's cmd names the one to evaluate with
@@ -134,15 +139,15 @@ def asha_filequeue(
 
     def fetch(tid):
         done_path = os.path.join(queue.root, "done", f"{tid}.json")
-        if not os.path.exists(done_path):
-            return None
         try:
-            return _read_json(done_path)
+            if not queue.fs.exists(done_path):
+                return None
+            return _read_json(done_path, fs=queue.fs)
         except (ValueError, OSError):
-            return None  # mid-write on a non-atomic FS: retry, but the
-            # driver's deadline check still runs -- a file left
-            # permanently truncated by a killed worker must not bypass
-            # eval_timeout
+            return None  # mid-write on a non-atomic FS, or a transient
+            # mount blip: retry next poll, but the driver's deadline
+            # check still runs -- a file left permanently truncated by
+            # a killed worker must not bypass eval_timeout
 
     transport = _TransportDriver(
         publish=queue.publish,
@@ -274,7 +279,17 @@ class _TransportDriver:
             if now - self._last_reap < self._reap_period:
                 return
             self._last_reap = now
-        self._reap(self.reserve_timeout)
+        try:
+            self._reap(self.reserve_timeout)
+        except Exception as e:
+            # reaping is periodic best-effort: a transient transport
+            # failure that outlives the backend's own retries must not
+            # kill a polling slot (and with it the whole run) -- the
+            # next reap cycle sees the same stale claims.  Anything
+            # non-transient is a real bug and surfaces.
+            if not _common.is_transient(e):
+                raise
+            logger.warning("reap cycle skipped on transient failure: %s", e)
 
     def evaluator(self, vals, cfg, budget):
         """The :func:`hyperband.asha` ``evaluator=`` seam: one queued

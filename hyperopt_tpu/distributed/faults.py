@@ -1,0 +1,362 @@
+"""Deterministic fault injection for the distributed layer.
+
+The filequeue/worker stack promises graceful degradation under flaky
+NFS mounts, mid-CAS crashes and SIGTERM -- this module makes those
+promises *testable* without monkeypatching.  Every filesystem primitive
+the queue touches (open/read/write, rename, utime, stat, listdir,
+unlink, fsync) goes through an injectable ``fs`` seam:
+
+* :data:`REAL_FS` -- the default, a thin ``os`` passthrough whose named
+  crash points are no-ops (zero overhead in production);
+* :class:`FaultPlan` + :meth:`FaultPlan.fs` -- a *seeded, deterministic*
+  injector: transient errno faults (ESTALE/EIO/... at a configurable
+  rate, burst-bounded so retries always converge), bounded latency,
+  partial writes, and one-shot **named crash points** -- e.g.
+  ``after_claim_utime_before_rename`` -- that raise
+  :class:`SimulatedCrash` at exactly the protocol step a real worker
+  could die at.
+
+``FileJobQueue(root, fs=plan.fs())`` (and ``FileTrials(..., fs=...)``,
+``asha_filequeue(..., fs=...)``) inject it; ``tests/test_chaos.py``
+replays seeded plans against live queue+worker stacks and asserts no
+job is ever lost or double-completed.
+
+Named crash points wired into the queue/worker protocol::
+
+    after_publish_tmp_before_rename    publish():   tmp written, not yet in new/
+    after_claim_utime_before_rename    reserve():   mtime refreshed, CAS rename pending
+    after_claim_rename_before_write    reserve():   claim renamed, doc not yet normalized
+    after_done_tmp_before_rename       complete():  result tmp fsynced, not yet in done/
+    after_done_rename_before_unlink    complete():  DONE published, claim not yet released
+    after_unreserve_utime_before_rename unreserve(): give-back rename pending
+    after_reap_utime_before_rename     reap():      recycle rename pending
+    after_attach_fsync_before_rename   attachments: blob tmp fsynced, not yet visible
+    before_complete                    worker:      evaluated, result not yet published
+"""
+
+from __future__ import annotations
+
+import collections
+import errno
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
+    "CRASH_POINTS",
+]
+
+#: every named crash point the protocol code declares (see module
+#: docstring) -- the chaos suite iterates this so a new crash point
+#: cannot be added without being exercised.
+CRASH_POINTS = (
+    "after_publish_tmp_before_rename",
+    "after_claim_utime_before_rename",
+    "after_claim_rename_before_write",
+    "after_done_tmp_before_rename",
+    "after_done_rename_before_unlink",
+    "after_unreserve_utime_before_rename",
+    "after_reap_utime_before_rename",
+    "after_attach_fsync_before_rename",
+    "before_complete",
+)
+
+#: the transient errno mix a flaky mount produces; FileNotFoundError
+#: (ENOENT) may be added to a plan's ``errors`` to simulate NFS
+#: visibility lag -- the protocol treats it as a lost race and retries
+#: at the job level, so nothing is ever deleted on its account.
+DEFAULT_ERRORS = (errno.ESTALE, errno.EIO)
+
+
+class SimulatedCrash(BaseException):
+    """Simulated process death at a named crash point.
+
+    A ``BaseException`` deliberately: the worker's evaluation-error
+    capture (``except Exception``) must not swallow a simulated crash
+    into an ERROR doc -- a dead process publishes nothing.  Chaos
+    harnesses catch it at the top of their worker loop and carry on as
+    a restarted worker would.
+    """
+
+    def __init__(self, point):
+        super().__init__(f"simulated crash at {point}")
+        self.point = point
+
+
+class RealFS:
+    """The default seam: ``os`` passthrough, no-op crash points."""
+
+    def open(self, path, mode="r"):
+        return open(path, mode)
+
+    def rename(self, src, dst):
+        os.rename(src, dst)
+
+    def utime(self, path, times=None):
+        os.utime(path, times)
+
+    def stat(self, path):
+        return os.stat(path)
+
+    def getmtime(self, path):
+        return os.path.getmtime(path)
+
+    def listdir(self, path):
+        return os.listdir(path)
+
+    def unlink(self, path):
+        os.unlink(path)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def makedirs(self, path, exist_ok=True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def fsync(self, f):
+        f.flush()
+        os.fsync(f.fileno())
+
+    def crashpoint(self, name):
+        pass
+
+
+REAL_FS = RealFS()
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults.
+
+    One plan = one RNG stream: with a fixed seed and a fixed sequence
+    of filesystem operations, the injected faults are identical run to
+    run (``self.log`` records every decision for trace-equality
+    assertions).  Concurrency note: give each simulated worker its own
+    :meth:`split` plan -- decisions then depend only on that worker's
+    own operation sequence, not on thread interleaving.
+
+    Parameters:
+      seed:    RNG seed (determinism anchor).
+      rate:    probability of injecting a transient error per fs call.
+      errors:  errno pool drawn from (``OSError(errno, ...)`` picks the
+               matching subclass, so ENOENT raises FileNotFoundError).
+      latency: max injected delay per call, seconds (capped at 50 ms --
+               the chaos suite's no-real-sleeps budget).
+      partial_rate: probability a file opened for writing fails midway
+               with EIO after writing only a prefix (the torn-write
+               case tmp+rename protocols must survive).
+      burst:   max *consecutive* injected failures per (op, file) key;
+               bounds the adversary so a retry loop of ``burst + 1``
+               attempts always converges.  ``None`` = unbounded.
+      ops:     restrict error injection to these op names (None = all).
+    """
+
+    def __init__(self, seed=0, rate=0.0, errors=DEFAULT_ERRORS,
+                 latency=0.0, partial_rate=0.0, burst=2, ops=None):
+        self.seed = seed
+        self.rate = float(rate)
+        self.errors = tuple(errors)
+        self.latency = min(float(latency), 0.05)
+        self.partial_rate = float(partial_rate)
+        self.burst = burst
+        self.ops = None if ops is None else frozenset(ops)
+        self._rng = random.Random(seed)
+        self._lock = threading.RLock()
+        self._consecutive = {}
+        self._crash = {}
+        self.log = []
+        self.stats = collections.Counter()
+
+    def split(self, name):
+        """A derived plan with the same fault profile and a stably
+        derived seed (crc32, not ``hash()`` -- PYTHONHASHSEED must not
+        leak into the schedule).  Crash points are NOT inherited: arm
+        them on exactly the plan whose actor should die."""
+        child_seed = zlib.crc32(f"{self.seed}/{name}".encode())
+        return FaultPlan(
+            seed=child_seed, rate=self.rate, errors=self.errors,
+            latency=self.latency, partial_rate=self.partial_rate,
+            burst=self.burst, ops=self.ops,
+        )
+
+    def fs(self):
+        """An injectable filesystem bound to this plan."""
+        return FaultyFS(self)
+
+    def arm(self, point, at=1):
+        """Arm a one-shot crash at the ``at``-th hit of ``point``."""
+        if point not in CRASH_POINTS:
+            raise ValueError(f"unknown crash point {point!r}")
+        with self._lock:
+            self._crash[point] = int(at)
+        return self
+
+    # -- decision engine (called by FaultyFS) ------------------------------
+    def _decide_error(self, op, key):
+        with self._lock:
+            if not self.rate or (self.ops is not None and op not in self.ops):
+                self.log.append((op, key, "ok"))
+                return None
+            k = (op, key)
+            streak = self._consecutive.get(k, 0)
+            allowed = self.burst is None or streak < self.burst
+            if allowed and self._rng.random() < self.rate:
+                self._consecutive[k] = streak + 1
+                err = self._rng.choice(self.errors)
+                self.log.append((op, key, f"errno={err}"))
+                self.stats[f"error:{op}"] += 1
+                return err
+            self._consecutive[k] = 0
+            self.log.append((op, key, "ok"))
+            return None
+
+    def _decide_partial(self, key, size_hint=256):
+        """None, or the byte offset at which a write handle dies."""
+        with self._lock:
+            if not self.partial_rate:
+                return None
+            k = ("write", key)
+            streak = self._consecutive.get(k, 0)
+            allowed = self.burst is None or streak < self.burst
+            if allowed and self._rng.random() < self.partial_rate:
+                self._consecutive[k] = streak + 1
+                cut = self._rng.randrange(0, size_hint)
+                self.log.append(("write", key, f"partial@{cut}"))
+                self.stats["error:partial_write"] += 1
+                return cut
+            self._consecutive[k] = 0
+            return None
+
+    def _decide_latency(self):
+        if not self.latency:
+            return 0.0
+        with self._lock:
+            return self._rng.uniform(0.0, self.latency)
+
+    def fire_crashpoint(self, name):
+        with self._lock:
+            if name not in self._crash:
+                return
+            self._crash[name] -= 1
+            if self._crash[name] > 0:
+                return
+            del self._crash[name]
+            self.log.append(("crash", name, "fired"))
+            self.stats[f"crash:{name}"] += 1
+        raise SimulatedCrash(name)
+
+
+class _FaultyWriteFile:
+    """Write-handle proxy that may die mid-stream: writes a prefix up
+    to the plan-chosen offset, then raises EIO -- exactly the torn
+    write the tmp+fsync+rename protocol exists to survive."""
+
+    def __init__(self, f, fail_at):
+        self._f = f
+        self._fail_at = fail_at
+        self._written = 0
+
+    def write(self, data):
+        if self._fail_at is not None:
+            budget = self._fail_at - self._written
+            if len(data) >= budget:
+                if budget > 0:
+                    self._f.write(data[:budget])
+                    self._written += budget
+                self._fail_at = None  # one torn write per handle
+                raise OSError(errno.EIO, "injected partial write")
+        self._f.write(data)
+        self._written += len(data)
+        return len(data)
+
+    def flush(self):
+        self._f.flush()
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def close(self):
+        self._f.close()
+
+    @property
+    def name(self):
+        return self._f.name
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+
+class FaultyFS:
+    """The injectable filesystem: every primitive consults the plan for
+    an error / latency decision before delegating to ``os``; crash
+    points raise :class:`SimulatedCrash` when armed.  API-compatible
+    with :class:`RealFS`, so product code is injection-agnostic."""
+
+    def __init__(self, plan):
+        self.plan = plan
+
+    def _gate(self, op, path):
+        delay = self.plan._decide_latency()
+        if delay:
+            time.sleep(delay)
+        err = self.plan._decide_error(op, os.path.basename(str(path)))
+        if err is not None:
+            raise OSError(err, f"injected {errno.errorcode.get(err, err)}",
+                          str(path))
+
+    def open(self, path, mode="r"):
+        self._gate("open", path)
+        f = open(path, mode)
+        if any(c in mode for c in "wxa+"):
+            fail_at = self.plan._decide_partial(os.path.basename(str(path)))
+            if fail_at is not None:
+                return _FaultyWriteFile(f, fail_at)
+        return f
+
+    def rename(self, src, dst):
+        self._gate("rename", src)
+        os.rename(src, dst)
+
+    def utime(self, path, times=None):
+        self._gate("utime", path)
+        os.utime(path, times)
+
+    def stat(self, path):
+        self._gate("stat", path)
+        return os.stat(path)
+
+    def getmtime(self, path):
+        self._gate("stat", path)
+        return os.path.getmtime(path)
+
+    def listdir(self, path):
+        self._gate("listdir", path)
+        return os.listdir(path)
+
+    def unlink(self, path):
+        self._gate("unlink", path)
+        os.unlink(path)
+
+    def exists(self, path):
+        self._gate("stat", path)
+        return os.path.exists(path)
+
+    def makedirs(self, path, exist_ok=True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def fsync(self, f):
+        self._gate("fsync", getattr(f, "name", "?"))
+        f.flush()
+        os.fsync(f.fileno())
+
+    def crashpoint(self, name):
+        self.plan.fire_crashpoint(name)

@@ -1,0 +1,257 @@
+"""Recovery audit for a FileJobQueue directory.
+
+``python -m hyperopt_tpu.distributed.fsck --dir D [--repair]`` detects
+(and, with ``--repair``, fixes) the residue every crash mode of the
+queue protocol can leave behind -- the operational complement of the
+worker-side hardening (FAILURES.md has the full recovery matrix):
+
+==================  ==============================================  ===========================
+issue               how it happens                                   repair
+==================  ==============================================  ===========================
+stale_tmp           crash between tmp write and rename               unlink (never referenced)
+half_written        torn write on a non-atomic FS / fault fixture    quarantine the doc
+orphaned_claim      worker died holding a claim (no heartbeat)       recycle to new/ (the reap)
+completed_claim     crash between DONE publish and claim release     release (unlink the claim)
+duplicate_tid       completed job recycled back into new/running     retire the shadowed copy
+==================  ==============================================  ===========================
+
+After ``--repair`` a fresh worker drains the directory completely: no
+job lost, no DONE doc duplicated.  The tool only moves or deletes files
+the protocol can prove are residue; half-written docs go to
+``quarantine/`` (with a uniquifying suffix), never silently destroyed.
+
+Exit codes: 0 clean (or fully repaired), 1 issues found (audit-only)
+or unrepaired issues remain.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+from . import _common
+from .faults import REAL_FS
+from .filequeue import _read_json
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["Issue", "audit", "repair", "main"]
+
+_SUBS = ("new", "running", "done")
+
+
+class Issue:
+    """One detected problem: ``kind`` (table above), the offending
+    ``path``, and a human-readable ``detail``."""
+
+    def __init__(self, kind, path, detail=""):
+        self.kind = kind
+        self.path = path
+        self.detail = detail
+
+    def __repr__(self):
+        return f"Issue({self.kind}, {self.path!r}, {self.detail!r})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Issue)
+            and (self.kind, self.path) == (other.kind, other.path)
+        )
+
+    def __hash__(self):
+        return hash((self.kind, self.path))
+
+
+def _valid_doc(path, fs):
+    try:
+        doc = _common.with_retries(
+            lambda: _read_json(path, fs=fs), label="fsck read"
+        )
+        return doc if isinstance(doc, dict) else None
+    except (OSError, json.JSONDecodeError, ValueError):
+        return None
+
+
+def audit(root, fs=REAL_FS, reserve_timeout=None, tmp_grace=60.0):
+    """Scan a queue directory and return the list of :class:`Issue`.
+
+    ``reserve_timeout`` enables orphaned-claim detection (claims in
+    running/ older than it); None skips that check (a live worker's
+    claim is indistinguishable from an orphan without an age bound).
+    ``tmp_grace`` is how old a ``*.tmp.*`` file must be before it
+    counts as stale -- in-flight writers keep theirs younger.
+    """
+    root = os.path.abspath(root)
+    issues = []
+    now = time.time()
+    docs = {}  # sub -> {name: doc or None}
+    for sub in _SUBS + ("attachments",):
+        subdir = os.path.join(root, sub)
+        try:
+            names = fs.listdir(subdir)
+        except FileNotFoundError:
+            continue
+        for name in sorted(names):
+            path = os.path.join(subdir, name)
+            if ".tmp." in name:
+                try:
+                    age = now - fs.getmtime(path)
+                except OSError:
+                    continue
+                if age >= tmp_grace:
+                    issues.append(Issue(
+                        "stale_tmp", path, f"age {age:.0f}s"
+                    ))
+                continue
+            if sub == "attachments" or not name.endswith(".json"):
+                continue
+            doc = _valid_doc(path, fs)
+            docs.setdefault(sub, {})[name] = doc
+            if doc is None:
+                issues.append(Issue(
+                    "half_written", path, "unparseable job doc"
+                ))
+    # duplicate tids: the same job file present in more than one state
+    # directory (a completed job recycled into new/ or running/, or a
+    # claim that was both recycled and re-claimed)
+    for name in sorted(
+        set(docs.get("new", {})) | set(docs.get("running", {}))
+    ):
+        in_done = docs.get("done", {}).get(name) is not None
+        in_new = name in docs.get("new", {})
+        in_running = name in docs.get("running", {})
+        if in_done:
+            for sub in ("new", "running"):
+                if name in docs.get(sub, {}):
+                    kind = (
+                        "completed_claim" if sub == "running"
+                        else "duplicate_tid"
+                    )
+                    issues.append(Issue(
+                        kind, os.path.join(root, sub, name),
+                        "DONE doc already published",
+                    ))
+        elif in_new and in_running:
+            issues.append(Issue(
+                "duplicate_tid", os.path.join(root, "new", name),
+                "also claimed in running/",
+            ))
+    # orphaned claims: running/ entries older than the reserve timeout
+    # with no DONE doc (those are completed_claim above)
+    if reserve_timeout is not None:
+        for name, doc in sorted(docs.get("running", {}).items()):
+            if doc is None or docs.get("done", {}).get(name) is not None:
+                continue
+            path = os.path.join(root, "running", name)
+            try:
+                age = now - fs.getmtime(path)
+            except OSError:
+                continue
+            if age >= reserve_timeout:
+                issues.append(Issue(
+                    "orphaned_claim", path, f"age {age:.0f}s"
+                ))
+    return issues
+
+
+def repair(root, issues, fs=REAL_FS):
+    """Fix every repairable :class:`Issue`; returns the repaired count.
+
+    Order matters: shadowed duplicates are retired before orphaned
+    claims are recycled, so a completed job can never be resurrected
+    through the reap transition."""
+    root = os.path.abspath(root)
+    quarantine = os.path.join(root, "quarantine")
+    repaired = 0
+    order = {
+        "stale_tmp": 0, "half_written": 1, "completed_claim": 2,
+        "duplicate_tid": 3, "orphaned_claim": 4,
+    }
+    for issue in sorted(issues, key=lambda i: (order.get(i.kind, 9), i.path)):
+        try:
+            if issue.kind == "stale_tmp":
+                fs.unlink(issue.path)
+            elif issue.kind == "half_written":
+                fs.makedirs(quarantine, exist_ok=True)
+                dst = os.path.join(
+                    quarantine,
+                    f"{os.path.basename(os.path.dirname(issue.path))}."
+                    f"{os.path.basename(issue.path)}",
+                )
+                fs.rename(issue.path, dst)
+                logger.warning("quarantined %s -> %s", issue.path, dst)
+            elif issue.kind in ("completed_claim", "duplicate_tid"):
+                # DONE already published, or the job is claimed in
+                # running/: this copy is the resurrection hazard
+                fs.unlink(issue.path)
+            elif issue.kind == "orphaned_claim":
+                # the reap transition: refresh the mtime first so the
+                # recycled job does not reappear in new/ reap-stale
+                name = os.path.basename(issue.path)
+                fs.utime(issue.path)
+                fs.rename(issue.path, os.path.join(root, "new", name))
+            else:
+                continue
+            repaired += 1
+        except FileNotFoundError:
+            repaired += 1  # a live worker fixed it first
+        except OSError as e:
+            logger.error("could not repair %r: %s", issue, e)
+    return repaired
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m hyperopt_tpu.distributed.fsck",
+        description="Audit (and repair) a FileJobQueue directory.",
+    )
+    parser.add_argument("--dir", required=True, help="queue directory")
+    parser.add_argument(
+        "--repair", action="store_true",
+        help="fix repairable issues instead of only reporting them",
+    )
+    parser.add_argument(
+        "--reserve-timeout", type=float, default=120.0,
+        help="claim age that counts as orphaned (seconds); the worker "
+        "default.  Pass a negative value to skip orphan detection.",
+    )
+    parser.add_argument(
+        "--tmp-grace", type=float, default=60.0,
+        help="tmp-file age that counts as stale (seconds)",
+    )
+    parser.add_argument("-v", "--verbose", action="count", default=0)
+    options = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if options.verbose else logging.INFO,
+        stream=sys.stderr,
+    )
+    reserve_timeout = (
+        None if options.reserve_timeout < 0 else options.reserve_timeout
+    )
+    issues = audit(
+        options.dir, reserve_timeout=reserve_timeout,
+        tmp_grace=options.tmp_grace,
+    )
+    for issue in issues:
+        print(f"{issue.kind}: {issue.path} ({issue.detail})")
+    if not issues:
+        print(f"{options.dir}: clean")
+        return 0
+    if not options.repair:
+        print(f"{len(issues)} issue(s) found (re-run with --repair to fix)")
+        return 1
+    n = repair(options.dir, issues)
+    remaining = audit(
+        options.dir, reserve_timeout=reserve_timeout,
+        tmp_grace=options.tmp_grace,
+    )
+    print(f"repaired {n}/{len(issues)} issue(s); {len(remaining)} remain")
+    return 0 if not remaining else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import logging
 import pickle
+import uuid
 
 from ..base import (
     JOB_STATE_DONE,
@@ -87,7 +88,9 @@ class MongoJobs:
 
     def publish(self, doc):
         doc = SONify(doc)
-        self.coll.insert_one(doc)
+        _common.with_retries(
+            lambda: self.coll.insert_one(doc), label="mongo publish"
+        )
         return doc
 
     def reserve(self, owner, exp_key=None, exclude_tids=()):
@@ -110,20 +113,35 @@ class MongoJobs:
             query["exp_key"] = exp_key
         if exclude_tids:
             query["tid"] = {"$nin": list(exclude_tids)}
-        return self.coll.find_one_and_update(
-            query,
-            {
-                "$set": {
-                    "state": JOB_STATE_RUNNING,
-                    "owner": owner,
-                    "book_time": coarse_utcnow(),
-                }
-            },
-            sort=[("_id", 1)],
-            return_document=True,
+        # unique claim token: completion-time lost-claim detection must
+        # distinguish THIS reservation from a reaped-and-re-claimed one
+        # even when both claimants share an owner string
+        token = uuid.uuid4().hex
+        return _common.with_retries(
+            lambda: self.coll.find_one_and_update(
+                query,
+                {
+                    "$set": {
+                        "state": JOB_STATE_RUNNING,
+                        "owner": owner,
+                        "book_time": coarse_utcnow(),
+                        "claim": token,
+                    }
+                },
+                sort=[("_id", 1)],
+                return_document=True,
+            ),
+            label="mongo reserve",
         )
 
-    def complete(self, doc, result=None, error=None):
+    def complete(self, doc, result=None, error=None, require_claim=False):
+        """Write the finished state back.  With ``require_claim=True``
+        the writeback is a CAS on the reservation's claim token: it
+        succeeds (returns True) only if the job is still RUNNING under
+        THIS claim -- a job reaped (and possibly re-run) mid-evaluation
+        matches nothing, returns False, and the stale worker's result
+        is dropped instead of racing the re-run into a duplicate DONE
+        doc."""
         update = {"refresh_time": coarse_utcnow()}
         if error is not None:
             update["state"] = JOB_STATE_ERROR
@@ -131,16 +149,27 @@ class MongoJobs:
         else:
             update["state"] = JOB_STATE_DONE
             update["result"] = SONify(result)
-        self.coll.update_one({"_id": doc["_id"]}, {"$set": update})
+        query = {"_id": doc["_id"]}
+        if require_claim:
+            query["state"] = JOB_STATE_RUNNING
+            query["claim"] = doc.get("claim")
+        res = _common.with_retries(
+            lambda: self.coll.update_one(query, {"$set": update}),
+            label="mongo complete",
+        )
+        return res.matched_count == 1
 
     def unreserve(self, doc):
         """Return a reserved job to NEW (the reap transition) -- used by
         a worker that cannot process it; the queue owns this state
         machine so reap/give-back semantics cannot drift apart."""
-        self.coll.update_one(
-            {"_id": doc["_id"]},
-            {"$set": {"state": JOB_STATE_NEW, "owner": None,
-                      "book_time": None}},
+        _common.with_retries(
+            lambda: self.coll.update_one(
+                {"_id": doc["_id"]},
+                {"$set": {"state": JOB_STATE_NEW, "owner": None,
+                          "book_time": None, "claim": None}},
+            ),
+            label="mongo unreserve",
         )
 
     def reap(self, reserve_timeout):
@@ -149,9 +178,13 @@ class MongoJobs:
         import datetime
 
         cutoff = coarse_utcnow() - datetime.timedelta(seconds=reserve_timeout)
-        res = self.coll.update_many(
-            {"state": JOB_STATE_RUNNING, "book_time": {"$lt": cutoff}},
-            {"$set": {"state": JOB_STATE_NEW, "owner": None, "book_time": None}},
+        res = _common.with_retries(
+            lambda: self.coll.update_many(
+                {"state": JOB_STATE_RUNNING, "book_time": {"$lt": cutoff}},
+                {"$set": {"state": JOB_STATE_NEW, "owner": None,
+                          "book_time": None, "claim": None}},
+            ),
+            label="mongo reap",
         )
         return res.modified_count
 
@@ -255,7 +288,10 @@ class MongoTrials(Trials):
 
     def refresh(self):
         query = {} if self._exp_key is None else {"exp_key": self._exp_key}
-        docs = list(self.handle.coll.find(query, sort=[("tid", 1)]))
+        docs = list(_common.with_retries(
+            lambda: self.handle.coll.find(query, sort=[("tid", 1)]),
+            label="mongo refresh",
+        ))
         for d in docs:
             d.pop("_id", None)
         self._dynamic_trials = docs
@@ -351,20 +387,42 @@ class MongoWorker:
             # refresh book_time so reapers (driver-side asha_mongo,
             # other workers' reap calls) never recycle a LIVE job whose
             # evaluation outlives reserve_timeout -- the mtime-heartbeat
-            # contract of the filequeue worker, via the shared scaffold
-            self.jobs.coll.update_one(
-                {"_id": doc["_id"]},
-                {"$set": {"book_time": coarse_utcnow()}},
+            # contract of the filequeue worker, via the shared scaffold.
+            # CAS on the claim token: a reaped-and-re-claimed job must
+            # not have its NEW claimant's book_time refreshed by the old
+            # worker, and a matched_count of 0 (claim gone) stops the
+            # beat thread cleanly (the scaffold's False contract)
+            res = _common.with_retries(
+                lambda: self.jobs.coll.update_one(
+                    {"_id": doc["_id"], "state": JOB_STATE_RUNNING,
+                     "claim": doc.get("claim")},
+                    {"$set": {"book_time": coarse_utcnow()}},
+                ),
+                label="mongo heartbeat",
             )
+            return res.matched_count == 1
 
         with _common.claim_heartbeat(_beat, self.heartbeat):
             try:
                 result = domain.evaluate(spec_from_misc(doc["misc"]), ctrl)
             except Exception as e:
                 logger.error("job %s failed: %s", doc.get("tid"), e)
-                self.jobs.complete(doc, error=(str(type(e)), str(e)))
+                published = self.jobs.complete(
+                    doc, error=(str(type(e)), str(e)), require_claim=True
+                )
             else:
-                self.jobs.complete(doc, result=result)
+                published = self.jobs.complete(
+                    doc, result=result, require_claim=True
+                )
+        if not published:
+            # completion-time lost-claim detection (the filequeue
+            # worker's contract): the claim was reaped mid-evaluation
+            # and the job re-queued -- drop this result rather than
+            # racing the re-run into a duplicate DONE doc
+            logger.warning(
+                "job %s: claim lost mid-evaluation (reaped); dropping "
+                "result to defer to the re-run", doc.get("tid"),
+            )
         return True
 
 
@@ -382,7 +440,13 @@ def main_worker(argv=None):
     parser.add_argument("--poll-interval", type=float, default=1.0)
     parser.add_argument("--reserve-timeout", type=float, default=120.0)
     parser.add_argument("--workdir", default=None)
+    parser.add_argument(
+        "--max-crash-loop", type=int, default=5,
+        help="consecutive unexpected errors before a loud exit (rc 2)",
+    )
     options = parser.parse_args(argv)
+
+    from .worker import GracefulDrain
 
     jobs = MongoJobs.new_from_connection_str(options.mongo)
     worker = MongoWorker(
@@ -393,20 +457,46 @@ def main_worker(argv=None):
         ),
     )
     owner = f"{socket.gethostname()}:{os.getpid()}"
+    drain = GracefulDrain().install()
     n = 0
+    consecutive_errors = 0
     while options.max_jobs is None or n < options.max_jobs:
-        jobs.reap(options.reserve_timeout)
+        if drain.requested:
+            logger.info("drained after %d job(s), exiting 0", n)
+            return 0
         try:
+            jobs.reap(options.reserve_timeout)
             ran = worker.run_one(owner)
         except Exception as e:
-            if getattr(e, "failed_tid", None) is None:
-                raise  # a real bug (reserve failure, auth): die loudly
-            # a job naming an unloadable Domain: run_one gave it back
-            # and put the tid on cooldown; cool off instead of
-            # crash-looping the process on the same lowest-tid doc
-            logger.error("job %s returned to queue: %s", e.failed_tid, e)
-            time.sleep(options.poll_interval)
+            if getattr(e, "failed_tid", None) is not None:
+                # a job naming an unloadable Domain: run_one gave it
+                # back and put the tid on cooldown; cool off instead of
+                # crash-looping the process on the same lowest-tid doc
+                logger.error("job %s returned to queue: %s", e.failed_tid, e)
+                consecutive_errors = 0
+                time.sleep(options.poll_interval)
+                continue
+            # crash-loop guard (the filequeue worker's contract): back
+            # off on unexpected errors -- an AutoReconnect storm that
+            # outlives the per-op retries costs backoff, not the
+            # process -- then exit loudly so a supervisor restart loop
+            # cannot silently spin forever
+            consecutive_errors += 1
+            if consecutive_errors >= options.max_crash_loop:
+                logger.critical(
+                    "%d consecutive unexpected errors (last: %s); "
+                    "exiting loudly", consecutive_errors, e, exc_info=True,
+                )
+                return 2
+            logger.error(
+                "unexpected worker error (%d/%d): %s",
+                consecutive_errors, options.max_crash_loop, e,
+            )
+            time.sleep(min(
+                options.poll_interval * (2 ** consecutive_errors), 2.0
+            ))
             continue
+        consecutive_errors = 0
         if ran:
             n += 1
         else:

@@ -5,11 +5,27 @@ The worker-process role of the reference's ``hyperopt-mongo-worker`` CLI
 evaluate -> publish DONE/ERROR, in a loop, with reserve-timeout reaping,
 an idle exit, optional workdir isolation and a max-jobs budget.
 
+Hardening (FAILURES.md has the full recovery matrix):
+
+* transient mount blips (ESTALE/EIO class) in reserve/heartbeat/
+  complete/reap are retried by the shared scaffold
+  (``_common.with_retries``); persistent ones back the loop off instead
+  of crashing it;
+* a crash-loop guard exits loudly (rc 2) after ``--max-crash-loop``
+  consecutive unexpected errors, so a supervisor restart-loop on a
+  poisoned environment cannot silently spin forever;
+* SIGTERM drains gracefully: the in-flight job finishes (or is given
+  back), then the worker exits 0;
+* lost claims are detected at completion time: a job reaped (and
+  possibly re-run) while this worker evaluated it is dropped with a
+  warning, never published as a duplicate DONE doc.
+
 Usage::
 
     python -m hyperopt_tpu.distributed.worker --dir /shared/exp1 \
         [--exp-key K] [--max-jobs N] [--poll-interval S] \
-        [--reserve-timeout S] [--last-job-timeout S] [--workdir D]
+        [--reserve-timeout S] [--last-job-timeout S] [--workdir D] \
+        [--max-crash-loop N]
 """
 
 from __future__ import annotations
@@ -19,6 +35,7 @@ import collections
 import logging
 import os
 import pickle
+import signal
 import sys
 import time
 import traceback
@@ -36,11 +53,36 @@ from .filequeue import FileJobQueue, FileTrials, worker_owner
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["main", "run_one", "WorkerExit"]
+__all__ = ["main", "run_one", "WorkerExit", "GracefulDrain"]
 
 
 class WorkerExit(Exception):
     pass
+
+
+class GracefulDrain:
+    """SIGTERM -> finish (or give back) the in-flight job, then exit 0.
+
+    The handler only flips a flag: evaluation is never interrupted
+    mid-flight, so a drained worker leaves either a published result or
+    an intact claim for the reaper -- never a half-written doc.
+    ``install()`` is a no-op outside the main thread (signal.signal
+    would raise), which keeps in-process/threaded harnesses working.
+    """
+
+    def __init__(self):
+        self.requested = False
+
+    def _handle(self, signum, frame):
+        self.requested = True
+        logger.info("SIGTERM received: draining (finishing in-flight job)")
+
+    def install(self):
+        try:
+            signal.signal(signal.SIGTERM, self._handle)
+        except ValueError:  # not the main thread
+            pass
+        return self
 
 
 def _load_domain(queue, blob_key="FMinIter_Domain",
@@ -63,7 +105,9 @@ def _load_domain(queue, blob_key="FMinIter_Domain",
     # land inside one mtime tick; mtime+size ride along as backstops.
     path = queue.attachments._path(blob_key)
     try:
-        st = os.stat(path)
+        st = _common.with_retries(
+            lambda: queue.fs.stat(path), label="domain stat"
+        )
     except FileNotFoundError:  # raced a re-publish; next loop retries
         raise WorkerExit(f"domain attachment vanished under {queue.root}")
     ident = (st.st_ino, st.st_mtime_ns, st.st_size)
@@ -73,16 +117,28 @@ def _load_domain(queue, blob_key="FMinIter_Domain",
     )
 
 
-def _beat_running_file(path):
-    """One heartbeat tick: refresh the running-file's mtime; False
-    (stop) once the claim is gone (completed/reaped underneath us).
-    Transient mount blips (ESTALE/EIO class) raise and are retried by
-    the shared scaffold."""
-    try:
-        os.utime(path)
-        return True
-    except FileNotFoundError:
-        return False
+class _ClaimBeat:
+    """The heartbeat callable for a filequeue claim: refresh the
+    running-file's mtime each tick; stop (return False) and remember
+    the loss once the claim is gone (completed/reaped underneath us).
+    Transient mount blips (ESTALE/EIO class) are retried by the shared
+    scaffold here; if they persist the tick raises, and
+    ``claim_heartbeat`` logs it and keeps beating."""
+
+    def __init__(self, path, fs):
+        self.path = path
+        self.fs = fs
+        self.lost = False
+
+    def __call__(self):
+        try:
+            _common.with_retries(
+                lambda: self.fs.utime(self.path), label="claim heartbeat"
+            )
+            return True
+        except FileNotFoundError:
+            self.lost = True
+            return False
 
 
 def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
@@ -118,9 +174,8 @@ def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
     trials._dynamic_trials.append(doc)
     spec = spec_from_misc(doc["misc"])
     running_path = os.path.join(queue.root, "running", f"{doc['tid']}.json")
-    with _common.claim_heartbeat(
-        lambda: _beat_running_file(running_path), heartbeat
-    ):
+    beat = _ClaimBeat(running_path, queue.fs)
+    with _common.claim_heartbeat(beat, heartbeat):
         try:
             if workdir:
                 with working_dir(os.path.join(workdir, str(doc["tid"]))):
@@ -135,28 +190,55 @@ def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
         else:
             doc["state"] = JOB_STATE_DONE
             doc["result"] = SONify(result)
-    queue.complete(doc)
+    queue.fs.crashpoint("before_complete")
+    # completion-time lost-claim detection: claim_is_live (inside
+    # complete) re-reads the running file and compares claim tokens --
+    # the authoritative check; beat.lost is only the early-stop hint
+    # that let the heartbeat thread exit cleanly
+    if not queue.complete(doc, require_claim=True):
+        # the claim was reaped mid-evaluation (heartbeat lost / running
+        # file re-owned): the job is already back in new/ or re-running
+        # elsewhere -- publishing now would race the re-run into a
+        # duplicate DONE doc, so drop this result and move on
+        logger.warning(
+            "job %s: claim lost mid-evaluation (reaped); dropping result "
+            "to defer to the re-run", doc.get("tid"),
+        )
     return True
 
 
-def main_worker_helper(options):
-    queue = FileJobQueue(options.dir)
+def main_worker_helper(options, drain=None):
+    # options.fs (optional) injects the filesystem seam -- the chaos
+    # harness drives the REAL CLI loop under a FaultPlan this way
+    fs = getattr(options, "fs", None)
+    queue = FileJobQueue(options.dir, fs=fs)
     owner = worker_owner()
     n_done = 0
     idle_since = time.time()
+    drain = (drain or GracefulDrain()).install()
     # jobs whose Domain failed to load are skipped on cooldown so one
     # dangling-attachment job cannot monopolize the sorted reserve scan
     # (other jobs and other drivers keep being served; the TTL retries
     # eventually in case the failure was transient)
     bad_tids = _common.TTLSet()
+    # crash-loop guard: consecutive unexpected errors (not per-job
+    # Domain failures) back off, then exit LOUDLY -- a worker under a
+    # process supervisor must not silently restart-spin on a poisoned
+    # environment, and a transient mount outage that outlives the
+    # per-op retries should cost backoff, not the process
+    consecutive_errors = 0
+    max_crash_loop = getattr(options, "max_crash_loop", 5)
     trials = FileTrials(
         options.dir, exp_key=options.exp_key, refresh=False,
-        reserve_timeout=options.reserve_timeout,
+        reserve_timeout=options.reserve_timeout, fs=fs,
     )
     logger.info("worker %s serving %s", owner, queue.root)
     while options.max_jobs is None or n_done < options.max_jobs:
-        queue.reap(options.reserve_timeout)
+        if drain.requested:
+            logger.info("drained after %d job(s), exiting 0", n_done)
+            return 0
         try:
+            queue.reap(options.reserve_timeout)
             ran = run_one(
                 queue, owner, exp_key=options.exp_key,
                 workdir=options.workdir, trials=trials,
@@ -177,12 +259,32 @@ def main_worker_helper(options):
             # returning False, and the normal idle path applies the
             # last_job_timeout give-up
             tid = getattr(e, "failed_tid", None)
-            if tid is None:
-                raise  # a real bug (not a per-job load failure): die loudly
-            logger.error("job %s returned to queue: %s", tid, e)
-            bad_tids.add(tid)
-            time.sleep(options.poll_interval)
+            if tid is not None:
+                logger.error("job %s returned to queue: %s", tid, e)
+                bad_tids.add(tid)
+                consecutive_errors = 0  # per-job failure, not a crash loop
+                time.sleep(options.poll_interval)
+                continue
+            consecutive_errors += 1
+            if consecutive_errors >= max_crash_loop:
+                logger.critical(
+                    "%d consecutive unexpected errors (last: %s); "
+                    "exiting loudly", consecutive_errors, e, exc_info=True,
+                )
+                return 2
+            level = (
+                logging.WARNING if _common.is_transient(e)
+                else logging.ERROR
+            )
+            logger.log(
+                level, "unexpected worker error (%d/%d): %s",
+                consecutive_errors, max_crash_loop, e, exc_info=True,
+            )
+            time.sleep(min(
+                options.poll_interval * (2 ** consecutive_errors), 2.0
+            ))
             continue
+        consecutive_errors = 0
         if ran:
             n_done += 1
             idle_since = time.time()
@@ -209,6 +311,10 @@ def main(argv=None):
         help="exit after this many seconds without work",
     )
     parser.add_argument("--workdir", default=None)
+    parser.add_argument(
+        "--max-crash-loop", type=int, default=5,
+        help="consecutive unexpected errors before a loud exit (rc 2)",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     options = parser.parse_args(argv)
     logging.basicConfig(

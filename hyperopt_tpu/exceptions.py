@@ -41,3 +41,29 @@ class AllTrialsFailed(HyperoptTpuError):
 
 class CompileError(HyperoptTpuError):
     """The space compiler could not lower a search space to a JAX sampler."""
+
+
+class BackendError(HyperoptTpuError):
+    """A distributed-transport (filequeue / mongo) operation failed.
+
+    The transient-vs-fatal split below is the contract
+    ``distributed._common.with_retries`` classifies by: transient
+    failures (mount blips, reconnects) are retried with exponential
+    backoff, fatal ones surface immediately."""
+
+
+class TransientBackendError(BackendError):
+    """A retryable transport failure (the ESTALE/EIO/AutoReconnect
+    class): raise this to ask the retry scaffold for another attempt."""
+
+
+class FatalBackendError(BackendError):
+    """A non-retryable transport failure (corruption, permission,
+    protocol violation): never retried, always surfaced."""
+
+
+class ClaimLost(BackendError):
+    """A worker's reservation was reaped (and possibly re-claimed)
+    while it was still evaluating -- detected at completion time so the
+    stale worker drops its result instead of racing the re-run into a
+    duplicate DONE doc."""

@@ -91,7 +91,7 @@ def test_queue_reserve_refreshes_stale_mtime_before_rename(tmp_path):
 
     real_write = filequeue._write_atomic
     try:
-        filequeue._write_atomic = lambda path, doc: None  # hold the window
+        filequeue._write_atomic = lambda path, doc, **kw: None  # hold the window
         d = q.reserve("w1")
     finally:
         filequeue._write_atomic = real_write
