@@ -174,6 +174,10 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     sync blocks on every call (what a sequential fmin pays per ask --
     dispatch RTT + compute).  The gap between the two IS the
     dispatch-vs-compute decomposition.
+
+    The device view is bucketed with the round-6 compaction default
+    (``pow2_cap``), exactly the path ``suggest()`` runs -- an uncapped
+    view would time a wider history slice than any real ask uploads.
     """
     import jax
 
@@ -183,7 +187,9 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     fn = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
-    arrays = buf.device_arrays()
+    arrays = buf.device_arrays(
+        pow2_cap=tpe_jax._resolve_above_cap(None)
+    )
     key = jax.random.key(1)
     jax.block_until_ready(fn(key, *arrays, batch=1))
     keys = list(jax.random.split(key, n_calls))
@@ -214,6 +220,133 @@ def bench_spec_latency(domain, trials, n_cand=128, k=32, n_calls=64):
     for i in range(n_calls):
         algo(trials.new_trial_ids(1), domain, trials, seed=1 + i)
     return n_calls / (time.perf_counter() - t0)
+
+
+def _tell_from_col(ps, buf, i, loss):
+    """Stage one synthetic completed trial into ``buf`` (values recycled
+    from an existing observation column -- speed benches only care about
+    the tell/ask mechanics, not the posterior trajectory)."""
+    col = i % max(buf.count, 1)
+    vals = {
+        ps.labels[d]: float(buf.values[d, col])
+        for d in range(ps.n_dims)
+        if buf.active[d, col]
+    }
+    buf.add(vals, float(loss))
+
+
+def bench_fused_latency(domain, trials, n_cand=128, n_calls=30):
+    """Fused tell+ask sync rate: the one-dispatch sequential regime.
+
+    Each timed iteration is one full sequential step -- stage an O(D)
+    delta tell, then apply it AND draw the next suggestion in a single
+    blocking dispatch (``build_suggest_fn(state_io=True)`` over a
+    resident history).  Reported alongside
+    ``single_suggest_sync_per_sec``, whose two blocking round trips per
+    trial (history upload + suggest dispatch) this path halves.  Runs
+    on a private resident mirror so the shared buffer's cache is
+    untouched.
+    """
+    import jax
+
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import ObsBuffer, packed_space_for
+
+    ps = packed_space_for(domain)
+    buf = ObsBuffer(ps, resident=True)
+    buf.sync(trials)
+    a_cap = tpe_jax._resolve_above_cap(None)
+    fused = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0,
+                                     state_io=True)
+    plain = tpe_jax.build_suggest_fn(ps, n_cand, 0.25, 25.0, 1.0)
+    buf.device_arrays(pow2_cap=a_cap)  # materialize the mirror
+    keys = list(jax.random.split(jax.random.key(2), n_calls + 1))
+    jax.block_until_ready(keys)
+
+    def step(i, key):
+        _tell_from_col(ps, buf, i, loss=float(i % 7))
+        fusable = buf.take_fusable_delta(a_cap)
+        if fusable is None:  # bucket crossed mid-bench: settle + plain ask
+            out = plain(key, *buf.device_arrays(pow2_cap=a_cap), batch=1)
+            return jax.device_get(out)
+        state, delta = fusable
+        out = fused(key, *state, *delta, batch=1)
+        buf.commit_resident(out[:4])
+        return jax.device_get((out[4], out[5]))
+
+    step(0, keys[-1])  # compile
+    t0 = time.perf_counter()
+    for i in range(n_calls):
+        step(1 + i, keys[i])
+    return n_calls / (time.perf_counter() - t0)
+
+
+def bench_transfer_per_ask(space, sizes, n_asks=8):
+    """Host->device traffic of one sequential tell+ask, COUNTED (not
+    timed) from the ObsBuffer byte accounting, at each history size:
+    resident O(D) delta vs generation-bump full re-upload.  The
+    resident row must stay flat in n_obs (the acceptance contract);
+    the re-upload row grows with the bucketed history width.
+    """
+    from hyperopt_tpu import tpe_jax
+    from hyperopt_tpu.jax_trials import ObsBuffer, packed_space_for
+
+    a_cap = tpe_jax._resolve_above_cap(None)
+    rows = []
+    for n_obs in sizes:
+        domain, trials = build_history(n_obs, space, seed=n_obs)
+        ps = packed_space_for(domain)
+        per_ask = {}
+        for resident in (True, False):
+            buf = ObsBuffer(ps, resident=resident)
+            buf.sync(trials)
+            buf.device_arrays(pow2_cap=a_cap)  # steady state: mirror warm
+            b0 = buf.transfer_bytes_total
+            for i in range(n_asks):
+                _tell_from_col(ps, buf, i, loss=float(i % 5))
+                buf.device_arrays(pow2_cap=a_cap)  # what one ask uploads
+            per_ask[resident] = (buf.transfer_bytes_total - b0) / n_asks
+        rows.append({
+            "n_obs": n_obs,
+            "resident_bytes_per_ask": round(per_ask[True], 1),
+            "full_reupload_bytes_per_ask": round(per_ask[False], 1),
+        })
+    return rows
+
+
+def bench_fused_dispatches(n_trials=120, seed=11):
+    """Deterministic dispatch accounting for the fused sequential driver:
+    a real ``fmin`` run (``algo=tpe_jax.suggest(fused=True)`` over
+    ``JaxTrials(resident=True)``) whose ObsBuffer dispatch counter is
+    read back -- one device dispatch per trial is the contract (the
+    counter-based form of "tell+ask fused", immune to timing noise).
+    ``n_trials`` stays below the first bucket-growth boundary so the
+    expected count is exactly ``n_trials`` + 1 trailing ask-ahead
+    pre-dispatch after the final result.
+    """
+    from functools import partial
+
+    import numpy as np
+
+    from hyperopt_tpu import fmin, tpe_jax
+    from hyperopt_tpu.jax_trials import JaxTrials
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+
+    trials = JaxTrials(resident=True)
+    fmin(
+        mixed_space_fn,
+        mixed_space(),
+        algo=partial(tpe_jax.suggest, fused=True),
+        max_evals=n_trials,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+        return_argmin=False,
+    )
+    buf = next(iter(trials._buffers.values()))
+    # the trailing pre-dispatch (enqueued after the last result, never
+    # consumed) is driver wind-down, not per-trial cost
+    return (buf.dispatch_count - 1) / n_trials
 
 
 def bench_device_loop(n_evals=8192, batch=128):
@@ -473,7 +606,14 @@ def main():
     latency_rate, latency_sync_rate = bench_jax_latency(
         domain, trials, n_cand=n_cand
     )
+    fused_sync_rate = bench_fused_latency(domain, trials, n_cand=n_cand)
     spec_rate = bench_spec_latency(domain, trials, n_cand=n_cand)
+    # round-7 traffic/dispatch contract rows: counted deterministically,
+    # so they are comparable across platforms and rounds (no timing)
+    transfer_rows = bench_transfer_per_ask(space, obs_sweep_sizes)
+    dispatches_per_trial = bench_fused_dispatches(
+        n_trials=min(120, n_trials_1k)
+    )
     loop_rate = bench_device_loop() if platform != "cpu" else None
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
@@ -519,7 +659,12 @@ def main():
                 ),
                 "single_suggest_per_sec": round(latency_rate, 1),
                 "single_suggest_sync_per_sec": round(latency_sync_rate, 1),
+                "single_suggest_fused_sync_per_sec": round(
+                    fused_sync_rate, 1
+                ),
                 "speculative_suggest_per_sec": round(spec_rate, 1),
+                "host_to_device_bytes_per_ask": transfer_rows,
+                "dispatches_per_trial": round(dispatches_per_trial, 3),
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),

@@ -23,12 +23,17 @@ _default_avg_best_idx = 2.0
 _default_shrink_coef = 0.1
 
 
-def build_anneal_fn(ps, avg_best_idx, shrink_coef):
+def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
     """Compile the full annealing suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
     (new_values [D, B], new_active [D, B])`` with ``batch`` static.
-    Matches :class:`hyperopt_tpu.anneal.AnnealingAlgo` semantics:
+    ``state_io=True`` returns the fused tell+ask variant instead (same
+    contract as :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`'s: a
+    staged O(D) observation delta is applied to the donated state
+    buffers and the suggestion drawn from the updated history, one
+    dispatch total).  Matches
+    :class:`hyperopt_tpu.anneal.AnnealingAlgo` semantics:
 
     * anchor trial per suggestion: rank ``geometric(1/avg_best_idx) - 1``
       into the loss-sorted ok history (clamped);
@@ -117,24 +122,54 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef):
 
         return new_values, ps.active_fn(new_values)
 
-    return jax.jit(fn, static_argnames=("batch",))
+    if not state_io:
+        return jax.jit(fn, static_argnames=("batch",))
+
+    from .ops import kernels as K
+
+    def fused(key, values, active, losses, valid, vcol, acol, loss, idx,
+              batch):
+        state = K.apply_delta(
+            values, active, losses, valid, vcol, acol, loss, idx
+        )
+        new_values, new_active = fn(key, *state, batch)
+        return tuple(state) + (new_values, new_active)
+
+    return jax.jit(
+        fused, static_argnames=("batch",), donate_argnums=(1, 2, 3, 4)
+    )
+
+
+def _anneal_builder(ps_, abi, sc, sio):
+    return build_anneal_fn(ps_, abi, sc, state_io=sio)
 
 
 def _dense_draw(domain, trials, seed, batch, avg_best_idx, shrink_coef):
     import jax
+
+    from .tpe_jax import _state_dispatch
 
     ps = packed_space_for(domain)
     buf = obs_buffer_for(domain, trials)
     key = host_key(int(seed) % (2**31 - 1))
 
     if buf.count == 0:
+        buf.dispatch_count += 1
         values, active = ps.sample_prior(key, batch)
     else:
+        params = (float(avg_best_idx), float(shrink_coef))
         fn = cached_suggest_fn(
-            domain, "_anneal_jax_cache",
-            (float(avg_best_idx), float(shrink_coef)), build_anneal_fn,
+            domain, "_anneal_jax_cache", params + (False,), _anneal_builder,
         )
-        values, active = fn(key, *buf.device_arrays(), batch=batch)
+        fused = (
+            cached_suggest_fn(
+                domain, "_anneal_jax_cache", params + (True,),
+                _anneal_builder,
+            )
+            if buf.resident
+            else None
+        )
+        values, active = _state_dispatch(buf, key, batch, None, fn, fused)
     return jax.device_get((values, active))
 
 
@@ -166,6 +201,7 @@ def suggest(
     shrink_coef=_default_shrink_coef,
     speculative=0,
     max_stale=None,
+    resident=None,
 ):
     """The TPU plugin-boundary entry point: ``algo=anneal_jax.suggest``.
 
@@ -173,8 +209,17 @@ def suggest(
     (same cache/staleness semantics as :func:`tpe_jax.suggest`: the
     anchor distribution refreshes on every redraw, and the cache
     invalidates once the history moves past ``max_stale``).
+
+    ``resident=True`` keeps the observation mirror device-resident:
+    sequential tells become O(D) deltas and, with exactly one tell
+    pending, the delta is fused into the ask dispatch via the
+    ``state_io`` program variant -- same one-dispatch semantics and
+    bitwise-identical suggestions as :func:`tpe_jax.suggest`'s resident
+    path (shared :func:`tpe_jax._state_dispatch` engine).
     """
     ps = packed_space_for(domain)
+    if resident is not None:
+        obs_buffer_for(domain, trials, resident=bool(resident))
     if speculative and len(new_ids) == 1:
         from .tpe_jax import _cast_vals, _speculative_cols
 

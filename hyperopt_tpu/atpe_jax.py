@@ -412,6 +412,7 @@ def suggest(
     speculative=0,
     max_stale=None,
     mesh=None,
+    resident=None,
 ):
     """``algo=atpe_jax.suggest``: adaptive TPE with the device sweep.
 
@@ -426,12 +427,20 @@ def suggest(
     the mesh's ``cand`` axis (the adaptive candidate count becomes the
     TOTAL across devices), like
     :func:`hyperopt_tpu.parallel.sharded.sharded_suggest` for plain TPE.
+
+    ``resident=True`` flips the observation mirror to device-resident
+    mode: the adaptive layer's device sweep runs through
+    ``tpe_jax.suggest_dense``, so its warm draws inherit the O(D)
+    delta-tell / fused-dispatch state engine unchanged (the host-side
+    restart/lock rolls are posterior-independent and unaffected).
     """
     from . import tpe_jax
 
     rng = ensure_rng(seed)
     opt = _optimizer_for(domain, lock_fraction, elite_count)
     ps = packed_space_for(domain)
+    if resident is not None:
+        obs_buffer_for(domain, trials, resident=bool(resident))
     B = len(new_ids)
 
     if speculative and B == 1:

@@ -343,6 +343,11 @@ def compile_fmin(
             x, NamedSharding(mesh, P(*spec_tail))
         )
 
+    # the scan carry is the SAME state container the resident ObsBuffer
+    # mirror and the fused tell+ask programs thread (one shape for "the
+    # history as a unit" across every execution model)
+    from .ops.kernels import HistoryState
+
     def step(base_key, c0, carry, i):
         values, active, losses, valid = carry
         # fold the warm offset too: a resumed run must not replay the
@@ -358,7 +363,7 @@ def compile_fmin(
         active = active.at[:, idx].set(new_act)
         losses = losses.at[idx].set(new_losses)
         valid = valid.at[idx].set(True)
-        return (values, active, losses, valid), new_losses
+        return HistoryState(values, active, losses, valid), new_losses
 
     @jax.jit
     def run(seed_arr, values, active, losses, valid, c0, best0):
@@ -366,7 +371,7 @@ def compile_fmin(
         if loss_threshold is None and no_progress_steps is None:
             (values, active, losses, valid), _ = jax.lax.scan(
                 lambda carry, i: step(base_key, c0, carry, i),
-                (values, active, losses, valid),
+                HistoryState(values, active, losses, valid),
                 jnp.arange(n_steps),
             )
             n_done = jnp.int32(n_steps)
@@ -404,7 +409,8 @@ def compile_fmin(
                 jax.lax.while_loop(
                     cond, body,
                     (jnp.int32(0), best0 <= thr, best0,
-                     jnp.int32(0), (values, active, losses, valid)),
+                     jnp.int32(0),
+                     HistoryState(values, active, losses, valid)),
                 )
             )
         ok = valid & jnp.isfinite(losses)

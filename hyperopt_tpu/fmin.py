@@ -147,6 +147,10 @@ class FMinIter:
         self.early_stop_fn = early_stop_fn
         self.early_stop_args = []
         self.trials_save_file = trials_save_file
+        # ask-ahead seam (sequential driver): seed pre-drawn for the NEXT
+        # ask so an algo's result hook can pre-dispatch it -- see
+        # _notify_result
+        self._ask_ahead_seed = None
 
         if self.asynchronous:
             # async workers fetch the Domain by attachment (SURVEY.md SS3.4)
@@ -163,6 +167,43 @@ class FMinIter:
         if hasattr(self.rstate, "integers"):
             return int(self.rstate.integers(2**31 - 1))
         return int(self.rstate.randint(2**31 - 1))
+
+    def _take_seed(self):
+        """The next ask's seed: the one pre-drawn for the ask-ahead hook
+        if a result notification already drew it, else a fresh draw.
+        Exactly one seed is consumed per ask either way, so the rstate
+        stream -- and therefore the suggestion stream -- is identical
+        with and without an ask-ahead hook installed."""
+        seed = self._ask_ahead_seed
+        if seed is not None:
+            self._ask_ahead_seed = None
+            return seed
+        return self._draw_seed()
+
+    def _notify_result(self):
+        """Ask-ahead seam of the sequential driver: right after a result
+        is recorded, give the algo's registered hook
+        (``domain._ask_ahead_hook``, installed e.g. by
+        ``tpe_jax.suggest(fused=True)``) the chance to pre-dispatch the
+        next suggestion -- the fused tell+ask device program is then in
+        flight while the driver does its host-side bookkeeping, and the
+        next ask only blocks on the fetch.  The seed is pre-drawn from
+        the same rstate stream the ask would use (``_take_seed`` hands
+        it back), so pre-dispatched and plain asks see identical seeds.
+        A hook failure disables the hook and falls back to plain asks:
+        ask-ahead is an optimization, never a correctness dependency."""
+        hook = getattr(self.domain, "_ask_ahead_hook", None)
+        if hook is None:
+            return
+        if self._ask_ahead_seed is None:
+            self._ask_ahead_seed = self._draw_seed()
+        try:
+            hook(self.trials, self._ask_ahead_seed)
+        except Exception:
+            logger.exception(
+                "ask-ahead hook failed; continuing with plain asks"
+            )
+            self.domain._ask_ahead_hook = None
 
     # -- stopping rules ----------------------------------------------------
     def _timed_out(self):
@@ -216,6 +257,7 @@ class FMinIter:
                 trial["state"] = JOB_STATE_DONE
                 trial["result"] = base.SONify(result)
                 trial["refresh_time"] = coarse_utcnow()
+                self._notify_result()
             N -= 1
             if N == 0:
                 break
@@ -269,7 +311,7 @@ class FMinIter:
                         break
                     new_ids = trials.new_trial_ids(n_to_enqueue)
                     self.trials.refresh()
-                    new_trials = algo(new_ids, self.domain, trials, self._draw_seed())
+                    new_trials = algo(new_ids, self.domain, trials, self._take_seed())
                     if new_trials is StopExperiment:
                         stopped = True
                         break

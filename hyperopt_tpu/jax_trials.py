@@ -27,6 +27,29 @@ __all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY", "GROWTH_FACTOR"]
 MIN_CAPACITY = 128
 GROWTH_FACTOR = 4
 
+# Resident mode: past this many staged-but-unapplied delta tells, one full
+# re-materialization is cheaper (and simpler) than a chain of delta
+# dispatches -- only reachable when many tells land between asks (long
+# startup phases, batched completions), never in the 1-tell-per-ask
+# sequential driver the delta path exists for.
+MAX_PENDING_DELTAS = 32
+
+_APPLY_DELTA = None  # lazily-built jitted delta program (donated state)
+
+
+def _apply_delta_fn():
+    global _APPLY_DELTA
+    if _APPLY_DELTA is None:
+        import jax
+
+        from .ops.kernels import apply_delta
+
+        # donate_argnums: the old state buffers are dead the moment the
+        # delta applies -- donation lets XLA update in place instead of
+        # holding two copies of the bucketed history in device memory
+        _APPLY_DELTA = jax.jit(apply_delta, donate_argnums=(0, 1, 2, 3))
+    return _APPLY_DELTA
+
 
 class ObsBuffer:
     """Dense, capacity-bucketed mirror of completed trials for one space.
@@ -37,9 +60,25 @@ class ObsBuffer:
       losses: [cap]
       valid:  [cap] slot occupancy
     Slots are tid-ordered (time order for forgetting weights).
+
+    ``resident=True`` keeps a device-side mirror of the four arrays that
+    is updated INCREMENTALLY: each in-order ``add`` stages an O(D) delta
+    (one value/active column + one loss scalar) applied by a jitted
+    ``dynamic_update_slice`` program with donated state buffers, instead
+    of re-uploading the whole bucketed history on every generation bump
+    (the O(n_obs*D)-bytes-per-ask term that left the sequential driver
+    dispatch-bound -- BENCH_r05).  Bucket growth, out-of-order tid
+    inserts, and rebuilds re-materialize the mirror exactly as the
+    non-resident log schedule does; the host arrays stay the source of
+    truth either way, so the resident view is bitwise identical to a
+    fresh upload at every step.  Deterministic counters
+    (``transfer_bytes_total`` / ``delta_tells`` / ``full_uploads`` /
+    ``dispatch_count``) expose the traffic and dispatch behavior for
+    benchmarks and regression pins.
     """
 
-    def __init__(self, space: PackedSpace, capacity=MIN_CAPACITY):
+    def __init__(self, space: PackedSpace, capacity=MIN_CAPACITY,
+                 resident=False):
         self.space = space
         self.capacity = int(capacity)
         D = space.n_dims
@@ -54,6 +93,15 @@ class ObsBuffer:
         self._legacy_tids = False  # loaded from a checkpoint without tids
         self._generation = 0  # bumped on every mutation
         self._device_cache = None  # ((generation, bucket), arrays-on-device)
+        self.resident = bool(resident)
+        self._resident = None  # {"bucket": int, "arrays": HistoryState}
+        self._resident_full = True  # mirror needs a full materialization
+        self._pending_deltas = []  # [(slot, values-col, active-col, loss)]
+        # deterministic traffic/dispatch accounting (counted, not timed)
+        self.transfer_bytes_total = 0
+        self.delta_tells = 0
+        self.full_uploads = 0
+        self.dispatch_count = 0
 
     def _grow(self):
         new_cap = self.capacity * GROWTH_FACTOR
@@ -102,6 +150,18 @@ class ObsBuffer:
         self.valid[n] = True  # occupancy is a prefix mask
         self.count = n + 1
         self._generation += 1
+        if self.resident:
+            if i == n and len(self._pending_deltas) < MAX_PENDING_DELTAS:
+                # in-order append: stage the O(D) delta for the mirror
+                self._pending_deltas.append((
+                    n, self.values[:, n].copy(), self.active[:, n].copy(),
+                    float(loss),
+                ))
+            else:
+                # late insert shifted the tail (or the delta backlog is
+                # past the crossover): re-materialize on next use
+                self._resident_full = True
+                self._pending_deltas.clear()
 
     @property
     def _label_pos(self):
@@ -140,7 +200,7 @@ class ObsBuffer:
             # shrunk list (delete_all) OR a legacy checkpoint whose tids
             # were synthesized as arange (only valid for contiguous-tid
             # runs): rebuild from the doc list, the source of truth
-            self.__init__(self.space, MIN_CAPACITY)
+            self.__init__(self.space, MIN_CAPACITY, resident=self.resident)
 
         before = self.count
         still_pending = []
@@ -198,17 +258,126 @@ class ObsBuffer:
         by (generation, bucket): repeated suggest calls against
         unchanged history transfer nothing (the 'on-device history'
         contract of the north star).  ``pow2_cap`` coarsens the bucket
-        schedule past a compaction cap (see :meth:`_device_bucket`)."""
+        schedule past a compaction cap (see :meth:`_device_bucket`).
+
+        In resident mode the return value is the incrementally-updated
+        device mirror: staged delta tells are applied by the jitted
+        O(D) delta program (one dispatch each) and a full upload happens
+        only on the first use, at bucket growth, and after out-of-order
+        inserts -- the log schedule, not once per observation."""
+        if self.resident:
+            return self._resident_sync(pow2_cap)
         b = self._device_bucket(pow2_cap)
         key = (self._generation, b)
         if self._device_cache is None or self._device_cache[0] != key:
             import jax
 
+            arrays = tuple(a[..., :b] for a in self.arrays())
+            self.transfer_bytes_total += sum(a.nbytes for a in arrays)
+            self.full_uploads += 1
             self._device_cache = (
                 key,
-                tuple(jax.device_put(a[..., :b]) for a in self.arrays()),
+                tuple(jax.device_put(a) for a in arrays),
             )
         return self._device_cache[1]
+
+    def set_resident(self, flag):
+        """Flip the device mirror between resident (incremental-delta)
+        and re-upload mode.  The host arrays are authoritative either
+        way, so flipping is always safe; the next :meth:`device_arrays`
+        call (re)materializes whichever view is now active."""
+        flag = bool(flag)
+        if flag == self.resident:
+            return
+        self.resident = flag
+        self._resident = None
+        self._resident_full = True
+        self._pending_deltas = []
+        self._device_cache = None
+
+    _DELTA_BYTES_FIXED = 8  # loss float32 + slot index int32
+
+    def _delta_nbytes(self, vcol, acol):
+        return vcol.nbytes + acol.nbytes + self._DELTA_BYTES_FIXED
+
+    def _materialize_resident(self, b):
+        import jax
+
+        from .ops.kernels import HistoryState
+
+        arrays = tuple(a[..., :b] for a in self.arrays())
+        self.transfer_bytes_total += sum(a.nbytes for a in arrays)
+        self.full_uploads += 1
+        self._resident = {
+            "bucket": b,
+            "arrays": HistoryState(*(jax.device_put(a) for a in arrays)),
+        }
+        self._pending_deltas.clear()
+        self._resident_full = False
+
+    def _resident_sync(self, pow2_cap=None):
+        """Bring the device mirror up to date and return it."""
+        b = self._device_bucket(pow2_cap)
+        st = self._resident
+        if st is None or st["bucket"] != b or self._resident_full:
+            self._materialize_resident(b)
+        elif self._pending_deltas:
+            apply_delta = _apply_delta_fn()
+            arrays = st["arrays"]
+            for slot, vcol, acol, loss in self._pending_deltas:
+                arrays = apply_delta(
+                    *arrays, vcol, acol, np.float32(loss), np.int32(slot)
+                )
+                self.transfer_bytes_total += self._delta_nbytes(vcol, acol)
+                self.delta_tells += 1
+                self.dispatch_count += 1
+            self._pending_deltas.clear()
+            st["arrays"] = arrays
+        return self._resident["arrays"]
+
+    def take_fusable_delta(self, pow2_cap=None):
+        """Pop the single pending delta for a fused tell+ask dispatch.
+
+        Returns ``(state, (vcol, acol, loss, slot))`` -- the current
+        resident :class:`~hyperopt_tpu.ops.kernels.HistoryState` plus
+        the staged O(D) delta -- when the one-dispatch fused path can
+        run: the mirror exists at the CURRENT bucket and exactly one
+        in-order tell is pending.  The caller owns the handoff: it must
+        feed both to a ``state_io`` suggest program and commit the
+        returned state via :meth:`commit_resident` (the old buffers are
+        donated).  Returns ``None`` when the fused path cannot run
+        (cold mirror, bucket growth, zero or multiple pending tells) --
+        callers fall back to :meth:`device_arrays` + a plain ask.
+        """
+        if not self.resident or self._resident_full or self._resident is None:
+            return None
+        if len(self._pending_deltas) != 1:
+            return None
+        if self._resident["bucket"] != self._device_bucket(pow2_cap):
+            return None
+        slot, vcol, acol, loss = self._pending_deltas.pop()
+        self.transfer_bytes_total += self._delta_nbytes(vcol, acol)
+        self.delta_tells += 1
+        return self._resident["arrays"], (
+            vcol, acol, np.float32(loss), np.int32(slot),
+        )
+
+    def commit_resident(self, arrays):
+        """Install a fused program's state outputs as the mirror (the
+        counterpart of :meth:`take_fusable_delta`)."""
+        from .ops.kernels import HistoryState
+
+        self._resident["arrays"] = HistoryState(*arrays)
+
+    def __getstate__(self):
+        # device-side state never pickles (checkpoints/attachments carry
+        # the host arrays; mirrors rebuild on first use after load)
+        state = self.__dict__.copy()
+        state["_device_cache"] = None
+        state["_resident"] = None
+        state["_resident_full"] = True
+        state["_pending_deltas"] = []
+        return state
 
 
 class JaxTrials(Trials):
@@ -218,17 +387,28 @@ class JaxTrials(Trials):
     Use exactly like ``Trials``; the JAX algorithms
     (:mod:`hyperopt_tpu.tpe_jax`, :mod:`hyperopt_tpu.rand_jax`) detect it
     and reuse its buffers instead of maintaining their own.
+
+    ``resident=True`` makes every owned buffer device-resident: tells
+    stage O(D) deltas instead of invalidating the device cache (see
+    :class:`ObsBuffer`), which is what the fused sequential driver
+    (``tpe_jax.suggest(fused=True)``) wants under it.
     """
 
-    def __init__(self, exp_key=None, refresh=True):
+    def __init__(self, exp_key=None, refresh=True, resident=False):
         self._buffers = {}  # id(PackedSpace) -> ObsBuffer
+        self._resident_default = bool(resident)
         super().__init__(exp_key=exp_key, refresh=refresh)
 
-    def obs_buffer(self, space: PackedSpace) -> ObsBuffer:
+    def obs_buffer(self, space: PackedSpace, resident=None) -> ObsBuffer:
         buf = self._buffers.get(id(space))
         if buf is None:
-            buf = ObsBuffer(space)
+            buf = ObsBuffer(
+                space,
+                resident=getattr(self, "_resident_default", False),
+            )
             self._buffers[id(space)] = buf
+        if resident is not None:
+            buf.set_resident(resident)
         buf.sync(self)
         return buf
 
@@ -239,18 +419,20 @@ class JaxTrials(Trials):
         return state
 
 
-def obs_buffer_for(domain, trials) -> ObsBuffer:
+def obs_buffer_for(domain, trials, resident=None) -> ObsBuffer:
     """The shared entry point used by the JAX algos: prefer the JaxTrials
-    resident buffer, else a buffer cached on the domain.
+    store-owned buffer, else a buffer cached on the domain.
 
     The domain-side cache keys on the trials-store identity (weakref): a
     Domain reused across two stores must never serve one store's
-    observations for the other."""
+    observations for the other.  ``resident`` (None = leave as-is)
+    flips the buffer's device-mirror mode (:meth:`ObsBuffer.
+    set_resident`) -- the knob the resident/fused suggest paths use."""
     import weakref
 
     space = packed_space_for(domain)
     if isinstance(trials, JaxTrials):
-        return trials.obs_buffer(space)
+        return trials.obs_buffer(space, resident=resident)
     cached = getattr(domain, "_obs_buffer", None)
     buf = None
     if cached is not None:
@@ -260,6 +442,8 @@ def obs_buffer_for(domain, trials) -> ObsBuffer:
     if buf is None:
         buf = ObsBuffer(space)
         domain._obs_buffer = (weakref.ref(trials), buf)
+    if resident is not None:
+        buf.set_resident(resident)
     buf.sync(trials)
     return buf
 

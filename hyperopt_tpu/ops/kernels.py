@@ -16,6 +16,8 @@ values -- so a single ``jit`` covers the whole suggest step.
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,6 +25,8 @@ from jax.scipy.special import ndtr, ndtri
 
 __all__ = [
     "DEFAULT_ABOVE_CAP",
+    "HistoryState",
+    "apply_delta",
     "check_prior_weight",
     "compact_gmm",
     "forgetting_weights",
@@ -46,6 +50,57 @@ __all__ = [
     "ei_sweep_cat_scores",
     "fit_all_dims",
 ]
+
+
+class HistoryState(NamedTuple):
+    """The four dense observation arrays every suggest path threads.
+
+    One container for the three places the history lives as a unit: the
+    resident :class:`hyperopt_tpu.jax_trials.ObsBuffer` device mirror,
+    the fused tell+ask programs (state in, state out, donated), and the
+    :mod:`hyperopt_tpu.device_loop` scan carry.  A NamedTuple is a
+    pytree, so it crosses jit/scan boundaries as-is and unpacks with
+    ``*state`` wherever the four positional arrays are expected.
+    """
+
+    values: jax.Array  # [D, cap] natural-space draws
+    active: jax.Array  # [D, cap] per-dim activity mask
+    losses: jax.Array  # [cap]
+    valid: jax.Array  # [cap] slot occupancy
+
+
+def apply_delta(values, active, losses, valid, vcol, acol, loss, idx):
+    """Stage one completed trial into the history: an O(D) delta tell.
+
+    The incremental alternative to re-uploading the whole bucketed
+    history on every generation bump (the O(n_obs*D) term that made the
+    sequential driver dispatch-bound): one value/active column, one
+    loss scalar, one slot index -- ~5*D+8 bytes of host->device traffic
+    -- applied by ``dynamic_update_slice`` so a single compiled program
+    covers every slot of a bucket (``idx`` is traced; no per-slot
+    retrace).  The write is pure data movement, so the updated state is
+    bitwise identical to a fresh upload of the same host arrays -- the
+    parity contract the resident ObsBuffer and the fused tell+ask
+    programs both rely on.  Only in-order appends come through here
+    (``valid`` is a prefix mask, so the new slot is simply marked
+    occupied); a late out-of-order completion shifts the tail on the
+    host and re-materializes.
+    """
+    idx = jnp.asarray(idx, jnp.int32)
+    zero = jnp.int32(0)
+    values = jax.lax.dynamic_update_slice(
+        values, jnp.asarray(vcol, values.dtype)[:, None], (zero, idx)
+    )
+    active = jax.lax.dynamic_update_slice(
+        active, jnp.asarray(acol, active.dtype)[:, None], (zero, idx)
+    )
+    losses = jax.lax.dynamic_update_slice(
+        losses, jnp.asarray(loss, losses.dtype)[None], (idx,)
+    )
+    valid = jax.lax.dynamic_update_slice(
+        valid, jnp.ones((1,), valid.dtype), (idx,)
+    )
+    return HistoryState(values, active, losses, valid)
 
 
 def check_prior_weight(prior_weight):

@@ -43,13 +43,26 @@ def per_device_count(total, n_dev):
 
 
 def _shard_map():
+    import functools
+    import inspect
+
     import jax
 
     if hasattr(jax, "shard_map"):
-        return jax.shard_map
-    from jax.experimental.shard_map import shard_map as sm  # pragma: no cover
+        sm = jax.shard_map
+    else:  # pragma: no cover
+        from jax.experimental.shard_map import shard_map as sm
+    if "check_vma" in inspect.signature(sm).parameters:
+        return sm
 
-    return sm
+    # older jax spells the replication-check knob ``check_rep``
+    @functools.wraps(sm)
+    def compat(*args, check_vma=None, **kwargs):
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+        return sm(*args, **kwargs)
+
+    return compat
 
 
 def build_sharded_sweep(ps, mesh, n_cand_per_device, axis=CAND_AXIS,

@@ -63,13 +63,25 @@ def _resolve_above_cap(above_cap):
 
 
 def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
-                     n_cand_cat=None, above_cap=None):
+                     n_cand_cat=None, above_cap=None, state_io=False):
     """Compile the full TPE suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
     (new_values [D, B], new_active [D, B])`` with ``batch`` static.
     Buffer capacity is baked into the trace via the array shapes
     (power-of-2 bucketed by ObsBuffer -> bounded recompiles).
+
+    ``state_io=True`` returns the FUSED tell+ask variant instead:
+    ``fn(key, values, active, losses, valid, vcol, acol, loss, idx,
+    batch) -> (values', active', losses', valid', new_values,
+    new_active)`` -- one dispatch applies a staged O(D) observation
+    delta (:func:`ops.kernels.apply_delta`) to the DONATED state
+    buffers AND draws the next suggestion from the updated posterior,
+    halving the sequential driver's round trips.  The suggest body is
+    the same closure either way, so at equal state the two variants'
+    suggestion streams are bitwise identical (the delta write is pure
+    data movement); see :func:`_state_dispatch` for the driver that
+    pairs this with :meth:`ObsBuffer.take_fusable_delta`.
 
     ``n_cand_cat`` sets a separate candidate count for categorical-family
     dims (None = same as ``n_cand``).  Rationale (measured, BASELINE.md
@@ -189,7 +201,20 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
         return new_values, ps.active_fn(new_values)
 
     fn = fn_joint if joint_ei else fn_factorized
-    return jax.jit(fn, static_argnames=("batch",))
+    if not state_io:
+        return jax.jit(fn, static_argnames=("batch",))
+
+    def fused(key, values, active, losses, valid, vcol, acol, loss, idx,
+              batch):
+        state = K.apply_delta(
+            values, active, losses, valid, vcol, acol, loss, idx
+        )
+        new_values, new_active = fn(key, *state, batch)
+        return tuple(state) + (new_values, new_active)
+
+    return jax.jit(
+        fused, static_argnames=("batch",), donate_argnums=(1, 2, 3, 4)
+    )
 
 
 def _cast_vals(ps, idxs, vals):
@@ -201,6 +226,95 @@ def _cast_vals(ps, idxs, vals):
         else:
             vals[label] = [float(v) for v in vals[label]]
     return idxs, vals
+
+
+def _state_dispatch(buf, key, batch, pow2_cap, plain_fn, fused_fn):
+    """Serve one dense draw over ``buf`` in ONE device dispatch whenever
+    the state allows it -- the shared engine of every resident suggest
+    path (TPE here, :mod:`hyperopt_tpu.anneal_jax`, and the speculative
+    k-wide redraws, which all route their warm draws through it).
+
+    With a resident buffer holding exactly one staged tell at an
+    unchanged bucket, the fused ``state_io`` program applies the delta
+    and draws the suggestion in a single dispatch (the buffer's mirror
+    is swapped for the program's state outputs -- the old buffers were
+    donated).  Otherwise -- non-resident buffer, cold mirror, bucket
+    growth, or a multi-tell backlog -- the staged deltas (or a full
+    upload, on the log schedule) flow through :meth:`ObsBuffer.
+    device_arrays` and the plain program draws from the settled state.
+    Both legs run the same suggest closure on bitwise-equal state, so
+    the suggestion stream does not depend on which leg served an ask.
+
+    Returns DEVICE (values, active) -- no host fetch, so callers that
+    pre-dispatch (the ask-ahead hook) stay non-blocking.
+    """
+    if fused_fn is not None:
+        fusable = buf.take_fusable_delta(pow2_cap)
+        if fusable is not None:
+            state, delta = fusable
+            out = fused_fn(key, *state, *delta, batch=batch)
+            buf.commit_resident(out[:4])
+            buf.dispatch_count += 1
+            return out[4], out[5]
+    arrays = buf.device_arrays(pow2_cap=pow2_cap)
+    buf.dispatch_count += 1
+    return plain_fn(key, *arrays, batch=batch)
+
+
+def _tpe_builder(ps_, nc, g, lf, pw, je, ncc, ac, sio):
+    return build_suggest_fn(
+        ps_, nc, g, lf, pw, joint_ei=je, n_cand_cat=ncc,
+        above_cap=0 if ac is None else ac, state_io=sio,
+    )
+
+
+def _dense_dispatch(
+    domain,
+    trials,
+    seed,
+    batch,
+    prior_weight=_default_prior_weight,
+    n_startup_jobs=_default_n_startup_jobs,
+    n_EI_candidates=_default_n_EI_candidates,
+    gamma=_default_gamma,
+    linear_forgetting=_default_linear_forgetting,
+    joint_ei=False,
+    n_EI_candidates_cat=_default_n_EI_candidates_cat,
+    above_cap=None,
+):
+    """Device half of :func:`suggest_dense`: returns DEVICE (values,
+    active) without blocking on the result -- the ask-ahead hook calls
+    this to enqueue the next dispatch behind the objective evaluation."""
+    ps = packed_space_for(domain)
+    buf = obs_buffer_for(domain, trials)
+    key = host_key(int(seed) % (2**31 - 1))
+
+    if buf.count < n_startup_jobs:
+        buf.dispatch_count += 1
+        return ps.sample_prior(key, batch)
+
+    n_cat = (
+        None if n_EI_candidates_cat is None else int(n_EI_candidates_cat)
+    )
+    a_cap = _resolve_above_cap(above_cap)
+    params = (
+        int(n_EI_candidates), float(gamma), float(linear_forgetting),
+        float(prior_weight), bool(joint_ei), n_cat, a_cap,
+    )
+    fn = cached_suggest_fn(
+        domain, "_tpe_jax_cache", params + (False,), _tpe_builder
+    )
+    fused = (
+        cached_suggest_fn(
+            domain, "_tpe_jax_cache", params + (True,), _tpe_builder
+        )
+        if buf.resident
+        else None
+    )
+    # with compaction active the scoring width is static, so the
+    # device view stops pow2 re-bucketing past the cap (fewer
+    # retraces; only the cheap fit pays the coarser padding)
+    return _state_dispatch(buf, key, batch, a_cap, fn, fused)
 
 
 def suggest_dense(
@@ -220,37 +334,25 @@ def suggest_dense(
     """Dense draws for a batch: (values [D, batch], active [D, batch]) as
     host numpy -- one device program (prior during startup, TPE after).
     The shared engine under :func:`suggest_batch` and adaptive variants
-    (:mod:`hyperopt_tpu.atpe_jax`)."""
+    (:mod:`hyperopt_tpu.atpe_jax`).  Over a resident buffer
+    (``ObsBuffer.resident`` / ``JaxTrials(resident=True)``) the warm
+    draw is the state-in/state-out path of :func:`_state_dispatch`:
+    staged tells ride along as O(D) deltas -- fused into the very same
+    dispatch when exactly one is pending -- instead of re-uploading the
+    bucketed history."""
     import jax
 
-    ps = packed_space_for(domain)
-    buf = obs_buffer_for(domain, trials)
-    key = host_key(int(seed) % (2**31 - 1))
-
-    if buf.count < n_startup_jobs:
-        values, active = ps.sample_prior(key, batch)
-    else:
-        n_cat = (
-            None if n_EI_candidates_cat is None else int(n_EI_candidates_cat)
-        )
-        a_cap = _resolve_above_cap(above_cap)
-        fn = cached_suggest_fn(
-            domain, "_tpe_jax_cache",
-            (int(n_EI_candidates), float(gamma), float(linear_forgetting),
-             float(prior_weight), bool(joint_ei), n_cat, a_cap),
-            lambda ps_, nc, g, lf, pw, je, ncc, ac: build_suggest_fn(
-                ps_, nc, g, lf, pw, joint_ei=je, n_cand_cat=ncc,
-                above_cap=0 if ac is None else ac,
-            ),
-        )
-        # with compaction active the scoring width is static, so the
-        # device view stops pow2 re-bucketing past the cap (fewer
-        # retraces; only the cheap fit pays the coarser padding)
-        values, active = fn(
-            key, *buf.device_arrays(pow2_cap=a_cap), batch=batch
-        )
-
-    return jax.device_get((values, active))
+    return jax.device_get(_dense_dispatch(
+        domain, trials, seed, batch,
+        prior_weight=prior_weight,
+        n_startup_jobs=n_startup_jobs,
+        n_EI_candidates=n_EI_candidates,
+        gamma=gamma,
+        linear_forgetting=linear_forgetting,
+        joint_ei=joint_ei,
+        n_EI_candidates_cat=n_EI_candidates_cat,
+        above_cap=above_cap,
+    ))
 
 
 def suggest_batch(
@@ -377,6 +479,82 @@ def _speculative_cols(domain, trials, seed, k, max_stale, params,
     return values[:, :1], active[:, :1]
 
 
+def _kw_key(kw):
+    """Hashable identity of a suggest-kwarg dict (ask-ahead matching)."""
+    return tuple(sorted((k, v) for k, v in kw.items()))
+
+
+def _ask_ahead_state(domain):
+    st = getattr(domain, "_ask_ahead_state", None)
+    if st is None:
+        st = {"pending": None, "hook_key": None}
+        domain._ask_ahead_state = st
+    return st
+
+
+def _install_ask_ahead(domain, kw):
+    """Register the sequential driver's result hook (idempotent per kw).
+
+    The hook is the ask-ahead half of the fused driver: the driver
+    (``FMinIter.serial_evaluate``) calls it right after recording a
+    loss, passing the seed it will hand the NEXT ask (pre-drawn from
+    the same rstate stream, so seed order -- and therefore the
+    suggestion stream -- is identical to the un-hooked driver).  The
+    hook enqueues the fused tell+ask dispatch WITHOUT fetching, so the
+    device round trip overlaps the driver's host-side bookkeeping (and,
+    with a queue, the remaining objective evaluations); the next
+    ``suggest(fused=True)`` call recognizes the pending draw and only
+    then blocks on it.
+    """
+    st = _ask_ahead_state(domain)
+    key = _kw_key(kw)
+    if st["hook_key"] == key and getattr(domain, "_ask_ahead_hook", None):
+        return
+    import weakref
+
+    def hook(trials, seed):
+        out = _dense_dispatch(domain, trials, int(seed), 1, **kw)
+        st["pending"] = {
+            "seed": int(seed),
+            "trials_ref": weakref.ref(trials),
+            "count": obs_buffer_for(domain, trials).count,
+            "kw_key": key,
+            "out": out,
+        }
+
+    domain._ask_ahead_hook = hook
+    st["hook_key"] = key
+
+
+def _fused_ask(domain, trials, seed, kw, ask_ahead):
+    """One sequential ask through the fused driver: consume a matching
+    pre-dispatched suggestion if the ask-ahead hook staged one, else
+    dispatch now (fused with the pending tell when possible)."""
+    import jax
+
+    if ask_ahead:
+        _install_ask_ahead(domain, kw)
+    st = _ask_ahead_state(domain)
+    pending, st["pending"] = st["pending"], None
+    buf = obs_buffer_for(domain, trials, resident=True)
+    if (
+        pending is not None
+        and pending["seed"] == int(seed)
+        and pending["trials_ref"]() is trials
+        and pending["kw_key"] == _kw_key(kw)
+        and pending["count"] == buf.count
+    ):
+        # the tell inside the pre-dispatch is already committed; only
+        # the suggestion is fetched here (blocking at last possible
+        # moment -- the dispatch has been in flight since the result
+        # was recorded)
+        return jax.device_get(pending["out"])
+    # no (matching) pre-dispatch: the staleness guards above dropped a
+    # draw whose posterior or key no longer applies -- its tell stays
+    # committed, only the ask re-runs
+    return jax.device_get(_dense_dispatch(domain, trials, seed, 1, **kw))
+
+
 def suggest(
     new_ids,
     domain,
@@ -392,6 +570,9 @@ def suggest(
     speculative=0,
     max_stale=None,
     above_cap=None,
+    fused=False,
+    resident=None,
+    ask_ahead=None,
 ):
     """The TPU plugin-boundary entry point: ``algo=tpe_jax.suggest``.
 
@@ -418,6 +599,21 @@ def suggest(
     warning -- the trap cannot be hit silently.  To keep speculation on
     such a space, lower the categorical candidate count below the
     option count (draw randomness is the exploration mechanism there).
+
+    ``resident=True`` makes the observation mirror device-resident
+    (O(D) delta tells instead of O(n_obs*D) re-uploads -- see
+    :class:`~hyperopt_tpu.jax_trials.ObsBuffer`); the suggestion stream
+    is bitwise identical to the re-upload path.  ``fused=True``
+    (implies ``resident``) additionally serves sequential asks through
+    the fused tell+ask program -- ONE dispatch per trial, with fresh
+    (zero-staleness) posteriors, unlike ``speculative=k`` -- and, under
+    ``fmin``'s sequential driver, pre-dispatches each ask the moment
+    the previous result is recorded (``ask_ahead``, default on with
+    ``fused``), hiding the device round trip behind the driver's host
+    work.  ``speculative=k`` composes with ``resident`` (the k-wide
+    redraw rides the same delta/fused state engine) and keeps its own
+    staleness semantics; the auto-degrade guard above is build-time
+    space logic and behaves identically on resident state.
     """
     kw = dict(
         prior_weight=prior_weight,
@@ -429,6 +625,19 @@ def suggest(
         n_EI_candidates_cat=n_EI_candidates_cat,
         above_cap=above_cap,
     )
+    if fused and resident is None:
+        resident = True
+    if resident is not None:
+        obs_buffer_for(domain, trials, resident=bool(resident))
+    if fused and not speculative and len(new_ids) == 1:
+        ps = packed_space_for(domain)
+        values, active = _fused_ask(
+            domain, trials, seed, kw,
+            ask_ahead=True if ask_ahead is None else bool(ask_ahead),
+        )
+        idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
+        idxs, vals = _cast_vals(ps, idxs, vals)
+        return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
     if speculative and len(new_ids) == 1:
         ps = packed_space_for(domain)
         n_cat_eff = (

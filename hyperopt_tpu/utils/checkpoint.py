@@ -93,8 +93,10 @@ def _obs_buffer_tree(buf):
         "losses": buf.losses,
         "valid": buf.valid,
         "tids": buf.tids,
-        "count": np.int64(buf.count),
-        "n_scanned": np.int64(buf._n_scanned),
+        # 0-d ndarrays, not np scalars: orbax's standard handler only
+        # accepts array types
+        "count": np.asarray(buf.count, dtype=np.int64),
+        "n_scanned": np.asarray(buf._n_scanned, dtype=np.int64),
         # leading -1 sentinel: orbax cannot save zero-size arrays, and
         # the pending list is empty in the common (no-in-flight) case
         "pending": np.asarray([-1] + list(buf._pending), dtype=np.int64),
@@ -158,7 +160,14 @@ def load_obs_buffer_orbax(space, directory):
     # target always matches what was actually saved
     with ocp.Checkpointer(ocp.StandardCheckpointHandler()) as ckptr:
         arrays_dir = os.path.join(directory, "arrays")
-        tree_meta = ckptr.metadata(arrays_dir).item_metadata.tree
+        meta_obj = ckptr.metadata(arrays_dir)
+        # orbax <= 0.7 returns the metadata tree (a dict) directly;
+        # newer releases wrap it in CheckpointMetadata.item_metadata
+        tree_meta = (
+            meta_obj
+            if isinstance(meta_obj, dict)
+            else meta_obj.item_metadata.tree
+        )
         target = {
             k: np.zeros(m.shape, np.dtype(m.dtype))
             for k, m in tree_meta.items()

@@ -55,3 +55,18 @@ def test_bench_prints_one_json_line():
         assert r["suggestions_per_sec"] > 0
         assert r["full_width_suggestions_per_sec"] > 0
         assert r["compaction_speedup_x"] > 0
+    # round-7: resident-history traffic/dispatch contract rows, counted
+    # deterministically (BENCH_r06 comparable to r01-r05 plus these)
+    assert d["single_suggest_fused_sync_per_sec"] > 0
+    assert d["dispatches_per_trial"] == 1.0
+    rows = d["host_to_device_bytes_per_ask"]
+    assert [r["n_obs"] for r in rows] == [60, 120]
+    for r in rows:
+        assert r["resident_bytes_per_ask"] > 0
+        # the delta tell is O(D); a full re-upload is O(bucket * D)
+        assert (
+            r["full_reupload_bytes_per_ask"] > r["resident_bytes_per_ask"]
+        )
+    # flat in n_obs: the acceptance contract (within 2x across sizes)
+    res = [r["resident_bytes_per_ask"] for r in rows]
+    assert max(res) <= 2 * min(res)

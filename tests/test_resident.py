@@ -1,0 +1,286 @@
+"""Device-resident trial history: O(1) delta tells + fused tell+ask.
+
+The round-7 contract (ISSUE 4): the resident ObsBuffer mirror -- O(D)
+delta tells applied on device instead of O(n_obs*D) re-uploads -- must
+produce a suggestion stream BITWISE equal to the re-upload path, through
+every regime stacked on top of it (fused one-dispatch driver with
+ask-ahead, speculative k-wide draws, the saturated-categorical
+auto-degrade guard, annealing/adaptive variants), across both the
+device-bucket growth boundary and the host ``ObsBuffer._grow`` capacity
+crossing.  Traffic and dispatch behavior is pinned by DETERMINISTIC
+counters, never timing.
+"""
+
+import pickle
+import warnings
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu import anneal_jax, atpe_jax, tpe_jax
+from hyperopt_tpu.base import Domain
+from hyperopt_tpu.fmin import FMinIter, partial
+from hyperopt_tpu.jax_trials import (
+    JaxTrials,
+    MIN_CAPACITY,
+    ObsBuffer,
+    obs_buffer_for,
+)
+from hyperopt_tpu.ops.compile import compile_space
+
+# a small mixed space: uniform + log + quantized + conditional branch
+# with a nested uniform / randint -- every dim family the packer knows
+MIXED = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "q": hp.quniform("q", 0, 10, 1),
+    "c": hp.choice("c", [
+        {"k": 0, "a": hp.uniform("a", 0, 1)},
+        {"k": 1, "b": hp.randint("b", 3)},
+    ]),
+}
+
+
+def mixed_obj(cfg):
+    base = (
+        (cfg["x"] - 1) ** 2 / 10
+        + abs(np.log(cfg["lr"]) + 2) / 3
+        + abs(cfg["q"] - 4) / 5
+    )
+    return base + (
+        cfg["c"]["a"] if cfg["c"]["k"] == 0 else 0.1 * cfg["c"]["b"]
+    )
+
+
+def run_stream(algo, trials, n, seed=7, obj=mixed_obj, space=MIXED):
+    fmin(
+        obj, space, algo=algo, max_evals=n, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        return_argmin=False,
+    )
+    return [t["misc"]["vals"] for t in trials.trials]
+
+
+@pytest.mark.slow
+def test_resident_parity_200_sequential():
+    """200 sequential trials -- past the ``_grow`` capacity crossing
+    (count 128: cap 128 -> 512) AND the device-bucket growth boundary
+    (count 129: bucket 128 -> 256) -- with the resident-delta and the
+    fused ask-ahead streams bitwise equal to the re-upload stream."""
+    kw = dict(n_EI_candidates=16)
+    base = run_stream(partial(tpe_jax.suggest, **kw), Trials(), 200)
+    resident = run_stream(
+        partial(tpe_jax.suggest, resident=True, **kw), Trials(), 200
+    )
+    fused = run_stream(
+        partial(tpe_jax.suggest, fused=True, **kw),
+        JaxTrials(resident=True), 200,
+    )
+    assert len(base) == 200
+    assert base == resident
+    assert base == fused
+
+
+def test_resident_parity_short():
+    """Fast-tier twin of the 200-trial pin: 60 trials, all three
+    regimes bitwise equal (boundary crossings covered by the slow
+    test and by the buffer-level tests below)."""
+    kw = dict(n_EI_candidates=16)
+    base = run_stream(partial(tpe_jax.suggest, **kw), Trials(), 60)
+    resident = run_stream(
+        partial(tpe_jax.suggest, resident=True, **kw), Trials(), 60
+    )
+    fused = run_stream(
+        partial(tpe_jax.suggest, fused=True, **kw),
+        JaxTrials(resident=True), 60,
+    )
+    assert base == resident == fused
+
+
+def test_speculative_parity_on_resident():
+    """speculative=k keeps its exact stream on top of the resident
+    state engine (the k-wide redraws ride the delta/fused dispatch)."""
+    kw = dict(n_EI_candidates=16, speculative=4)
+    base = run_stream(partial(tpe_jax.suggest, **kw), Trials(), 70)
+    resident = run_stream(
+        partial(tpe_jax.suggest, resident=True, **kw), Trials(), 70
+    )
+    assert base == resident
+
+
+def test_saturated_guard_identical_on_resident():
+    """The pure-categorical auto-degrade guard is build-time space
+    logic: same one-time warning, same degraded one-dispatch-per-ask
+    stream, resident or not."""
+    space = {"r": hp.randint("r", 3), "s": hp.randint("s", 4)}
+
+    def obj(cfg):
+        return cfg["r"] * 0.1 + cfg["s"] * 0.01
+
+    streams = {}
+    for resident in (False, True):
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            streams[resident] = run_stream(
+                partial(
+                    tpe_jax.suggest, speculative=4,
+                    resident=True if resident else None,
+                ),
+                Trials(), 40, obj=obj, space=space,
+            )
+        assert sum("speculative=4 disabled" in str(x.message) for x in w) == 1
+    assert streams[False] == streams[True]
+
+
+def test_anneal_resident_parity():
+    base = run_stream(anneal_jax.suggest, Trials(), 60)
+    resident = run_stream(
+        partial(anneal_jax.suggest, resident=True), Trials(), 60
+    )
+    assert base == resident
+
+
+def test_atpe_resident_parity():
+    base = run_stream(atpe_jax.suggest, Trials(), 50)
+    resident = run_stream(
+        partial(atpe_jax.suggest, resident=True), Trials(), 50
+    )
+    assert base == resident
+
+
+def test_fused_dispatch_and_transfer_counters():
+    """Deterministic accounting through the real sequential driver:
+    one dispatch per trial (+ the trailing ask-ahead pre-dispatch), one
+    full upload (cold mirror), every other tell an O(D) delta of
+    exactly 5*D+8 bytes."""
+    domain = Domain(mixed_obj, MIXED)
+    trials = JaxTrials(resident=True)
+    FMinIter(
+        partial(tpe_jax.suggest, fused=True, n_EI_candidates=16),
+        domain, trials, rstate=np.random.default_rng(3),
+        max_evals=60, show_progressbar=False,
+    ).exhaust()
+    buf = next(iter(trials._buffers.values()))
+    D = buf.space.n_dims
+    # 60 asks, each one dispatch, + 1 pre-dispatch after the last result
+    assert buf.dispatch_count == 61
+    assert buf.full_uploads == 1
+    # warm asks 21..60 fused a delta each; the trailing pre-dispatch too
+    assert buf.delta_tells == 40
+    bucket = buf._device_bucket()
+    full_bytes = bucket * (4 * D + D + 4 + 1)
+    delta_bytes = 5 * D + 8
+    assert buf.transfer_bytes_total == (
+        full_bytes + buf.delta_tells * delta_bytes
+    )
+
+
+def test_resident_delta_bytes_flat_in_history_size():
+    """The per-tell upload is O(D) -- independent of the observation
+    count (the acceptance contract the bench rows measure at scale)."""
+    ps = compile_space(MIXED)
+    per_tell = {}
+    for n_obs in (40, 3 * MIN_CAPACITY):
+        buf = ObsBuffer(ps, resident=True)
+        for i in range(n_obs):
+            buf.add({"x": float(i % 7), "q": 1.0}, float(i % 5))
+        buf.device_arrays()  # settle the mirror
+        b0 = buf.transfer_bytes_total
+        buf.add({"x": 0.5, "q": 2.0}, 0.25)
+        buf.device_arrays()
+        per_tell[n_obs] = buf.transfer_bytes_total - b0
+    assert per_tell[40] == per_tell[3 * MIN_CAPACITY] == 5 * ps.n_dims + 8
+
+
+def test_resident_mirror_matches_host_across_regimes():
+    """Buffer-level parity: the resident device view equals the
+    re-upload view bitwise after in-order appends, a multi-tell
+    backlog, bucket growth, capacity growth, AND an out-of-order (late
+    completion) insert that forces re-materialization."""
+    import jax
+
+    ps = compile_space(MIXED)
+    res = ObsBuffer(ps, resident=True)
+    ref = ObsBuffer(ps)
+
+    def check():
+        a = jax.device_get(res.device_arrays())
+        b = jax.device_get(ref.device_arrays())
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    def add_both(vals, loss, tid=None):
+        res.add(dict(vals), loss, tid=tid)
+        ref.add(dict(vals), loss, tid=tid)
+
+    # in-order appends, syncing the mirror every few tells (multi-delta
+    # backlogs) and crossing both the bucket and capacity boundaries
+    tid = 0
+    for i in range(MIN_CAPACITY + 10):
+        add_both({"x": float(i % 9), "lr": 0.1}, float(i % 4), tid=tid)
+        tid += 2  # leave odd tids free for the late insert below
+        if i % 3 == 0:
+            check()
+    check()
+    assert res.capacity > MIN_CAPACITY  # _grow crossed
+    assert res._device_bucket() > MIN_CAPACITY  # bucket grew
+
+    # late completion: insert at a mid-buffer tid -> tail shift on the
+    # host, full re-materialization on the device
+    add_both({"x": -1.0, "lr": 0.5}, 9.9, tid=5)
+    assert res._resident_full
+    check()
+
+
+def test_resident_buffer_pickles_without_device_state():
+    ps = compile_space(MIXED)
+    buf = ObsBuffer(ps, resident=True)
+    for i in range(8):
+        buf.add({"x": float(i)}, float(i))
+    buf.device_arrays()
+    clone = pickle.loads(pickle.dumps(buf))
+    assert clone.resident and clone._resident is None
+    # the restored buffer re-materializes and serves the same view
+    import jax
+
+    a = jax.device_get(clone.device_arrays())
+    b = jax.device_get(buf.device_arrays())
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_set_resident_flips_safely_mid_run():
+    """Flipping residency between asks must not change the stream (the
+    host arrays stay authoritative)."""
+    domain = Domain(mixed_obj, MIXED)
+    trials = Trials()
+    seeds = np.random.default_rng(0).integers(2**31 - 1, size=40)
+    stream = []
+    for i, s in enumerate(seeds):
+        if i == 25:  # flip once warm, mid-run
+            obs_buffer_for(domain, trials, resident=True)
+        (doc,) = tpe_jax.suggest(
+            trials.new_trial_ids(1), domain, trials, int(s),
+            n_EI_candidates=16,
+        )
+        stream.append({k: list(v) for k, v in doc["misc"]["vals"].items()})
+        doc["state"] = 2  # JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(i % 7)}
+        trials.insert_trial_docs([doc])
+        trials.refresh()
+
+    domain2 = Domain(mixed_obj, MIXED)
+    trials2 = Trials()
+    stream2 = []
+    for i, s in enumerate(seeds):
+        (doc,) = tpe_jax.suggest(
+            trials2.new_trial_ids(1), domain2, trials2, int(s),
+            n_EI_candidates=16,
+        )
+        stream2.append({k: list(v) for k, v in doc["misc"]["vals"].items()})
+        doc["state"] = 2
+        doc["result"] = {"status": "ok", "loss": float(i % 7)}
+        trials2.insert_trial_docs([doc])
+        trials2.refresh()
+    assert stream == stream2

@@ -1,0 +1,75 @@
+"""Retrace-count guard for the state-threaded sequential suggest path.
+
+A 1k-observation sequential run must compile each suggest program
+exactly once per device-bucket of the log schedule -- with
+MIN_CAPACITY=128 and the default compaction cap (512, then 4x steps)
+the buckets visited by 1000 observations are {128, 256, 512, 2048}:
+FOUR traces per program family, total.  State threading (resident
+deltas, fused tell+ask, donated buffers) must not reintroduce per-pow2
+double-tracing or -- the disaster case this pins against -- a retrace
+per ask.  Compile counts come from the jitted functions' own trace
+caches (``_cache_size``), and the transfer/dispatch schedule from the
+ObsBuffer's deterministic counters, so the guard is exact, not timed.
+"""
+
+import numpy as np
+
+from hyperopt_tpu import Trials, hp
+from hyperopt_tpu import tpe_jax
+from hyperopt_tpu.base import Domain, JOB_STATE_DONE
+from hyperopt_tpu.fmin import partial
+from hyperopt_tpu.jax_trials import JaxTrials, MIN_CAPACITY
+
+N_OBS = 1000
+N_STARTUP = 20
+# log schedule for 1000 obs: 128 -> 256 -> 512 -> (cap: 4x) 2048
+EXPECTED_BUCKETS = 4
+
+SPACE = {"x": hp.uniform("x", -5, 5), "r": hp.randint("r", 4)}
+
+
+def _cache_size(fn):
+    # PjitFunction's own trace-cache census; the jax test suite uses it
+    return fn._cache_size()
+
+
+def test_sequential_1k_compiles_on_log_schedule():
+    domain = Domain(lambda cfg: 0.0, SPACE)
+    trials = JaxTrials(resident=True)
+    algo = partial(
+        tpe_jax.suggest, fused=True, n_EI_candidates=8,
+        n_EI_candidates_cat=4,
+    )
+    rng = np.random.default_rng(0)
+    for i in range(N_OBS):
+        (doc,) = algo(trials.new_trial_ids(1), domain, trials, seed=i)
+        doc["state"] = JOB_STATE_DONE
+        doc["result"] = {"status": "ok", "loss": float(rng.uniform(0, 9))}
+        trials.insert_trial_docs([doc])
+        trials.refresh()
+
+    buf = next(iter(trials._buffers.values()))
+    # the final result is ingested on the next sync (counters below are
+    # about the 1000 ASK dispatches, which saw counts 0..999)
+    assert buf.count == N_OBS - 1
+
+    # one dispatch per ask, exactly (no fmin driver here, so no
+    # trailing ask-ahead pre-dispatch)
+    assert buf.dispatch_count == N_OBS
+    # full uploads only at mirror birth + the three bucket crossings
+    assert buf.full_uploads == EXPECTED_BUCKETS
+    # every other warm ask fused its tell into the ask dispatch
+    assert buf.delta_tells == (N_OBS - N_STARTUP) - EXPECTED_BUCKETS
+
+    cache = domain._tpe_jax_cache
+    plain = [v for k, v in cache.items() if k[-1] is False]
+    fused = [v for k, v in cache.items() if k[-1] is True]
+    assert len(plain) == 1 and len(fused) == 1
+    # the retrace pins: one trace per bucket per program family --
+    # a per-pow2 regression doubles these, a per-ask regression puts
+    # them near N_OBS
+    assert _cache_size(plain[0]) == EXPECTED_BUCKETS
+    assert _cache_size(fused[0]) == EXPECTED_BUCKETS
+    # startup prior draws share one trace (B=1, one shape)
+    ps = domain._packed_space
+    assert _cache_size(ps.sample_prior) == 1
