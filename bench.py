@@ -36,6 +36,26 @@ def build_history(n_obs, space, seed=0):
     return domain, trials
 
 
+def bench_lint():
+    """graftlint totals over the package against the committed baseline
+    -- stamped so the baseline trend is tracked alongside perf (a
+    growing baseline is a regression the same way a slowing ask is).
+
+    Returns (unbaselined_findings_total, baseline_size); the first must
+    be 0 on a healthy tree (the tier-1 lint test enforces it)."""
+    from hyperopt_tpu.analysis import lint_paths, load_baseline
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    baseline_path = os.path.join(repo, "lint_baseline.json")
+    baseline = (
+        load_baseline(baseline_path)
+        if os.path.exists(baseline_path) else None
+    )
+    result = lint_paths([os.path.join(repo, "hyperopt_tpu")],
+                        baseline=baseline, root=repo)
+    return len(result.findings), result.baseline_size
+
+
 def bench_rtt(n_calls=20):
     """Dispatch round-trip of a trivial device program, in ms.
 
@@ -645,6 +665,7 @@ def main():
         ),
     )
     rtt_ms = bench_rtt()
+    lint_findings_total, lint_baseline_size = bench_lint()
 
     print(
         json.dumps(
@@ -713,6 +734,11 @@ def main():
                 ),
                 "obs_scaling": obs_scaling,
                 "above_cap": above_cap_default,
+                # round-9 static-analysis trend rows: unbaselined
+                # findings must be 0 (tier-1 enforces), baseline size
+                # tracks the grandfathered-debt burn-down
+                "lint_findings_total": lint_findings_total,
+                "lint_baseline_size": lint_baseline_size,
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,

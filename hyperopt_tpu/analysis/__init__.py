@@ -1,0 +1,49 @@
+"""graftlint: AST-based invariant checker for this codebase's own rules.
+
+PRs 2-4 made the package fast and crash-safe by establishing invariants
+that nothing enforced statically: jitted program families must not
+host-sync or retrace per ask, donated buffers must never be read after
+dispatch, and every durable write must be fsync-before-rename with
+transient errors routed through ``with_retries``.  This package turns
+those reviewer-memory rules into a lint pass that runs at diff time --
+before a bench or a chaos run ever executes.
+
+Rule families (see :mod:`.rules` for the pack, DESIGN.md SS4 for the
+table mapping each rule to the PR that motivated it):
+
+* GL0xx -- engine/meta (unknown pragma ID, unparsable file)
+* GL1xx -- trace discipline inside jit/shard_map/pallas_call scopes
+* GL2xx -- dispatch hygiene (donation, device sync, per-call jit)
+* GL3xx -- crash consistency & fault routing
+
+Inline suppression::
+
+    risky_line()  # graftlint: disable=GL202 bench-only sync point
+
+on the violating line, or on the ``def``/``class`` header to cover the
+whole scope.  Grandfathered findings live in a committed baseline
+(``lint_baseline.json``, keyed by (path, rule, content-hash) so entries
+survive unrelated line shifts); the tier-1 test fails on any finding
+not in it.
+
+CLI: ``hyperopt-tpu-lint hyperopt_tpu/`` (exit 0 clean, 1 findings,
+2 usage/internal error).  No third-party dependencies -- stdlib ``ast``
+and ``tokenize`` only.
+"""
+
+from .baseline import load_baseline, write_baseline
+from .engine import Finding, LintResult, lint_paths, lint_source
+from .report import format_json, format_text
+from .rules import RULES
+
+__all__ = [
+    "Finding",
+    "LintResult",
+    "lint_paths",
+    "lint_source",
+    "RULES",
+    "load_baseline",
+    "write_baseline",
+    "format_text",
+    "format_json",
+]

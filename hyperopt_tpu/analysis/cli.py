@@ -1,0 +1,111 @@
+"""``hyperopt-tpu-lint``: the graftlint console entry point.
+
+Exit-code contract (pinned by tests/test_lint_suppress.py):
+
+* 0 -- clean (no findings after baseline + pragmas)
+* 1 -- findings
+* 2 -- usage error or internal failure (bad path, unreadable baseline,
+  engine exception); argparse's own usage errors also exit 2
+
+``lint_baseline.json`` in the current directory is picked up
+automatically so ``hyperopt-tpu-lint hyperopt_tpu/`` from the repo root
+runs against the committed baseline with no flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .engine import lint_paths
+from .report import format_json, format_text
+from .rules import RULES
+
+__all__ = ["main"]
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="hyperopt-tpu-lint",
+        description="AST-based invariant checker for trace discipline, "
+        "dispatch hygiene, and crash consistency (graftlint).",
+    )
+    p.add_argument(
+        "paths", nargs="*", default=["hyperopt_tpu"],
+        help="files or directories to lint (default: hyperopt_tpu)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (default: text)",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="FILE",
+        help="findings baseline to grandfather (default: "
+        f"./{DEFAULT_BASELINE} when it exists)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline, report every finding",
+    )
+    p.add_argument(
+        "--write-baseline", action="store_true",
+        help="write the current findings to the baseline file and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule pack and exit",
+    )
+    return p
+
+
+def main(argv=None):
+    parser = _build_parser()
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{r.id}  {r.name:28s} {r.summary}")
+        return 0
+
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        if os.path.exists(DEFAULT_BASELINE):
+            baseline_path = DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline_path = None
+
+    try:
+        counter = None
+        if baseline_path is not None and not args.write_baseline:
+            counter = baseline_mod.load_baseline(baseline_path)
+        result = lint_paths(args.paths, baseline=counter)
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"hyperopt-tpu-lint: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # internal failure is 2, never a traceback
+        print(
+            f"hyperopt-tpu-lint: internal error: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        baseline_mod.write_baseline(out, result.findings)
+        print(
+            f"wrote {len(result.findings)} finding(s) to {out}",
+            file=sys.stderr,
+        )
+        return 0
+
+    print(format_json(result) if args.format == "json" else format_text(result))
+    return 0 if result.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

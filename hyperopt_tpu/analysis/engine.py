@@ -1,0 +1,407 @@
+"""graftlint core: file context, jit-scope resolution, suppressions.
+
+The engine is deliberately stdlib-only (``ast`` + ``tokenize``): it must
+run in the fast tier on a bare CPU container, lint the whole package in
+well under five seconds, and never import jax (linting the trace rules
+must not itself build a trace).
+
+Scope model
+-----------
+A function is a *jitted scope* when it is
+
+* decorated with ``jit`` / ``pmap`` / ``shard_map`` / ``pallas_call``
+  (bare, called, or via ``partial(jax.jit, ...)``), or
+* passed by name (through one level of plain-name / conditional-name
+  aliasing, the ``fn = fn_joint if joint_ei else fn_factorized``
+  pattern) or as an inline lambda to a call of one of those wrappers,
+* or lexically nested inside a jitted scope (tracing descends into
+  closures).
+
+This is lexical, not interprocedural: a helper merely *called from* a
+jitted function is not resolved.  That keeps false positives near zero;
+the fixture corpus under ``tests/lint_fixtures/`` pins the behavior.
+
+Suppressions
+------------
+``# graftlint: disable=GL101,GL303 reason`` on the violating line, or on
+the ``def``/``class`` header line of any enclosing scope.  A pragma
+naming an unknown rule ID is itself a finding (GL001).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+
+__all__ = ["Finding", "LintResult", "FileContext", "lint_source", "lint_paths"]
+
+# wrapper terminals that open a traced scope
+JIT_WRAPPERS = frozenset({"jit", "pmap", "shard_map", "pallas_call"})
+
+_PRAGMA_RE = re.compile(
+    r"#\s*graftlint:\s*disable="
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+    r"(?:\s+(?P<reason>\S.*))?$"
+)
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+_SCOPE_NODES = _FUNC_NODES + (ast.ClassDef,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    rule: str
+    line: int
+    col: int
+    message: str
+    source_line: str = ""
+
+    def content_hash(self):
+        """Identity that survives unrelated line shifts: the rule plus
+        the stripped text of the violating line (baseline key)."""
+        payload = f"{self.rule}:{self.source_line.strip()}"
+        return hashlib.sha1(payload.encode("utf-8", "replace")).hexdigest()
+
+    def to_dict(self):
+        return {
+            "path": self.path,
+            "rule": self.rule,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "content_hash": self.content_hash(),
+        }
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list
+    n_files: int = 0
+    n_suppressed: int = 0          # pragma-suppressed
+    n_baseline_matched: int = 0    # grandfathered by the baseline
+    baseline_size: int = 0
+
+    @property
+    def clean(self):
+        return not self.findings
+
+
+def terminal_name(node):
+    """``a.b.c`` -> ``"c"``, ``name`` -> ``"name"``, else None."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def dotted_name(node):
+    """Full dotted path of a Name/Attribute chain, or None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def wrapper_call_name(call):
+    """If ``call`` invokes a trace wrapper (directly or via partial),
+    return the wrapper terminal, else None."""
+    t = terminal_name(call.func)
+    if t in JIT_WRAPPERS:
+        return t
+    if t == "partial":
+        for a in call.args:
+            at = terminal_name(a)
+            if at in JIT_WRAPPERS:
+                return at
+    return None
+
+
+def _is_jit_decorator(dec):
+    if terminal_name(dec) in JIT_WRAPPERS:
+        return True
+    return isinstance(dec, ast.Call) and wrapper_call_name(dec) is not None
+
+
+def walk_scope(node):
+    """Yield ``node``'s descendants WITHOUT descending into nested
+    function/lambda bodies -- a function's own statements only."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if not isinstance(child, _FUNC_NODES):
+            stack.extend(ast.iter_child_nodes(child))
+
+
+class FileContext:
+    """Everything a rule checker needs about one parsed file."""
+
+    def __init__(self, path, source, tree):
+        self.path = path
+        self.posix_path = path.replace(os.sep, "/")
+        self.parts = [p for p in self.posix_path.split("/") if p]
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self._jitted = self._resolve_jitted_scopes()
+        self.functions = [
+            n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)
+        ]
+
+    # -- scope helpers -----------------------------------------------------
+
+    def ancestors(self, node):
+        n = self.parents.get(node)
+        while n is not None:
+            yield n
+            n = self.parents.get(n)
+
+    def enclosing_function(self, node):
+        for a in self.ancestors(node):
+            if isinstance(a, _FUNC_NODES):
+                return a
+        return None
+
+    def scope_header_lines(self, node):
+        """Line numbers of every enclosing def/class header (pragma
+        placed there suppresses the whole scope)."""
+        out = []
+        if isinstance(node, _SCOPE_NODES):
+            out.append(node.lineno)
+        for a in self.ancestors(node):
+            if isinstance(a, _SCOPE_NODES):
+                out.append(a.lineno)
+        return out
+
+    def in_jitted_scope(self, node):
+        if isinstance(node, _FUNC_NODES) and node in self._jitted:
+            return True
+        return any(
+            isinstance(a, _FUNC_NODES) and a in self._jitted
+            for a in self.ancestors(node)
+        )
+
+    def is_jitted(self, fn_node):
+        return fn_node in self._jitted or self.in_jitted_scope(fn_node)
+
+    def source_line(self, lineno):
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def finding(self, rule, node, message):
+        f = Finding(
+            path=self.posix_path,
+            rule=rule,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            source_line=self.source_line(getattr(node, "lineno", 1)),
+        )
+        # scope chain rides along (not part of identity) so the engine
+        # can apply def-header pragmas
+        object.__setattr__(f, "_scope_lines", self.scope_header_lines(node))
+        return f
+
+    # -- jitted-scope resolution -------------------------------------------
+
+    def _resolve_jitted_scopes(self):
+        jitted = set()
+        defs_by_name = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+                if any(_is_jit_decorator(d) for d in node.decorator_list):
+                    jitted.add(node)
+
+        # one level of plain-name aliasing: fn = a / fn = a if c else b
+        alias = {}
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            names = set()
+            v = node.value
+            if isinstance(v, ast.Name):
+                names.add(v.id)
+            elif isinstance(v, ast.IfExp):
+                for leg in (v.body, v.orelse):
+                    if isinstance(leg, ast.Name):
+                        names.add(leg.id)
+            if names:
+                alias.setdefault(tgt.id, set()).update(names)
+
+        def resolve(name, depth=0):
+            hits = set(defs_by_name.get(name, ()))
+            if depth < 4:
+                for nxt in alias.get(name, ()):
+                    hits |= resolve(nxt, depth + 1)
+            return hits
+
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and wrapper_call_name(node)):
+                continue
+            target = None
+            if node.args:
+                target = node.args[0]
+            else:
+                for kw in node.keywords:
+                    if kw.arg in ("fun", "f", "fn"):
+                        target = kw.value
+                        break
+            if isinstance(target, ast.Lambda):
+                jitted.add(target)
+            elif isinstance(target, ast.Name):
+                jitted |= resolve(target.id)
+        return jitted
+
+
+def parse_pragmas(source):
+    """Map line -> set of rule IDs disabled there (via tokenize, so
+    pragmas inside strings don't count)."""
+    pragmas = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _PRAGMA_RE.search(tok.string)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                pragmas.setdefault(tok.start[0], set()).update(ids)
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass
+    return pragmas
+
+
+def lint_source(source, path="<string>"):
+    """Lint one file's source; returns (findings, n_pragma_suppressed).
+
+    Unparsable source is itself a finding (GL002) rather than an engine
+    crash -- a syntax error in a diff must fail the lint test, not
+    crash the harness with a traceback.
+    """
+    from .rules import CHECKERS, RULES
+
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        f = Finding(
+            path=path.replace(os.sep, "/"),
+            rule="GL002",
+            line=e.lineno or 1,
+            col=(e.offset or 1) - 1,
+            message=f"file does not parse: {e.msg}",
+            source_line=(e.text or "").rstrip("\n"),
+        )
+        object.__setattr__(f, "_scope_lines", [])
+        return [f], 0
+
+    ctx = FileContext(path, source, tree)
+    pragmas = parse_pragmas(source)
+
+    raw = []
+    for rule_id, checker in CHECKERS:
+        raw.extend(checker(ctx))
+
+    # GL001: a pragma naming a rule the pack does not define is dead
+    # weight that silently stops protecting when the real ID differs
+    for lineno, ids in pragmas.items():
+        for rid in sorted(ids):
+            if rid not in RULES:
+                f = ctx.finding(
+                    "GL001",
+                    ast.Pass(lineno=lineno, col_offset=0),
+                    f"suppression names unknown rule ID {rid!r}",
+                )
+                raw.append(f)
+
+    kept, n_suppressed = [], 0
+    for f in raw:
+        covering = set(pragmas.get(f.line, ()))
+        for scope_line in getattr(f, "_scope_lines", ()):
+            covering |= pragmas.get(scope_line, set())
+        if f.rule in covering:
+            n_suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept, n_suppressed
+
+
+def iter_python_files(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith(".")
+                )
+                out.extend(
+                    os.path.join(root, f) for f in sorted(files)
+                    if f.endswith(".py")
+                )
+        elif p.endswith(".py") or os.path.isfile(p):
+            out.append(p)
+        else:
+            raise FileNotFoundError(p)
+    return out
+
+
+def lint_paths(paths, baseline=None, root=None):
+    """Lint files/directories; apply ``baseline`` (a loaded baseline
+    multiset, see :mod:`.baseline`) to filter grandfathered findings.
+
+    ``root`` anchors finding paths (default: the process cwd) -- pass
+    the repo root when calling from elsewhere so paths keep matching
+    the committed baseline's repo-relative keys.
+    """
+    from .baseline import apply_baseline
+
+    files = iter_python_files(paths)
+    findings, n_suppressed = [], 0
+    for fp in files:
+        try:
+            with open(fp, encoding="utf-8", errors="replace") as fh:
+                source = fh.read()
+        except OSError as e:
+            raise FileNotFoundError(f"cannot read {fp}: {e}") from e
+        rel = (
+            os.path.relpath(fp, start=root)
+            if root is not None or os.path.isabs(fp) else fp
+        )
+        fs, ns = lint_source(source, path=rel)
+        findings.extend(fs)
+        n_suppressed += ns
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    n_matched = 0
+    baseline_size = 0
+    if baseline is not None:
+        baseline_size = sum(baseline.values())
+        findings, n_matched = apply_baseline(findings, baseline)
+    return LintResult(
+        findings=findings,
+        n_files=len(files),
+        n_suppressed=n_suppressed,
+        n_baseline_matched=n_matched,
+        baseline_size=baseline_size,
+    )

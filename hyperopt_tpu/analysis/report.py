@@ -1,0 +1,49 @@
+"""Reporters: the text form for humans at a terminal, JSON for tooling
+(the bench stamps ``lint_findings_total`` / ``lint_baseline_size`` from
+the same structure)."""
+
+from __future__ import annotations
+
+import json
+
+from .rules import RULES
+
+__all__ = ["format_text", "format_json", "result_summary"]
+
+
+def result_summary(result):
+    return {
+        "total": len(result.findings),
+        "files": result.n_files,
+        "pragma_suppressed": result.n_suppressed,
+        "baseline_matched": result.n_baseline_matched,
+        "baseline_size": result.baseline_size,
+    }
+
+
+def format_text(result):
+    lines = []
+    for f in result.findings:
+        rule = RULES.get(f.rule)
+        name = f" ({rule.name})" if rule else ""
+        lines.append(f"{f.path}:{f.line}:{f.col + 1}: {f.rule}{name} {f.message}")
+        if f.source_line.strip():
+            lines.append(f"    {f.source_line.strip()}")
+    s = result_summary(result)
+    lines.append(
+        f"graftlint: {s['total']} finding(s) in {s['files']} file(s) "
+        f"({s['baseline_matched']} baselined, "
+        f"{s['pragma_suppressed']} suppressed)"
+    )
+    return "\n".join(lines)
+
+
+def format_json(result):
+    return json.dumps(
+        {
+            "summary": result_summary(result),
+            "findings": [f.to_dict() for f in result.findings],
+        },
+        indent=2,
+        sort_keys=True,
+    )

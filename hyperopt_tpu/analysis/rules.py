@@ -1,0 +1,527 @@
+"""The graftlint rule pack: the invariants PRs 2-4 established, as AST
+checks.  DESIGN.md SS4 maps each rule to the PR that motivated it and
+the runtime guard it complements.
+
+Every checker is a function ``check(ctx) -> iterable[Finding]`` over a
+:class:`~.engine.FileContext`; registration order is reporting order.
+Rules are heuristic by design -- lexical, single-file, no type
+inference -- and every rule's true-positive and near-miss behavior is
+pinned by a fixture pair in ``tests/lint_fixtures/``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from .engine import (
+    JIT_WRAPPERS,
+    dotted_name,
+    terminal_name,
+    walk_scope,
+    wrapper_call_name,
+)
+
+__all__ = ["RULES", "CHECKERS", "Rule"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    summary: str
+
+
+RULES = {}
+CHECKERS = []
+
+
+def register(rule_id, name, summary):
+    RULES[rule_id] = Rule(rule_id, name, summary)
+
+    def deco(fn):
+        CHECKERS.append((rule_id, fn))
+        return fn
+
+    return deco
+
+
+# engine-emitted rules: registered so pragmas naming them validate and
+# --list-rules documents them, but no checker walks the tree
+RULES["GL001"] = Rule(
+    "GL001", "unknown-pragma-rule",
+    "a # graftlint: disable= pragma names a rule ID the pack does not define",
+)
+RULES["GL002"] = Rule(
+    "GL002", "parse-error", "file does not parse (syntax error)"
+)
+
+
+def _is_test_file(ctx):
+    base = ctx.parts[-1] if ctx.parts else ""
+    return base.startswith("test_") or base == "conftest.py"
+
+
+def _call_args_all_constant(call):
+    return all(isinstance(a, ast.Constant) for a in call.args)
+
+
+# ---------------------------------------------------------------------------
+# GL1xx -- trace discipline (PR 4's resident/fused dispatch contract)
+# ---------------------------------------------------------------------------
+
+_HOST_SYNC_METHODS = frozenset({"item", "tolist"})
+_HOST_MATERIALIZERS = frozenset({"asarray", "array"})
+_NUMPY_MODULES = frozenset({"np", "numpy", "onp"})
+_SCALAR_BUILTINS = frozenset({"float", "int", "bool"})
+
+
+@register(
+    "GL101", "tracer-host-sync",
+    ".item()/tolist()/float()/int()/bool()/np.asarray on a value inside a "
+    "jitted scope -- forces a device sync or a concretization error",
+)
+def check_tracer_host_sync(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not ctx.in_jitted_scope(node):
+            continue
+        func = node.func
+        # x.item() / x.tolist()
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _HOST_SYNC_METHODS
+            and not node.args
+        ):
+            yield ctx.finding(
+                "GL101", node,
+                f".{func.attr}() inside a jitted scope host-syncs the "
+                "traced value; return it and fetch outside the program",
+            )
+            continue
+        # np.asarray / np.array on a traced value
+        if isinstance(func, ast.Attribute):
+            dn = dotted_name(func)
+            if (
+                func.attr in _HOST_MATERIALIZERS
+                and dn is not None
+                and dn.split(".")[0] in _NUMPY_MODULES
+            ):
+                yield ctx.finding(
+                    "GL101", node,
+                    f"{dn}() inside a jitted scope materializes the tracer "
+                    "on host; use jnp inside the program",
+                )
+            continue
+        # float(x)/int(x)/bool(x) on non-literal arguments
+        if (
+            isinstance(func, ast.Name)
+            and func.id in _SCALAR_BUILTINS
+            and node.args
+            and not _call_args_all_constant(node)
+        ):
+            yield ctx.finding(
+                "GL101", node,
+                f"{func.id}() on a traced value inside a jitted scope "
+                "raises ConcretizationError (or silently host-syncs)",
+            )
+
+
+@register(
+    "GL102", "debug-print-in-jit",
+    "jax.debug.print/breakpoint inside a jitted scope -- hot program "
+    "families must stay debug-callback-free",
+)
+def check_debug_print(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn in ("jax.debug.print", "jax.debug.breakpoint") and (
+            ctx.in_jitted_scope(node)
+        ):
+            yield ctx.finding(
+                "GL102", node,
+                f"{dn} inside a jitted scope inserts a host callback into "
+                "the hot program; strip before shipping",
+            )
+
+
+@register(
+    "GL103", "loop-var-closure-capture",
+    "a jitted function defined inside a loop closes over the loop "
+    "variable -- every iteration traces a fresh program",
+)
+def check_loop_closure_capture(ctx):
+    for fn in ctx.functions:
+        if not ctx.is_jitted(fn):
+            continue
+        # names (re)bound by For/While loops that lexically enclose fn
+        loop_names = set()
+        for anc in ctx.ancestors(fn):
+            if isinstance(anc, (ast.For, ast.While)):
+                for t in ast.walk(getattr(anc, "target", anc)):
+                    if isinstance(t, ast.Name):
+                        loop_names.add(t.id)
+                for st in walk_scope(anc):
+                    if isinstance(st, ast.Name) and isinstance(
+                        st.ctx, ast.Store
+                    ):
+                        loop_names.add(st.id)
+        if not loop_names:
+            continue
+        local = set()
+        args = fn.args
+        for a in (
+            args.args + args.posonlyargs + args.kwonlyargs
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else [])
+        ):
+            local.add(a.arg)
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for st in body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    local.add(n.id)
+        for st in body:
+            for n in ast.walk(st):
+                if (
+                    isinstance(n, ast.Name)
+                    and isinstance(n.ctx, ast.Load)
+                    and n.id in loop_names
+                    and n.id not in local
+                ):
+                    yield ctx.finding(
+                        "GL103", n,
+                        f"jitted closure captures loop-carried {n.id!r}: "
+                        "each iteration bakes a new constant and retraces; "
+                        "pass it as an argument",
+                    )
+
+
+@register(
+    "GL104", "jit-constructed-in-loop",
+    "jax.jit/pmap called inside a loop -- builds a fresh program family "
+    "per iteration; route through ops/compile.py's cache",
+)
+def check_jit_in_loop(ctx):
+    if "compile.py" == (ctx.parts[-1] if ctx.parts else ""):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and wrapper_call_name(node)):
+            continue
+        # the loop must enclose the call within the same function: a
+        # def inside a loop re-jitting at ITS top level is regime GL103
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(anc, (ast.For, ast.While)):
+                yield ctx.finding(
+                    "GL104", node,
+                    "trace wrapper constructed inside a loop: every "
+                    "iteration starts a fresh program family (compile "
+                    "storm); hoist it or use ops/compile.py's cache",
+                )
+                break
+
+
+# ---------------------------------------------------------------------------
+# GL2xx -- dispatch hygiene (PR 4's donation + one-dispatch contract)
+# ---------------------------------------------------------------------------
+
+
+def _donated_indices(call):
+    """donate_argnums of a jit call, as a tuple of ints, else None."""
+    for kw in call.keywords:
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            return (v.value,)
+        if isinstance(v, (ast.Tuple, ast.List)):
+            idxs = []
+            for e in v.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                    idxs.append(e.value)
+            return tuple(idxs)
+    return None
+
+
+@register(
+    "GL201", "read-after-donate",
+    "a buffer passed at a donated position is read after the dispatch -- "
+    "donated buffers are dead the moment the call is issued",
+)
+def check_read_after_donate(ctx):
+    # names bound to jit(..., donate_argnums=...) anywhere in the file
+    donated = {}
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Name) and isinstance(node.value, ast.Call)):
+            continue
+        if wrapper_call_name(node.value) is None:
+            continue
+        idxs = _donated_indices(node.value)
+        if idxs:
+            donated[tgt.id] = idxs
+    if not donated:
+        return
+
+    def _store_pos(n):
+        # a Store takes effect at the END of its statement (the value
+        # side of `state = step(state)` runs first), so position the
+        # rebind after the donating call it feeds from
+        cur = n
+        while cur is not None and not isinstance(cur, ast.stmt):
+            cur = ctx.parents.get(cur)
+        if cur is not None and getattr(cur, "end_lineno", None) is not None:
+            return (cur.end_lineno, cur.end_col_offset, 1)
+        return (n.lineno, n.col_offset, 1)
+
+    scopes = list(ctx.functions) + [ctx.tree]
+    for scope in scopes:
+        # own statements only: a nested def is its own dataflow scope
+        nodes = [n for n in walk_scope(scope) if hasattr(n, "lineno")]
+        events = []
+        for n in nodes:
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                events.append((_store_pos(n), n))
+            else:
+                events.append(((n.lineno, n.col_offset, 0), n))
+        # dead[name] = position the buffer dies: the END of the donating
+        # call, so argument reads inside the call span stay legal
+        dead = {}
+        for pos, n in sorted(events, key=lambda e: e[0]):
+            if isinstance(n, ast.Call):
+                fname = n.func.id if isinstance(n.func, ast.Name) else None
+                if fname in donated:
+                    end = (
+                        (n.end_lineno, n.end_col_offset, 0)
+                        if getattr(n, "end_lineno", None) is not None
+                        else pos
+                    )
+                    for i in donated[fname]:
+                        if i < len(n.args) and isinstance(n.args[i], ast.Name):
+                            dead[n.args[i].id] = end
+            elif isinstance(n, ast.Name) and n.id in dead:
+                if isinstance(n.ctx, ast.Store):
+                    # rebinding revives the name (fresh buffer)
+                    if pos > dead[n.id]:
+                        del dead[n.id]
+                elif isinstance(n.ctx, ast.Load) and pos > dead[n.id]:
+                    yield ctx.finding(
+                        "GL201", n,
+                        f"{n.id!r} was donated to a jitted call above; its "
+                        "buffer is dead -- use the program's outputs",
+                    )
+                    del dead[n.id]  # one finding per donation site
+
+
+@register(
+    "GL202", "sync-outside-bench",
+    "block_until_ready outside bench/profiling modules -- product paths "
+    "must stay dispatch-async (the RTT floor is the contract)",
+)
+def check_block_until_ready(ctx):
+    name = ctx.parts[-1] if ctx.parts else ""
+    if "bench" in name or "profiling" in name or _is_test_file(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if terminal_name(node.func) == "block_until_ready":
+            yield ctx.finding(
+                "GL202", node,
+                "block_until_ready in a product path serializes dispatch "
+                "on device completion; only bench/profiling may sync",
+            )
+
+
+@register(
+    "GL203", "per-call-jit",
+    "jax.jit(f)(args) -- wrapping per call defeats the program cache "
+    "(a fresh callable each time)",
+)
+def check_per_call_jit(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        inner = node.func
+        if isinstance(inner, ast.Call) and wrapper_call_name(inner) in (
+            "jit", "pmap"
+        ):
+            yield ctx.finding(
+                "GL203", node,
+                "jit-wrap-then-call in one expression builds a fresh "
+                "callable per invocation; bind the jitted function once",
+            )
+
+
+# ---------------------------------------------------------------------------
+# GL3xx -- crash consistency & fault routing (PR 3's durability contract)
+# ---------------------------------------------------------------------------
+
+_WRITE_MODES = "wax+"
+
+
+def _is_write_open(call):
+    if terminal_name(call.func) != "open":
+        return False
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return isinstance(mode, str) and any(c in mode for c in _WRITE_MODES)
+
+
+@register(
+    "GL301", "rename-without-fsync",
+    "os.rename/os.replace publishes a file written in the same function "
+    "with no fsync -- a crash can publish an empty or truncated file",
+)
+def check_rename_without_fsync(ctx):
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        own = list(walk_scope(fn))
+        wrote = any(isinstance(n, ast.Call) and _is_write_open(n) for n in own)
+        if not wrote:
+            continue
+        synced = any(
+            isinstance(n, ast.Call) and terminal_name(n.func) == "fsync"
+            for n in own
+        )
+        if synced:
+            continue
+        for n in own:
+            if isinstance(n, ast.Call) and terminal_name(n.func) in (
+                "rename", "replace"
+            ):
+                yield ctx.finding(
+                    "GL301", n,
+                    "rename publishes a file this function wrote without "
+                    "fsync: the rename's metadata can reach disk before "
+                    "the data does (fsync-before-rename, PR 3)",
+                )
+
+
+# broad = a net wide enough to catch OSError/TransientBackendError by
+# accident; a typed `except OSError` is a deliberate protocol catch
+_BROAD_EXCEPTS = frozenset({"Exception", "BaseException"})
+
+
+def _handler_is_broad(handler):
+    if handler.type is None:
+        return True
+    types = (
+        handler.type.elts
+        if isinstance(handler.type, ast.Tuple)
+        else [handler.type]
+    )
+    return any(terminal_name(t) in _BROAD_EXCEPTS for t in types)
+
+
+@register(
+    "GL302", "swallowed-broad-except",
+    "broad except in the fault domain (distributed/, checkpoint) that "
+    "neither re-raises nor consults is_transient -- can eat "
+    "TransientBackendError/OSError meant for with_retries",
+)
+def check_swallowed_broad_except(ctx):
+    in_domain = "distributed" in ctx.parts or (
+        ctx.parts and ctx.parts[-1] == "checkpoint.py"
+    )
+    if not in_domain or _is_test_file(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _handler_is_broad(node):
+            continue
+        consults = False
+        for n in [x for st in node.body for x in ast.walk(st)]:
+            if isinstance(n, ast.Raise):
+                consults = True
+                break
+            if isinstance(n, ast.Call) and terminal_name(n.func) in (
+                "is_transient", "classify",
+            ):
+                consults = True
+                break
+        if not consults:
+            yield ctx.finding(
+                "GL302", node,
+                "broad except swallows the error class with_retries "
+                "routes on; catch typed, re-raise, or consult "
+                "is_transient (suppress with a reason if deliberate)",
+            )
+
+
+@register(
+    "GL303", "sleep-in-retry-loop",
+    "time.sleep inside an except handler inside a loop -- a hand-rolled "
+    "retry loop; route through _common.with_retries",
+)
+def check_sleep_in_retry_loop(ctx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func) != "time.sleep":
+            continue
+        in_handler = in_loop = False
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.ExceptHandler):
+                in_handler = True
+            if isinstance(anc, (ast.For, ast.While)):
+                in_loop = True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+        if in_handler and in_loop:
+            yield ctx.finding(
+                "GL303", node,
+                "sleep-on-error inside a loop is a hand-rolled retry: "
+                "use _common.with_retries (bounded, classified backoff)",
+            )
+
+
+_NP_GLOBAL_STATE = frozenset({
+    "seed", "rand", "randn", "randint", "random", "uniform", "normal",
+    "choice", "shuffle", "permutation", "standard_normal", "beta",
+    "binomial", "get_state", "set_state", "sample", "random_sample",
+    "exponential", "poisson", "lognormal", "multivariate_normal",
+})
+
+
+@register(
+    "GL304", "np-random-global-state",
+    "np.random global-state use outside tests -- seeded streams are the "
+    "reproducibility contract (rstate/default_rng only)",
+)
+def check_np_random_global(ctx):
+    if _is_test_file(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dn = dotted_name(node.func)
+        if dn is None:
+            continue
+        parts = dn.split(".")
+        if (
+            len(parts) == 3
+            and parts[0] in _NUMPY_MODULES
+            and parts[1] == "random"
+            and parts[2] in _NP_GLOBAL_STATE
+        ):
+            yield ctx.finding(
+                "GL304", node,
+                f"{dn} mutates/reads numpy's process-global RNG: every "
+                "draw must come from an explicit Generator (default_rng)",
+            )

@@ -149,7 +149,10 @@ def claim_heartbeat(beat, interval):
             try:
                 if beat() is False:
                     return
-            except Exception as e:
+            # a frozen beat gets a LIVE job reaped and duplicated, so the
+            # heartbeat must outlive ANY transport error; non-transient
+            # failures surface on the next (classified) queue operation
+            except Exception as e:  # graftlint: disable=GL302 beat must outlive any error
                 logger.warning("claim heartbeat failed transiently: %s", e)
 
     th = threading.Thread(target=loop, daemon=True)

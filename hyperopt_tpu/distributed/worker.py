@@ -182,7 +182,7 @@ def run_one(queue, owner, exp_key=None, workdir=None, trials=None,
                     result = domain.evaluate(spec, ctrl)
             else:
                 result = domain.evaluate(spec, ctrl)
-        except Exception as e:
+        except Exception as e:  # graftlint: disable=GL302 objective errors become ERROR docs
             logger.error("job %s failed: %s", doc["tid"], e)
             doc["state"] = JOB_STATE_ERROR
             doc["misc"]["error"] = (str(type(e)), str(e))

@@ -46,6 +46,10 @@ def save_obs_buffer(buf, path):
             pending=np.asarray(buf._pending, dtype=np.int64),
             labels=np.asarray(buf.space.labels, dtype=object),
         )
+        # fsync before the rename (GL301): without it a crash after the
+        # replace can publish a truncated checkpoint under the real name
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 
@@ -134,6 +138,8 @@ def save_obs_buffer_orbax(buf, directory):
         # if the same directory is reused for a different space, which
         # load rejects either way
         json.dump({"labels": list(buf.space.labels)}, f)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, os.path.join(directory, "labels.json"))
     return directory
 
@@ -202,6 +208,8 @@ def save_pytree(tree, path):
     tmp = f"{path}.tmp.{os.getpid()}.npz"
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 
@@ -245,6 +253,8 @@ def save_trials(trials, path):
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "wb") as f:
         pickle.dump(trials, f, protocol=pickle.HIGHEST_PROTOCOL)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
     return path
 

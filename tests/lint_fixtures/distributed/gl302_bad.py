@@ -1,0 +1,13 @@
+"""GL302 true positive (fault-domain path): a broad except that eats
+the error classes with_retries routes on, without re-raise or triage."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def refresh(op):
+    try:
+        return op()
+    except Exception as e:          # GL302: swallows OSError/transients
+        logger.warning("refresh failed: %s", e)
+        return None

@@ -1,0 +1,29 @@
+"""GL302 near-misses: typed catches, a broad catch that consults
+is_transient, and a broad catch that re-raises."""
+import logging
+
+logger = logging.getLogger(__name__)
+
+
+def is_transient(exc):
+    return False
+
+
+def refresh(op):
+    try:
+        return op()
+    except FileNotFoundError:       # typed: a protocol signal
+        return None
+    except Exception as e:
+        if not is_transient(e):     # triaged: fatal errors surface
+            raise
+        logger.warning("transient refresh failure: %s", e)
+        return None
+
+
+def audit(op):
+    try:
+        return op()
+    except Exception:
+        logger.exception("audit failed")
+        raise                       # re-raised: nothing swallowed

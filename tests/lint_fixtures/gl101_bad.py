@@ -1,0 +1,18 @@
+"""GL101 true positive: host sync on a traced value inside a jitted scope."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x):
+    scale = x.item()            # GL101: .item() host-syncs the tracer
+    return x * scale
+
+
+def suggest(key, values):
+    def body(v):
+        host = np.asarray(v)    # GL101: materializes the tracer on host
+        return jnp.sum(v) * float(host.mean())  # GL101: float() on traced
+    program = jax.jit(body)
+    return program(values)

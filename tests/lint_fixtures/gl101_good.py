@@ -1,0 +1,21 @@
+"""GL101 near-miss: the same host syncs OUTSIDE any jitted scope, plus
+literal-only scalar casts inside one (static config, not tracers)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(x, n=4):
+    return x * float(1e-3) + int(2)  # literals: no tracer involved
+
+
+def fetch(program, key, values):
+    out = program(key, values)
+    host = np.asarray(out)     # outside the jitted scope: a real fetch
+    return float(host.mean()), out.item() if out.ndim == 0 else None
+
+
+def build(gamma):
+    gamma_f = float(gamma)     # builder scope, never traced
+    return jax.jit(lambda x: x * gamma_f)

@@ -1,0 +1,8 @@
+"""GL102 true positive: debug callback left inside a jitted program."""
+import jax
+
+
+@jax.jit
+def hot(x):
+    jax.debug.print("x = {}", x)    # GL102: host callback in the hot path
+    return x * 2.0

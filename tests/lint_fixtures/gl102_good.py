@@ -1,0 +1,13 @@
+"""GL102 near-miss: plain prints in host code, jax.debug outside jit."""
+import jax
+
+
+def diagnose(x):
+    jax.debug.print("host-side inspection {}", x)   # not a jitted scope
+    print("plain host print")
+    return x
+
+
+@jax.jit
+def hot(x):
+    return x * 2.0

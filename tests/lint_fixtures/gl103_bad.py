@@ -1,0 +1,13 @@
+"""GL103 true positive: a jitted closure defined in a loop captures the
+loop variable -- every iteration bakes a new constant and retraces."""
+import jax
+
+
+def make_steps(learning_rates):
+    steps = []
+    for lr in learning_rates:
+        @jax.jit
+        def step(p, g):
+            return p - lr * g       # GL103: captures loop-carried `lr`
+        steps.append(step)
+    return steps

@@ -1,0 +1,18 @@
+"""GL103 near-miss: the loop value rides in as an ARGUMENT (one trace
+serves every iteration), and a non-jitted closure may capture freely."""
+import jax
+import functools
+
+
+@jax.jit
+def step(p, g, lr):
+    return p - lr * g
+
+
+def make_steps(learning_rates):
+    steps = []
+    for lr in learning_rates:
+        steps.append(functools.partial(step, lr=lr))  # partial, not a trace
+        def host_log(msg):
+            return f"{msg} @ {lr}"  # plain closure: no program involved
+    return steps

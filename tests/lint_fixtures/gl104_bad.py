@@ -1,0 +1,15 @@
+"""GL104 true positive: trace wrappers constructed inside a loop -- a
+fresh program family (and compile) per iteration."""
+import jax
+
+
+def square(x):
+    return x * x
+
+
+def run(batches):
+    outs = []
+    for b in batches:
+        f = jax.jit(square)         # GL104: new program family each pass
+        outs.append(f(b))
+    return outs

@@ -1,0 +1,20 @@
+"""GL104 near-miss: the wrapper is hoisted out of the loop (or built
+once behind a memo guard), so the family compiles once."""
+import jax
+
+
+def square(x):
+    return x * x
+
+
+_MEMO = []
+
+
+def run(batches):
+    f = jax.jit(square)             # hoisted: one family for all batches
+    if not _MEMO:
+        _MEMO.append(jax.jit(square))   # memo guard, not a loop
+    outs = []
+    for b in batches:
+        outs.append(f(b))
+    return outs

@@ -1,0 +1,16 @@
+"""GL201 true positive: a donated buffer read after the dispatch that
+consumed it -- XLA may already have aliased its memory."""
+import jax
+
+
+def apply_delta(values, vcol, idx):
+    return values.at[:, idx].set(vcol)
+
+
+step = jax.jit(apply_delta, donate_argnums=(0,))
+
+
+def tell(values, vcol, idx):
+    new_values = step(values, vcol, idx)
+    checksum = values.sum()     # GL201: `values` was donated above
+    return new_values, checksum

@@ -1,0 +1,16 @@
+"""GL201 near-miss: the donated name is REBOUND to the program's output
+(the resident-mirror swap), so every later read sees the live buffer."""
+import jax
+
+
+def apply_delta(values, vcol, idx):
+    return values.at[:, idx].set(vcol)
+
+
+step = jax.jit(apply_delta, donate_argnums=(0,))
+
+
+def tell(values, vcol, idx):
+    values = step(values, vcol, idx)    # rebind: the swap, not a read
+    checksum = values.sum()             # reads the program's output
+    return values, checksum

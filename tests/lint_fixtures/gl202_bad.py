@@ -1,0 +1,8 @@
+"""GL202 true positive: a product path serializing on device completion."""
+import jax
+
+
+def suggest(program, key, values):
+    out = program(key, values)
+    jax.block_until_ready(out)      # GL202: sync in a product path
+    return out.block_until_ready()  # GL202: method form

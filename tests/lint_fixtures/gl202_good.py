@@ -1,0 +1,8 @@
+"""GL202 near-miss: the product path stays dispatch-async; completion
+is forced by the consumer's device_get, not an explicit barrier."""
+import jax
+
+
+def suggest(program, key, values):
+    out = program(key, values)
+    return jax.device_get(out)      # fetch forces completion implicitly

@@ -1,0 +1,11 @@
+"""GL203 true positive: jit-wrap-then-call in one expression -- a fresh
+callable (and cache entry lookup by a new id) per invocation."""
+import jax
+
+
+def square(x):
+    return x * x
+
+
+def run(x):
+    return jax.jit(square)(x)       # GL203: per-call wrapping

@@ -1,0 +1,13 @@
+"""GL203 near-miss: the jitted callable is bound once and reused."""
+import jax
+
+
+def square(x):
+    return x * x
+
+
+square_fast = jax.jit(square)
+
+
+def run(x):
+    return square_fast(x)

@@ -1,0 +1,12 @@
+"""GL301 true positive: write-tmp-then-rename with no fsync -- a crash
+shortly after the rename can publish an empty or truncated file."""
+import json
+import os
+
+
+def save(doc, path):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, path)           # GL301: rename without fsync
+    return path

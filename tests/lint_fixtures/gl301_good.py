@@ -1,0 +1,21 @@
+"""GL301 near-miss: fsync-before-rename (the PR 3 idiom), and a
+read-then-rename function that never wrote the data it moves."""
+import json
+import os
+
+
+def save(doc, path):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def promote(src, dst):
+    with open(src) as f:            # read-only: nothing to sync
+        json.load(f)
+    os.rename(src, dst)
+    return dst

@@ -1,0 +1,12 @@
+"""GL303 true positive: a hand-rolled retry loop -- sleep-on-error
+inside a loop instead of _common.with_retries."""
+import time
+
+
+def fetch(op, attempts=5):
+    for _ in range(attempts):
+        try:
+            return op()
+        except OSError:
+            time.sleep(0.05)        # GL303: hand-rolled backoff
+    raise TimeoutError("gave up")

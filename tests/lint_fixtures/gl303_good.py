@@ -1,0 +1,17 @@
+"""GL303 near-misses: the retry routed through with_retries, and an
+idle-poll sleep in a loop with no error handling around it."""
+import time
+
+
+def with_retries(fn, attempts=5):
+    return fn()
+
+
+def fetch(op):
+    return with_retries(op, attempts=5)     # the sanctioned scaffold
+
+
+def poll(ready, interval=0.05):
+    while not ready():
+        time.sleep(interval)        # idle poll, not an error path
+    return True

@@ -1,0 +1,8 @@
+"""GL304 true positive: numpy's process-global RNG in product code --
+unseeded draws break the reproducibility contract."""
+import numpy as np
+
+
+def jitter(values):
+    np.random.seed(0)                       # GL304: global-state seed
+    return values + np.random.uniform(0, 1e-6, size=len(values))  # GL304

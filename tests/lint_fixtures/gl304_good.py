@@ -1,0 +1,7 @@
+"""GL304 near-miss: explicit Generator streams (the rstate contract)."""
+import numpy as np
+
+
+def jitter(values, rstate=None):
+    rng = rstate or np.random.default_rng(0)    # explicit stream: fine
+    return values + rng.uniform(0, 1e-6, size=len(values))

@@ -70,3 +70,7 @@ def test_bench_prints_one_json_line():
     # flat in n_obs: the acceptance contract (within 2x across sizes)
     res = [r["resident_bytes_per_ask"] for r in rows]
     assert max(res) <= 2 * min(res)
+    # round-9: graftlint trend rows -- a healthy tree has zero
+    # unbaselined findings, and the grandfathered baseline stays small
+    assert d["lint_findings_total"] == 0
+    assert 0 <= d["lint_baseline_size"] <= 6

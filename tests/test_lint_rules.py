@@ -1,0 +1,74 @@
+"""Fixture corpus: every rule's true-positive and near-miss behavior is
+pinned by a bad/good file pair under tests/lint_fixtures/.
+
+The *_bad.py file must produce at least one finding, all of the target
+rule (a fixture that trips a neighboring rule is a fixture bug); the
+*_good.py file -- the nearest legal idiom -- must produce none at all.
+"""
+
+import os
+
+import pytest
+
+from hyperopt_tpu.analysis.engine import lint_source
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+PACK_RULES = [
+    "GL101", "GL102", "GL103", "GL104",
+    "GL201", "GL202", "GL203",
+    "GL301", "GL302", "GL303", "GL304",
+]
+
+
+def _fixture_path(rule_id, kind):
+    stem = f"{rule_id.lower()}_{kind}.py"
+    # GL302 is path-scoped to the fault domain, so its pair lives under
+    # a distributed/ subdirectory (the path IS part of the fixture)
+    sub = os.path.join(FIXTURES, "distributed", stem)
+    return sub if os.path.exists(sub) else os.path.join(FIXTURES, stem)
+
+
+def _lint(path):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    findings, _ = lint_source(source, path=os.path.relpath(path))
+    return findings
+
+
+@pytest.mark.parametrize("rule_id", PACK_RULES)
+def test_bad_fixture_trips_exactly_its_rule(rule_id):
+    findings = _lint(_fixture_path(rule_id, "bad"))
+    assert findings, f"{rule_id}: bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule_id}, (
+        f"{rule_id}: bad fixture tripped "
+        f"{sorted({f.rule for f in findings})}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", PACK_RULES)
+def test_good_fixture_is_clean(rule_id):
+    findings = _lint(_fixture_path(rule_id, "good"))
+    assert not findings, (
+        f"{rule_id}: near-miss fixture produced "
+        f"{[(f.rule, f.line, f.message) for f in findings]}"
+    )
+
+
+def test_known_finding_counts():
+    # multi-site fixtures pin the exact count, not just "some finding":
+    # a rule that silently stops seeing one of the sites regresses here
+    assert len(_lint(_fixture_path("GL101", "bad"))) == 3
+    assert len(_lint(_fixture_path("GL202", "bad"))) == 2
+    assert len(_lint(_fixture_path("GL304", "bad"))) == 2
+
+
+def test_findings_carry_location_and_hash():
+    findings = _lint(_fixture_path("GL301", "bad"))
+    (f,) = findings
+    assert f.line > 0 and f.col >= 0
+    assert "os.replace" in f.source_line
+    assert len(f.content_hash()) == 40
+    d = f.to_dict()
+    assert d["rule"] == "GL301" and d["content_hash"] == f.content_hash()

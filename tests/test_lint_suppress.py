@@ -1,0 +1,210 @@
+"""Suppression semantics, the engine's meta rules (GL001/GL002), the
+baseline's content-hash keying, and the CLI's exit-code contract."""
+
+import json
+import textwrap
+
+import pytest
+
+from hyperopt_tpu.analysis.baseline import (
+    apply_baseline,
+    load_baseline,
+    write_baseline,
+)
+from hyperopt_tpu.analysis.cli import main
+from hyperopt_tpu.analysis.engine import lint_source
+
+BAD_SLEEP = textwrap.dedent(
+    """\
+    import time
+
+
+    def fetch(op):
+        for _ in range(3):
+            try:
+                return op()
+            except OSError:
+                time.sleep(0.05)
+    """
+)
+
+
+def _findings(source, path="pkg/mod.py"):
+    fs, _ = lint_source(source, path=path)
+    return fs
+
+
+# -- pragma placement --------------------------------------------------------
+
+def test_pragma_on_violating_line_suppresses():
+    src = BAD_SLEEP.replace(
+        "time.sleep(0.05)",
+        "time.sleep(0.05)  # graftlint: disable=GL303 supervisor backoff",
+    )
+    assert _findings(src) == []
+    _, n = lint_source(src, path="pkg/mod.py")
+    assert n == 1  # counted as suppressed, not silently dropped
+
+
+def test_pragma_on_enclosing_def_suppresses_scope():
+    src = BAD_SLEEP.replace(
+        "def fetch(op):",
+        "def fetch(op):  # graftlint: disable=GL303 hand-rolled by design",
+    )
+    assert _findings(src) == []
+
+
+def test_pragma_on_unrelated_line_does_not_suppress():
+    # one line ABOVE the violation is neither the line nor a scope header
+    src = BAD_SLEEP.replace(
+        "except OSError:",
+        "except OSError:  # graftlint: disable=GL303 wrong line",
+    )
+    fs = _findings(src)
+    assert [f.rule for f in fs] == ["GL303"]
+
+
+def test_pragma_for_different_rule_does_not_suppress():
+    src = BAD_SLEEP.replace(
+        "time.sleep(0.05)",
+        "time.sleep(0.05)  # graftlint: disable=GL304 wrong rule",
+    )
+    assert [f.rule for f in _findings(src)] == ["GL303"]
+
+
+def test_multi_rule_pragma():
+    src = BAD_SLEEP.replace(
+        "time.sleep(0.05)",
+        "time.sleep(0.05)  # graftlint: disable=GL304,GL303 both named",
+    )
+    assert _findings(src) == []
+
+
+# -- GL001 / GL002 -----------------------------------------------------------
+
+def test_unknown_rule_id_in_pragma_is_itself_a_finding():
+    src = "x = 1  # graftlint: disable=GL999 no such rule\n"
+    fs = _findings(src)
+    assert [f.rule for f in fs] == ["GL001"]
+    assert "GL999" in fs[0].message
+
+
+def test_valid_pragma_with_reason_is_not_gl001():
+    src = BAD_SLEEP.replace(
+        "time.sleep(0.05)",
+        "time.sleep(0.05)  # graftlint: disable=GL303 reason text here",
+    )
+    assert _findings(src) == []
+
+
+def test_syntax_error_is_gl002():
+    fs = _findings("def broken(:\n")
+    assert [f.rule for f in fs] == ["GL002"]
+
+
+# -- baseline: content-hash keying ------------------------------------------
+
+def test_baseline_survives_unrelated_line_shift(tmp_path):
+    fs = _findings(BAD_SLEEP)
+    assert [f.rule for f in fs] == ["GL303"]
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+
+    shifted = "import os\n\nUNRELATED = os.sep  # new code above\n" + BAD_SLEEP
+    shifted_fs = _findings(shifted)
+    assert shifted_fs[0].line != fs[0].line  # the shift really happened
+    kept, matched = apply_baseline(shifted_fs, load_baseline(str(bl_path)))
+    assert kept == [] and matched == 1
+
+
+def test_baseline_entry_dies_when_violating_line_changes(tmp_path):
+    fs = _findings(BAD_SLEEP)
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+
+    edited = BAD_SLEEP.replace("time.sleep(0.05)", "time.sleep(0.25)")
+    kept, matched = apply_baseline(
+        _findings(edited), load_baseline(str(bl_path))
+    )
+    assert matched == 0 and [f.rule for f in kept] == ["GL303"]
+
+
+def test_baseline_is_keyed_by_path_too(tmp_path):
+    fs = _findings(BAD_SLEEP, path="pkg/a.py")
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs)
+    kept, matched = apply_baseline(
+        _findings(BAD_SLEEP, path="pkg/b.py"), load_baseline(str(bl_path))
+    )
+    assert matched == 0 and len(kept) == 1
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    # two identical violating lines need two entries; one entry only
+    # absorbs one of them
+    double = BAD_SLEEP.replace(
+        "time.sleep(0.05)", "time.sleep(0.05)\n            time.sleep(0.05)"
+    )
+    fs = _findings(double)
+    assert len(fs) == 2
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), fs[:1])
+    kept, matched = apply_baseline(fs, load_baseline(str(bl_path)))
+    assert matched == 1 and len(kept) == 1
+
+
+# -- CLI contract ------------------------------------------------------------
+
+@pytest.fixture
+def bad_tree(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(BAD_SLEEP)
+    (pkg / "clean.py").write_text("x = 1\n")
+    return pkg
+
+
+def test_cli_exit_1_on_findings(bad_tree, capsys):
+    assert main([str(bad_tree)]) == 1
+    out = capsys.readouterr().out
+    assert "GL303" in out and "1 finding(s)" in out
+
+
+def test_cli_exit_0_on_clean(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert main([str(tmp_path)]) == 0
+
+
+def test_cli_exit_2_on_bad_path(tmp_path, capsys):
+    assert main([str(tmp_path / "does_not_exist")]) == 2
+    assert "error" in capsys.readouterr().err
+
+
+def test_cli_exit_2_on_unreadable_baseline(bad_tree, tmp_path, capsys):
+    bl = tmp_path / "corrupt.json"
+    bl.write_text("{not json")
+    assert main([str(bad_tree), "--baseline", str(bl)]) == 2
+
+
+def test_cli_json_format(bad_tree, capsys):
+    assert main([str(bad_tree), "--format", "json"]) == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["total"] == 1
+    (finding,) = payload["findings"]
+    assert finding["rule"] == "GL303" and finding["content_hash"]
+
+
+def test_cli_write_baseline_roundtrip(bad_tree, tmp_path, capsys):
+    bl = tmp_path / "bl.json"
+    assert main(
+        [str(bad_tree), "--baseline", str(bl), "--write-baseline"]
+    ) == 0
+    assert main([str(bad_tree), "--baseline", str(bl)]) == 0  # grandfathered
+    assert main([str(bad_tree), "--baseline", str(bl), "--no-baseline"]) == 1
+
+
+def test_cli_list_rules(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("GL001", "GL101", "GL201", "GL301", "GL304"):
+        assert rid in out
