@@ -369,6 +369,43 @@ def bench_fused_dispatches(n_trials=120, seed=11):
     return (buf.dispatch_count - 1) / n_trials
 
 
+def bench_resume_overhead(n_trials=60, seed=11):
+    """Per-trial cost of crash recoverability (ISSUE 6 acceptance row):
+    a real fused ``fmin`` run with ``DriverRecovery`` active, reading
+    back the coordinator's own wall-clock accumulator (WAL appends +
+    bundle publishes) -- a direct measurement, immune to the compile-
+    time noise a with/without A-B comparison would drown in.
+
+    Returns (seconds_per_trial, wal_tells) -- the second is the
+    zero-lost/zero-duplicate counter, asserted == n_trials.
+    """
+    import tempfile
+    from functools import partial
+
+    import numpy as np
+
+    from hyperopt_tpu import fmin, tpe_jax
+    from hyperopt_tpu.jax_trials import JaxTrials
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+    from hyperopt_tpu.utils.checkpoint import DriverRecovery
+
+    with tempfile.TemporaryDirectory() as d:
+        rec = DriverRecovery(os.path.join(d, "bench.ckpt"), cadence=25)
+        trials = JaxTrials(resident=True)
+        fmin(
+            mixed_space_fn,
+            mixed_space(),
+            algo=partial(tpe_jax.suggest, fused=True),
+            max_evals=n_trials,
+            trials=trials,
+            resume_from=rec,
+            rstate=np.random.default_rng(seed),
+            show_progressbar=False,
+            return_argmin=False,
+        )
+        return rec.seconds_spent / n_trials, rec.wal.total_tells
+
+
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
@@ -634,6 +671,10 @@ def main():
     dispatches_per_trial = bench_fused_dispatches(
         n_trials=min(120, n_trials_1k)
     )
+    resume_overhead, resume_wal_tells = bench_resume_overhead(
+        n_trials=min(60, n_trials_1k)
+    )
+    assert resume_wal_tells == min(60, n_trials_1k)
     loop_rate = bench_device_loop() if platform != "cpu" else None
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
@@ -686,6 +727,14 @@ def main():
                 "speculative_suggest_per_sec": round(spec_rate, 1),
                 "host_to_device_bytes_per_ask": transfer_rows,
                 "dispatches_per_trial": round(dispatches_per_trial, 3),
+                # round-10 crash-recovery contract rows: durability cost
+                # per trial (WAL append + amortized bundle publish), and
+                # the same as a fraction of the fused per-trial dispatch
+                # time (acceptance bound: < 0.10)
+                "resume_overhead_per_trial": round(resume_overhead, 6),
+                "resume_overhead_frac_of_fused": round(
+                    resume_overhead * fused_sync_rate, 4
+                ),
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),

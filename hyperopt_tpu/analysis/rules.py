@@ -491,6 +491,62 @@ def check_sleep_in_retry_loop(ctx):
             )
 
 
+#: state-serialization entry points whose output, written straight to a
+#: file, is a checkpoint in the making
+_STATE_DUMPERS = frozenset({"dump", "savez", "savez_compressed"})
+
+
+def _is_state_dump(call):
+    """pickle.dump / np.savez / np.savez_compressed with arguments."""
+    dn = dotted_name(call.func)
+    if dn is None or not call.args:
+        return False
+    parts = dn.split(".")
+    if len(parts) != 2 or parts[1] not in _STATE_DUMPERS:
+        return False
+    return parts[0] == "pickle" or parts[0] in _NUMPY_MODULES
+
+
+@register(
+    "GL305", "state-dump-bypasses-durable-saver",
+    "pickle.dump/np.savez writes state to a file with no fsync in the "
+    "same function -- a crash publishes a truncated checkpoint; route "
+    "through utils/checkpoint's durable savers (tmp+fsync+rename)",
+)
+def check_state_dump_bypasses_durable_saver(ctx):
+    # the gap GL301 cannot see: a checkpoint written IN PLACE (no
+    # rename at all, so GL301 never fires) is still torn by a crash
+    # mid-dump -- the exact fmin.py:285 latent bug this rule pins
+    if _is_test_file(ctx):
+        return
+    for scope in list(ctx.functions) + [ctx.tree]:
+        if isinstance(scope, ast.Lambda):
+            continue
+        own = list(walk_scope(scope))
+        dumps = [
+            n for n in own if isinstance(n, ast.Call) and _is_state_dump(n)
+        ]
+        if not dumps:
+            continue
+        names = {
+            terminal_name(n.func)
+            for n in own
+            if isinstance(n, ast.Call)
+        }
+        if "fsync" in names:
+            continue  # durable-saver shape; rename ordering is GL301's job
+        if "BytesIO" in names:
+            continue  # in-memory serialization: nothing to make durable
+        for n in dumps:
+            yield ctx.finding(
+                "GL305", n,
+                f"{dotted_name(n.func)}() writes state with no fsync "
+                "in scope: a crash mid-write (or before writeback) "
+                "publishes a truncated checkpoint; use the durable "
+                "savers in utils/checkpoint.py",
+            )
+
+
 _NP_GLOBAL_STATE = frozenset({
     "seed", "rand", "randn", "randint", "random", "uniform", "normal",
     "choice", "shuffle", "permutation", "standard_normal", "beta",

@@ -593,8 +593,16 @@ class Domain:
 
         if isinstance(rval, (float, int, np.number)):
             loss = float(rval)
-            if np.isnan(loss):
-                result = {"status": STATUS_FAIL, "loss": None}
+            if not np.isfinite(loss):
+                # NaN/Inf quarantine: a non-finite loss is recorded as
+                # a FAILED trial -- never as an "ok" observation that
+                # would poison best_trial/loss_threshold and every
+                # subsequent suggestion's above/below split
+                result = {
+                    "status": STATUS_FAIL,
+                    "loss": None,
+                    "failure": f"non-finite loss {loss!r}",
+                }
             else:
                 result = {"status": STATUS_OK, "loss": loss}
         elif isinstance(rval, dict):
@@ -612,6 +620,13 @@ class Domain:
                         f"objective with status 'ok' must return a float loss, "
                         f"got {result.get('loss')!r}"
                     )
+                if not np.isfinite(result["loss"]):
+                    # same quarantine for the dict-result path
+                    result["failure"] = (
+                        f"non-finite loss {result['loss']!r}"
+                    )
+                    result["status"] = STATUS_FAIL
+                    result["loss"] = None
         else:
             raise InvalidResultStatus(
                 f"objective must return float or dict, got {type(rval)}"

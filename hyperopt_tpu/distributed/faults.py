@@ -49,10 +49,10 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
-    "CRASH_POINTS",
+    "CRASH_POINTS", "DRIVER_CRASH_POINTS", "ALL_CRASH_POINTS",
 ]
 
-#: every named crash point the protocol code declares (see module
+#: every named crash point the QUEUE protocol code declares (see module
 #: docstring) -- the chaos suite iterates this so a new crash point
 #: cannot be added without being exercised.
 CRASH_POINTS = (
@@ -66,6 +66,27 @@ CRASH_POINTS = (
     "after_attach_fsync_before_rename",
     "before_complete",
 )
+
+#: crash points of the sequential DRIVER's recovery protocol (fmin's
+#: write-ahead tell log + checkpoint bundles -- utils/wal.py,
+#: utils/checkpoint.DriverRecovery).  The resume-parity suite
+#: (tests/test_resume_parity.py) iterates this tuple the same way the
+#: distributed chaos suite iterates :data:`CRASH_POINTS`::
+#:
+#:     before_wal_append            evaluated/asked, record not yet durable
+#:     after_wal_append_before_tell record durable, tell not yet applied
+#:     after_tell_before_ask_ahead  tell applied, pre-dispatch handoff pending
+#:     after_ckpt_tmp_before_rename bundle tmp fsynced, not yet published
+#:     after_ckpt_publish_before_wal_reset  bundle live, WAL not compacted
+DRIVER_CRASH_POINTS = (
+    "before_wal_append",
+    "after_wal_append_before_tell",
+    "after_tell_before_ask_ahead",
+    "after_ckpt_tmp_before_rename",
+    "after_ckpt_publish_before_wal_reset",
+)
+
+ALL_CRASH_POINTS = CRASH_POINTS + DRIVER_CRASH_POINTS
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError
 #: (ENOENT) may be added to a plan's ``errors`` to simulate NFS
@@ -190,7 +211,7 @@ class FaultPlan:
 
     def arm(self, point, at=1):
         """Arm a one-shot crash at the ``at``-th hit of ``point``."""
-        if point not in CRASH_POINTS:
+        if point not in ALL_CRASH_POINTS:
             raise ValueError(f"unknown crash point {point!r}")
         with self._lock:
             self._crash[point] = int(at)

@@ -1,4 +1,5 @@
-"""Recovery audit for a FileJobQueue directory.
+"""Recovery audit for a FileJobQueue directory -- and for the
+sequential driver's crash-recovery artifacts.
 
 ``python -m hyperopt_tpu.distributed.fsck --dir D [--repair]`` detects
 (and, with ``--repair``, fixes) the residue every crash mode of the
@@ -15,10 +16,24 @@ completed_claim     crash between DONE publish and claim release     release (un
 duplicate_tid       completed job recycled back into new/running     retire the shadowed copy
 ==================  ==============================================  ===========================
 
-After ``--repair`` a fresh worker drains the directory completely: no
-job lost, no DONE doc duplicated.  The tool only moves or deletes files
-the protocol can prove are residue; half-written docs go to
-``quarantine/`` (with a uniquifying suffix), never silently destroyed.
+``--driver PATH`` audits a driver checkpoint family instead (``PATH``,
+``PATH.meta``, ``PATH.wal`` -- ``fmin(trials_save_file=)``'s recovery
+artifacts):
+
+=========================  =========================================  ===========================
+issue                      how it happens                             repair
+=========================  =========================================  ===========================
+wal_torn_tail              driver died mid-append (torn record)       truncate to the valid prefix
+wal_corrupt                mid-file checksum failure (not a tail)     quarantine the log
+ckpt_fingerprint_mismatch  bundle belongs to a different study        quarantine the bundle
+orphaned_snapshot_tmp      crash between snapshot tmp and rename      unlink (never referenced)
+=========================  =========================================  ===========================
+
+After ``--driver PATH --repair`` the checkpoint family is resumable:
+``fmin(resume_from=PATH)`` loads the trials pickle, replays the valid
+WAL prefix, and continues.  The tool only moves or deletes files the
+protocol can prove are residue; anything ambiguous is quarantined
+(``*.quarantined.*`` suffix), never silently destroyed.
 
 Exit codes: 0 clean (or fully repaired), 1 issues found (audit-only)
 or unrepaired issues remain.
@@ -39,7 +54,9 @@ from .filequeue import _read_json
 
 logger = logging.getLogger(__name__)
 
-__all__ = ["Issue", "audit", "repair", "main"]
+__all__ = [
+    "Issue", "audit", "repair", "audit_driver", "repair_driver", "main",
+]
 
 _SUBS = ("new", "running", "done")
 
@@ -204,12 +221,121 @@ def repair(root, issues, fs=REAL_FS):
     return repaired
 
 
+# ---------------------------------------------------------------------------
+# driver checkpoint family (fmin's WAL + bundle artifacts)
+# ---------------------------------------------------------------------------
+
+
+def audit_driver(path, fs=REAL_FS, tmp_grace=60.0):
+    """Audit a driver checkpoint family (``path`` / ``path.meta`` /
+    ``path.wal``) for the corruption classes a killed driver can leave:
+    a torn WAL tail, mid-file WAL corruption, a bundle whose guard
+    fingerprint disagrees with the WAL header (a foreign study's
+    artifact under this name), and orphaned ``*.tmp.*`` snapshots."""
+    import pickle
+
+    from ..exceptions import CheckpointError
+    from ..utils.wal import TellWAL
+
+    path = os.path.abspath(path)
+    issues = []
+    now = time.time()
+    # orphaned snapshot tmp files: <family member>.tmp.<pid> residue of
+    # a crash inside a durable publish (the rename never happened)
+    dirname, base = os.path.split(path)
+    try:
+        names = fs.listdir(dirname)
+    except FileNotFoundError:
+        names = []
+    for name in sorted(names):
+        if not name.startswith(base) or ".tmp." not in name:
+            continue
+        full = os.path.join(dirname, name)
+        try:
+            age = now - fs.getmtime(full)
+        except OSError:
+            continue
+        if age >= tmp_grace:
+            issues.append(Issue(
+                "orphaned_snapshot_tmp", full, f"age {age:.0f}s"
+            ))
+    # WAL integrity: a torn tail is normal crash residue (repairable by
+    # truncation); a mid-file checksum failure is not ours to truncate
+    wal = TellWAL(path + ".wal", fs=fs)
+    wal_guard = None
+    if wal.exists():
+        try:
+            header, _records, _good, torn = wal.scan()
+            wal_guard = (header or {}).get("guard")
+            if torn:
+                issues.append(Issue(
+                    "wal_torn_tail", wal.path, f"{torn} torn byte(s)"
+                ))
+        except CheckpointError as e:
+            issues.append(Issue("wal_corrupt", wal.path, str(e)))
+    # bundle fingerprint: the meta guard and the WAL header guard were
+    # stamped by the same study -- disagreement means one of them is a
+    # foreign artifact parked under this family's name
+    meta_path = path + ".meta"
+    if fs.exists(meta_path) and wal_guard is not None:
+        try:
+            with fs.open(meta_path, "rb") as f:
+                meta = pickle.loads(f.read())
+            meta_guard = meta.get("guard")
+        except Exception:  # graftlint: disable=GL302 an unreadable bundle is reported as an issue, not retried
+            meta_guard = None
+            issues.append(Issue(
+                "ckpt_fingerprint_mismatch", meta_path,
+                "bundle unreadable",
+            ))
+        if meta_guard is not None and list(meta_guard) != list(wal_guard):
+            issues.append(Issue(
+                "ckpt_fingerprint_mismatch", meta_path,
+                f"bundle guard {meta_guard!r} != WAL guard {wal_guard!r}",
+            ))
+    return issues
+
+
+def repair_driver(path, issues, fs=REAL_FS):
+    """Fix every repairable driver-family :class:`Issue`; returns the
+    repaired count.  Quarantined artifacts get a ``.quarantined.<pid>``
+    suffix next to the family -- resume then falls back to the
+    surviving artifacts (trials pickle + valid WAL prefix)."""
+    from ..utils.wal import TellWAL
+
+    repaired = 0
+    for issue in sorted(issues, key=lambda i: (i.kind, i.path)):
+        try:
+            if issue.kind == "orphaned_snapshot_tmp":
+                fs.unlink(issue.path)
+            elif issue.kind == "wal_torn_tail":
+                TellWAL(issue.path, fs=fs).recover()
+            elif issue.kind in ("wal_corrupt", "ckpt_fingerprint_mismatch"):
+                dst = f"{issue.path}.quarantined.{os.getpid()}"
+                fs.rename(issue.path, dst)
+                logger.warning("quarantined %s -> %s", issue.path, dst)
+            else:
+                continue
+            repaired += 1
+        except FileNotFoundError:
+            repaired += 1  # a live driver fixed it first
+        except OSError as e:
+            logger.error("could not repair %r: %s", issue, e)
+    return repaired
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.distributed.fsck",
-        description="Audit (and repair) a FileJobQueue directory.",
+        description="Audit (and repair) a FileJobQueue directory, or a "
+        "driver checkpoint family (--driver).",
     )
-    parser.add_argument("--dir", required=True, help="queue directory")
+    parser.add_argument("--dir", help="queue directory")
+    parser.add_argument(
+        "--driver", metavar="PATH",
+        help="audit fmin's driver checkpoint family (PATH, PATH.meta, "
+        "PATH.wal) instead of a queue directory",
+    )
     parser.add_argument(
         "--repair", action="store_true",
         help="fix repairable issues instead of only reporting them",
@@ -229,26 +355,37 @@ def main(argv=None):
         level=logging.DEBUG if options.verbose else logging.INFO,
         stream=sys.stderr,
     )
-    reserve_timeout = (
-        None if options.reserve_timeout < 0 else options.reserve_timeout
-    )
-    issues = audit(
-        options.dir, reserve_timeout=reserve_timeout,
-        tmp_grace=options.tmp_grace,
-    )
+    if bool(options.dir) == bool(options.driver):
+        parser.error("exactly one of --dir or --driver is required")
+    if options.driver:
+        target = options.driver
+        do_audit = lambda: audit_driver(  # noqa: E731
+            options.driver, tmp_grace=options.tmp_grace
+        )
+        do_repair = lambda issues: repair_driver(  # noqa: E731
+            options.driver, issues
+        )
+    else:
+        target = options.dir
+        reserve_timeout = (
+            None if options.reserve_timeout < 0 else options.reserve_timeout
+        )
+        do_audit = lambda: audit(  # noqa: E731
+            options.dir, reserve_timeout=reserve_timeout,
+            tmp_grace=options.tmp_grace,
+        )
+        do_repair = lambda issues: repair(options.dir, issues)  # noqa: E731
+    issues = do_audit()
     for issue in issues:
         print(f"{issue.kind}: {issue.path} ({issue.detail})")
     if not issues:
-        print(f"{options.dir}: clean")
+        print(f"{target}: clean")
         return 0
     if not options.repair:
         print(f"{len(issues)} issue(s) found (re-run with --repair to fix)")
         return 1
-    n = repair(options.dir, issues)
-    remaining = audit(
-        options.dir, reserve_timeout=reserve_timeout,
-        tmp_grace=options.tmp_grace,
-    )
+    n = do_repair(issues)
+    remaining = do_audit()
     print(f"repaired {n}/{len(issues)} issue(s); {len(remaining)} remain")
     return 0 if not remaining else 1
 

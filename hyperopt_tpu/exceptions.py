@@ -43,6 +43,20 @@ class CompileError(HyperoptTpuError):
     """The space compiler could not lower a search space to a JAX sampler."""
 
 
+class CheckpointError(HyperoptTpuError):
+    """A checkpoint / write-ahead-log artifact could not be used for
+    resume: truncated or corrupt pickle, torn mid-file WAL record, or a
+    guard-fingerprint mismatch (the snapshot belongs to a different
+    space/algo/objective).  The message names the offending file and,
+    when one exists, the last-good artifact to fall back to."""
+
+
+class TrialTimeout(HyperoptTpuError):
+    """A single objective evaluation exceeded the driver's per-trial
+    deadline (``fmin(trial_timeout=...)``); recorded as a STATUS_FAIL
+    trial, never propagated."""
+
+
 class BackendError(HyperoptTpuError):
     """A distributed-transport (filequeue / mongo) operation failed.
 

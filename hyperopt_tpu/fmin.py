@@ -15,6 +15,7 @@ import os
 import pickle
 import time
 import timeit
+import traceback
 
 import numpy as np
 
@@ -26,12 +27,18 @@ from .base import (
     JOB_STATE_ERROR,
     JOB_STATE_NEW,
     JOB_STATE_RUNNING,
+    STATUS_FAIL,
     STATUS_OK,
     Trials,
     spec_from_misc,
     trials_from_docs,
 )
-from .exceptions import AllTrialsFailed, InvalidAnnotatedParameter
+from .exceptions import (
+    AllTrialsFailed,
+    CheckpointError,
+    InvalidAnnotatedParameter,
+    TrialTimeout,
+)
 from .pyll.base import as_apply, rec_eval
 from .pyll_utils import expr_to_config
 from .utils import coarse_utcnow
@@ -128,6 +135,9 @@ class FMinIter:
         show_progressbar=True,
         early_stop_fn=None,
         trials_save_file="",
+        recovery=None,
+        trial_timeout=None,
+        catch=(),
     ):
         self.algo = algo
         self.domain = domain
@@ -147,6 +157,17 @@ class FMinIter:
         self.early_stop_fn = early_stop_fn
         self.early_stop_args = []
         self.trials_save_file = trials_save_file
+        # crash recovery (utils.checkpoint.DriverRecovery): write-ahead
+        # tell log + durable bundles.  Sequential driver only -- async
+        # backends have their own durability story (the queue itself).
+        self._recovery = None if self.asynchronous else recovery
+        # per-trial failure containment: a deadline in seconds, and a
+        # tuple of exception classes recorded as STATUS_FAIL trials
+        # (with traceback) instead of aborting the study
+        self.trial_timeout = trial_timeout
+        if catch and not isinstance(catch, tuple):
+            catch = (catch,)
+        self.catch = catch or ()
         # ask-ahead seam (sequential driver): seed pre-drawn for the NEXT
         # ask so an algo's result hook can pre-dispatch it -- see
         # _notify_result
@@ -233,7 +254,68 @@ class FMinIter:
     def should_stop(self):
         return self._timed_out() or self._loss_reached() or self._early_stopped()
 
+    # -- crash recovery seams ----------------------------------------------
+    def _crashpoint(self, name):
+        if self._recovery is not None:
+            self._recovery.fs.crashpoint(name)
+
+    def _log_ask(self, docs):
+        """Write-ahead the new trial docs (plus the rstate cursor after
+        their seed draw) BEFORE they are inserted: an ask that reached
+        the log is never re-drawn on resume; one that did not is
+        re-issued from the recorded cursor and draws the same seed."""
+        if self._recovery is not None:
+            self._recovery.log_ask(base.SONify(docs), self.rstate)
+
+    def _log_tell(self, trial, result=None):
+        """Write-ahead one evaluation outcome BEFORE it is applied --
+        the exactly-once half of the recovery contract: a logged tell
+        is never re-evaluated and never double-applied on resume."""
+        if self._recovery is None:
+            return
+        if result is not None:
+            self._recovery.log_tell(
+                trial["tid"], JOB_STATE_DONE, result=result
+            )
+        else:
+            self._recovery.log_tell(
+                trial["tid"], JOB_STATE_ERROR,
+                error=list(trial["misc"].get("error", ())),
+                tb=trial["misc"].get("traceback"),
+            )
+
     # -- evaluation --------------------------------------------------------
+    def _evaluate_one(self, spec, ctrl):
+        """One objective call, under the per-trial deadline when
+        ``trial_timeout`` is set.  The deadline runs the objective on a
+        daemon thread: on expiry the trial is recorded as failed and
+        the driver moves on -- the runaway evaluation cannot be killed,
+        only abandoned (documented in FAILURES.md)."""
+        if not self.trial_timeout:
+            return self.domain.evaluate(spec, ctrl)
+        import threading
+
+        box = {}
+
+        def _run():
+            try:
+                box["result"] = self.domain.evaluate(spec, ctrl)
+            except BaseException as e:
+                box["error"] = e
+
+        worker = threading.Thread(target=_run, daemon=True)
+        worker.start()
+        worker.join(self.trial_timeout)
+        if worker.is_alive():
+            raise TrialTimeout(
+                f"objective exceeded trial_timeout="
+                f"{self.trial_timeout}s; recording STATUS_FAIL and "
+                "continuing (the runaway thread is abandoned)"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
     def serial_evaluate(self, N=-1):
         for trial in self.trials._dynamic_trials:
             if trial["state"] != JOB_STATE_NEW:
@@ -243,20 +325,46 @@ class FMinIter:
             trial["owner"] = "serial"
             spec = spec_from_misc(trial["misc"])
             ctrl = Ctrl(self.trials, current_trial=trial)
+            result = failure = None
             try:
-                result = self.domain.evaluate(spec, ctrl)
+                result = self._evaluate_one(spec, ctrl)
+            except TrialTimeout as e:
+                failure = ("TrialTimeout", str(e), None)
+            except self.catch as e:
+                failure = (type(e).__name__, str(e), traceback.format_exc())
             except Exception as e:
                 logger.error("job exception: %s", e)
                 trial["state"] = JOB_STATE_ERROR
                 trial["misc"]["error"] = (str(type(e)), str(e))
+                trial["misc"]["traceback"] = traceback.format_exc()
                 trial["refresh_time"] = coarse_utcnow()
+                # the failure is durable before any (re)raise: a
+                # resumed driver must not re-run a crashing objective
+                self._log_tell(trial)
                 if not self.catch_eval_exceptions:
                     self.trials.refresh()
                     raise
-            else:
+            if result is not None or failure is not None:
+                if failure is not None:
+                    kind, msg, tb = failure
+                    logger.warning(
+                        "trial %s recorded as failed (%s): %s",
+                        trial["tid"], kind, msg,
+                    )
+                    result = {
+                        "status": STATUS_FAIL,
+                        "loss": None,
+                        "failure": f"{kind}: {msg}",
+                    }
+                    if tb is not None:
+                        result["traceback"] = tb
+                result = base.SONify(result)
+                # write-ahead: the tell is on disk before it is applied
+                self._log_tell(trial, result=result)
                 trial["state"] = JOB_STATE_DONE
-                trial["result"] = base.SONify(result)
+                trial["result"] = result
                 trial["refresh_time"] = coarse_utcnow()
+                self._crashpoint("after_tell_before_ask_ahead")
                 self._notify_result()
             N -= 1
             if N == 0:
@@ -280,9 +388,26 @@ class FMinIter:
 
     # -- checkpoint --------------------------------------------------------
     def _save_trials(self):
+        # tmp + fsync + rename (was a bare pickle.dump: the latent
+        # GL301/GL305 -- a crash mid-dump left a truncated pickle under
+        # the real name, unloadable on resume)
         if self.trials_save_file:
-            with open(self.trials_save_file, "wb") as f:
-                pickle.dump(self.trials, f, protocol=self.pickle_protocol)
+            from .utils.checkpoint import save_trials
+
+            save_trials(self.trials, self.trials_save_file)
+
+    def _checkpoint_round(self, force=False):
+        """Round-boundary durability: the recovery bundle at its tell
+        cadence (WAL covers the gaps), or -- without a recovery
+        coordinator (async backends, legacy callers) -- the plain
+        durable trials pickle every round."""
+        if self._recovery is not None:
+            self._recovery.maybe_checkpoint(
+                self.trials, self.rstate,
+                ask_ahead_seed=self._ask_ahead_seed, force=force,
+            )
+        else:
+            self._save_trials()
 
     # -- main loop ---------------------------------------------------------
     def run(self, N, block_until_done=True):
@@ -319,6 +444,7 @@ class FMinIter:
                         stopped = True
                         break
                     assert len(new_ids) >= len(new_trials)
+                    self._log_ask(new_trials)
                     trials.insert_trial_docs(new_trials)
                     trials.refresh()
                     n_queued += len(new_trials)
@@ -346,9 +472,10 @@ class FMinIter:
                     )
                     set_progress_done(progress, n_done - initial_n_done)
 
-                self._save_trials()
+                self._checkpoint_round()
                 if stopped:
                     break
+        self._checkpoint_round(force=True)
 
     def _progress_ctx(self, initial, total):
         if callable(self.show_progressbar) and not isinstance(
@@ -383,6 +510,21 @@ def set_progress_done(progress, n):
     progress._n_done = n
 
 
+def _driver_guard(algo, fn, space):
+    """The study fingerprint stamped into every recovery artifact
+    (reusing the PR-3/4 checkpoint-guard identities): resuming under a
+    different algo, objective, or space silently changes the experiment
+    and must be refused instead."""
+    from .hyperband import _algo_identity, _space_fingerprint
+
+    return [
+        "fmin-driver", 1,
+        _algo_identity(algo),
+        _algo_identity(fn),
+        _space_fingerprint(as_apply(space)),
+    ]
+
+
 def fmin(
     fn,
     space,
@@ -402,12 +544,32 @@ def fmin(
     show_progressbar=True,
     early_stop_fn=None,
     trials_save_file="",
+    resume_from=None,
+    trial_timeout=None,
+    catch=(),
 ):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
     Drop-in parity with the reference ``hyperopt.fmin`` (SURVEY.md SS2 L4);
     pass ``algo=hyperopt_tpu.tpe.suggest`` for the host parity path or
     ``algo=hyperopt_tpu.tpe_jax.suggest`` for the jitted TPU path.
+
+    Crash recovery (sequential driver): ``trials_save_file`` routes
+    through :class:`~hyperopt_tpu.utils.checkpoint.DriverRecovery` -- a
+    write-ahead tell log plus durable checkpoint bundles -- so a driver
+    killed at any point resumes with zero lost / zero duplicated tells
+    and a suggestion stream bitwise identical to the uninterrupted run
+    (the restored numpy bit-generator supersedes a passed ``rstate``).
+    ``resume_from`` is the explicit form: the checkpoint must already
+    exist (a :class:`~hyperopt_tpu.exceptions.CheckpointError` refuses
+    a missing or foreign-study one); it may also be a ``DriverRecovery``
+    instance for injection (chaos tests arm crash points on its ``fs``).
+
+    Per-trial containment: ``trial_timeout`` (seconds) records an
+    overrunning objective as a STATUS_FAIL trial and moves on;
+    ``catch`` (an exception class or tuple) does the same for raising
+    objectives, with the traceback attached to the result -- both are
+    WAL-logged, so a resumed run never re-runs a known-bad trial.
     """
     if algo is None:
         from . import tpe
@@ -429,10 +591,40 @@ def fmin(
 
     validate_timeout(timeout)
     validate_loss_threshold(loss_threshold)
+    validate_timeout(trial_timeout)
 
-    if trials_save_file and os.path.exists(trials_save_file):
-        with open(trials_save_file, "rb") as f:
-            trials = pickle.load(f)
+    from .utils.checkpoint import DriverRecovery
+
+    recovery = None
+    ask_ahead_seed = None
+    if resume_from is not None or trials_save_file:
+        if isinstance(resume_from, DriverRecovery):
+            # injected coordinator (the chaos suite arms crash points
+            # on its fs seam): load-if-exists, start fresh otherwise
+            recovery = resume_from
+        else:
+            recovery = DriverRecovery(resume_from or trials_save_file)
+            if resume_from is not None and not recovery.exists():
+                raise CheckpointError(
+                    f"resume_from checkpoint {recovery.path!r} does "
+                    "not exist; pass trials_save_file= to start a "
+                    "fresh recoverable run instead"
+                )
+        recovery.set_guard(_driver_guard(algo, fn, space))
+        if recovery.exists():
+            restored = recovery.load()
+            trials = restored.trials
+            ask_ahead_seed = restored.ask_ahead_seed
+            if restored.rstate is not None:
+                rstate = restored.rstate
+                logger.info(
+                    "resumed %d trials from %r (replayed %d tell(s) "
+                    "from the WAL); bit-generator state restored -- "
+                    "the suggestion stream continues exactly where the "
+                    "previous run stopped",
+                    len(trials), recovery.path,
+                    restored.n_replayed_tells,
+                )
 
     if trials is None:
         if points_to_evaluate is None:
@@ -484,8 +676,21 @@ def fmin(
         show_progressbar=show_progressbar,
         early_stop_fn=early_stop_fn,
         trials_save_file=trials_save_file,
+        recovery=recovery,
+        trial_timeout=trial_timeout,
+        catch=catch,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
+    if ask_ahead_seed is not None:
+        # the bundle-recorded ask-ahead seam position: the seed the
+        # crashed run had pre-drawn for its next ask (same stream, so
+        # the resumed ask sees the identical seed either way)
+        rval._ask_ahead_seed = int(ask_ahead_seed)
+    if rval._recovery is not None and not recovery.exists():
+        # anchor checkpoint before the first ask: WAL replay needs a
+        # bundle to be relative to, and points_to_evaluate seeds must
+        # survive a crash before the first cadence boundary
+        recovery.checkpoint(trials, rstate)
     rval.exhaust()
 
     if return_argmin:

@@ -402,20 +402,45 @@ class JaxTrials(Trials):
     def obs_buffer(self, space: PackedSpace, resident=None) -> ObsBuffer:
         buf = self._buffers.get(id(space))
         if buf is None:
-            buf = ObsBuffer(
-                space,
-                resident=getattr(self, "_resident_default", False),
-            )
+            buf = self._restore_stashed(space)
+            if buf is None:
+                buf = ObsBuffer(
+                    space,
+                    resident=getattr(self, "_resident_default", False),
+                )
             self._buffers[id(space)] = buf
         if resident is not None:
             buf.set_resident(resident)
         buf.sync(self)
         return buf
 
+    def _restore_stashed(self, space: PackedSpace):
+        """Rebuild a buffer from a checkpoint-bundle npz blob
+        (``DriverRecovery.load`` stashes them on the unpickled store):
+        the resumed resident mirror starts from the saved dense arrays
+        and ``sync`` only ingests the WAL-replayed suffix, instead of
+        re-scanning the whole doc list.  A blob whose labels do not
+        match ``space`` is simply not this space's buffer."""
+        blobs = getattr(self, "_stashed_obs_npz", None)
+        if not blobs:
+            return None
+        from .utils.checkpoint import load_obs_buffer_bytes
+
+        for i, blob in enumerate(blobs):
+            try:
+                buf = load_obs_buffer_bytes(space, blob)
+            except ValueError:
+                continue
+            blobs.pop(i)
+            buf.set_resident(getattr(self, "_resident_default", False))
+            return buf
+        return None
+
     def __getstate__(self):
         # buffers are derived state; rebuilt on demand after unpickling
         state = self.__dict__.copy()
         state["_buffers"] = {}
+        state.pop("_stashed_obs_npz", None)  # bundle-restore residue
         return state
 
 

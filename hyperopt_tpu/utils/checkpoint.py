@@ -34,18 +34,7 @@ def save_obs_buffer(buf, path):
     """Serialize an ObsBuffer's arrays + cursors to ``path`` (.npz)."""
     tmp = f"{path}.tmp.{os.getpid()}.npz"
     with open(tmp, "wb") as f:
-        np.savez_compressed(
-            f,
-            values=buf.values,
-            active=buf.active,
-            losses=buf.losses,
-            valid=buf.valid,
-            tids=buf.tids,
-            count=np.int64(buf.count),
-            n_scanned=np.int64(buf._n_scanned),
-            pending=np.asarray(buf._pending, dtype=np.int64),
-            labels=np.asarray(buf.space.labels, dtype=object),
-        )
+        f.write(obs_buffer_npz_bytes(buf))
         # fsync before the rename (GL301): without it a crash after the
         # replace can publish a truncated checkpoint under the real name
         f.flush()
@@ -54,40 +43,79 @@ def save_obs_buffer(buf, path):
     return path
 
 
-def load_obs_buffer(space, path):
-    """Rebuild an ObsBuffer for ``space`` from a saved .npz."""
+def _fill_obs_buffer(space, data):
+    """Rebuild an ObsBuffer for ``space`` from a loaded npz mapping --
+    the shared core of :func:`load_obs_buffer` (file path) and
+    :func:`load_obs_buffer_bytes` (in-bundle blob)."""
     from ..jax_trials import ObsBuffer
 
-    with np.load(path, allow_pickle=True) as data:
-        labels = list(data["labels"])
-        if labels != list(space.labels):
-            raise ValueError(
-                f"checkpoint labels {labels} do not match space "
-                f"{list(space.labels)}"
-            )
-        buf = ObsBuffer(space, capacity=int(data["values"].shape[1]))
-        buf.values[:] = data["values"]
-        buf.active[:] = data["active"]
-        buf.losses[:] = data["losses"]
-        buf.valid[:] = data["valid"]
-        if "tids" in data:  # absent in pre-round-2 checkpoints
-            buf.tids[:] = data["tids"]
-        else:
-            # legacy checkpoint: synthesized contiguous tids are only an
-            # approximation (failed/NaN trials interleave tids in real
-            # runs) -- mark the buffer so its first sync() against a
-            # trials store rebuilds from the doc list (source of truth)
-            # instead of trusting this guess for late-completion inserts
-            buf.tids[: int(data["count"])] = np.arange(int(data["count"]))
-            buf._legacy_tids = True
-        buf.count = int(data["count"])
-        buf._n_scanned = int(data["n_scanned"])
-        # docs scanned while in flight must survive resume, else the
-        # checkpoint path reintroduces async posterior starvation
-        buf._pending = (
-            [int(i) for i in data["pending"]] if "pending" in data else []
+    labels = list(data["labels"])
+    if labels != list(space.labels):
+        raise ValueError(
+            f"checkpoint labels {labels} do not match space "
+            f"{list(space.labels)}"
         )
+    buf = ObsBuffer(space, capacity=int(data["values"].shape[1]))
+    buf.values[:] = data["values"]
+    buf.active[:] = data["active"]
+    buf.losses[:] = data["losses"]
+    buf.valid[:] = data["valid"]
+    if "tids" in data:  # absent in pre-round-2 checkpoints
+        buf.tids[:] = data["tids"]
+    else:
+        # legacy checkpoint: synthesized contiguous tids are only an
+        # approximation (failed/NaN trials interleave tids in real
+        # runs) -- mark the buffer so its first sync() against a
+        # trials store rebuilds from the doc list (source of truth)
+        # instead of trusting this guess for late-completion inserts
+        buf.tids[: int(data["count"])] = np.arange(int(data["count"]))
+        buf._legacy_tids = True
+    buf.count = int(data["count"])
+    buf._n_scanned = int(data["n_scanned"])
+    # docs scanned while in flight must survive resume, else the
+    # checkpoint path reintroduces async posterior starvation
+    buf._pending = (
+        [int(i) for i in data["pending"]] if "pending" in data else []
+    )
     return buf
+
+
+def load_obs_buffer(space, path):
+    """Rebuild an ObsBuffer for ``space`` from a saved .npz."""
+    with np.load(path, allow_pickle=True) as data:
+        return _fill_obs_buffer(space, data)
+
+
+def obs_buffer_npz_bytes(buf):
+    """The :func:`save_obs_buffer` npz payload as in-memory bytes --
+    what :class:`DriverRecovery` embeds in its checkpoint bundle so a
+    resumed resident mirror re-materializes without re-scanning the
+    whole doc list."""
+    import io
+
+    bio = io.BytesIO()
+    np.savez_compressed(
+        bio,
+        values=buf.values,
+        active=buf.active,
+        losses=buf.losses,
+        valid=buf.valid,
+        tids=buf.tids,
+        count=np.int64(buf.count),
+        n_scanned=np.int64(buf._n_scanned),
+        pending=np.asarray(buf._pending, dtype=np.int64),
+        labels=np.asarray(buf.space.labels, dtype=object),
+    )
+    return bio.getvalue()
+
+
+def load_obs_buffer_bytes(space, blob):
+    """Inverse of :func:`obs_buffer_npz_bytes`; raises ValueError on a
+    space/label mismatch (the caller treats that as 'not my blob')."""
+    import io
+
+    with np.load(io.BytesIO(blob), allow_pickle=True) as data:
+        return _fill_obs_buffer(space, data)
 
 
 def _obs_buffer_tree(buf):
@@ -240,7 +268,30 @@ def load_pytree(target, path):
         return jax.tree_util.tree_map_with_path(fill, target)
 
 
-def save_trials(trials, path):
+def durable_pickle(obj, path, fs=None, crash_between=None):
+    """THE durable saver for pickled state: tmp + fsync + atomic
+    rename.  Every checkpoint/WAL-adjacent pickle write must route
+    through here (or fsync+rename itself) -- graftlint GL305 flags the
+    bare-``pickle.dump`` shortcut.  ``fs`` is the PR-3 injection seam;
+    ``crash_between`` names a crash point fired between the fsync and
+    the publishing rename (the torn-publish window chaos tests kill
+    in)."""
+    import pickle
+
+    from ..distributed.faults import REAL_FS
+
+    fs = REAL_FS if fs is None else fs
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with fs.open(tmp, "wb") as f:
+        f.write(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+        fs.fsync(f)
+        if crash_between:
+            fs.crashpoint(crash_between)
+    fs.rename(tmp, path)
+    return path
+
+
+def save_trials(trials, path, fs=None):
     """Checkpoint a Trials store.
 
     Trial docs are JSON-ish host objects, so this is the stdlib pickle
@@ -248,15 +299,7 @@ def save_trials(trials, path):
     (:func:`save_obs_buffer_orbax`) for deployments standardized on
     orbax checkpoint trees.
     """
-    import pickle
-
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "wb") as f:
-        pickle.dump(trials, f, protocol=pickle.HIGHEST_PROTOCOL)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return path
+    return durable_pickle(trials, path, fs=fs)
 
 
 def load_trials(path):
@@ -264,6 +307,381 @@ def load_trials(path):
 
     with open(path, "rb") as f:
         return pickle.load(f)
+
+
+def load_pickle_guarded(path, fs=None, what="checkpoint"):
+    """Load a pickle, converting the raw truncation/corruption zoo
+    (EOFError, UnpicklingError, ...) into a :class:`~hyperopt_tpu.
+    exceptions.CheckpointError` that names the file and the recovery
+    options -- a resumed driver must never greet its operator with a
+    bare ``pickle`` traceback."""
+    import pickle
+
+    from ..distributed.faults import REAL_FS
+    from ..exceptions import CheckpointError
+
+    from ..distributed import _common
+
+    fs = REAL_FS if fs is None else fs
+
+    def _read():
+        with fs.open(path, "rb") as f:
+            return f.read()
+
+    try:
+        return pickle.loads(
+            _common.with_retries(_read, label="checkpoint read")
+        )
+    except (
+        EOFError, pickle.UnpicklingError, AttributeError, ImportError,
+        IndexError, MemoryError, ValueError,
+    ) as e:
+        hints = [
+            f"{sib} exists"
+            for sib in (f"{path}.meta", f"{path}.wal")
+            if fs.exists(sib)
+        ]
+        hint = (
+            f" (last-good recovery artifacts: {', '.join(hints)}; run "
+            f"`hyperopt-tpu-fsck --driver {path}` to audit)"
+            if hints
+            else " (no sidecar recovery artifacts found; the study must "
+            "be restarted from scratch)"
+        )
+        raise CheckpointError(
+            f"{what} {path!r} is truncated or corrupt "
+            f"({type(e).__name__}: {e}){hint}"
+        ) from e
+
+
+# ---------------------------------------------------------------------------
+# rstate serialization (JSON-able, for WAL records and bundle metadata)
+# ---------------------------------------------------------------------------
+
+
+def _jsonify_state(v):
+    if isinstance(v, np.ndarray):
+        return {"__ndarray__": [v.dtype.str, v.tolist()]}
+    if isinstance(v, dict):
+        return {k: _jsonify_state(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonify_state(x) for x in v]
+    if isinstance(v, np.generic):
+        return v.item()
+    return v
+
+
+def _dejsonify_state(v):
+    if isinstance(v, dict):
+        if set(v) == {"__ndarray__"}:
+            dtype, data = v["__ndarray__"]
+            return np.asarray(data, dtype=np.dtype(dtype))
+        return {k: _dejsonify_state(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_dejsonify_state(x) for x in v]
+    return v
+
+
+def encode_rstate(rstate):
+    """The bit-generator cursor of an ``np.random.Generator`` (or
+    legacy ``RandomState``) as a JSON-able dict -- the per-record
+    rstate cursor of the write-ahead log.  Restoring it and re-drawing
+    reproduces the exact seed stream, which is what makes a resumed
+    suggestion stream bitwise-identical to the uninterrupted run."""
+    if hasattr(rstate, "bit_generator"):
+        return {
+            "kind": "generator",
+            "state": _jsonify_state(rstate.bit_generator.state),
+        }
+    state = rstate.get_state()
+    return {"kind": "randomstate", "state": _jsonify_state(list(state))}
+
+
+def decode_rstate(encoded):
+    """Inverse of :func:`encode_rstate`: a fresh generator positioned
+    at the recorded cursor."""
+    state = _dejsonify_state(encoded["state"])
+    if encoded["kind"] == "generator":
+        bitgen_cls = getattr(np.random, state["bit_generator"])
+        rstate = np.random.Generator(bitgen_cls())
+        rstate.bit_generator.state = state
+        return rstate
+    rstate = np.random.RandomState()
+    state = list(state)
+    state[1] = np.asarray(state[1], dtype=np.uint32)
+    rstate.set_state(tuple(state))
+    return rstate
+
+
+# ---------------------------------------------------------------------------
+# DriverRecovery: the sequential driver's crash-recovery coordinator
+# ---------------------------------------------------------------------------
+
+
+class RestoredDriverState:
+    """What :meth:`DriverRecovery.load` hands back to ``fmin``."""
+
+    def __init__(self, trials, rstate, ask_ahead_seed, n_replayed_tells,
+                 n_replayed_asks):
+        self.trials = trials
+        self.rstate = rstate
+        self.ask_ahead_seed = ask_ahead_seed
+        self.n_replayed_tells = n_replayed_tells
+        self.n_replayed_asks = n_replayed_asks
+
+
+class DriverRecovery:
+    """Write-ahead log + durable checkpoint bundles for ``fmin``'s
+    sequential driver (the FAILURES.md driver recovery matrix).
+
+    Artifacts, all rooted at ``path``:
+
+    * ``path``       -- the pickled Trials store (durable tmp+fsync+
+      rename; stays loadable by plain ``pickle.load`` for backward
+      compatibility with the bare ``trials_save_file`` contract).
+    * ``path.meta``  -- the bundle metadata: guard fingerprint, numpy
+      bit-generator state, ask-ahead seam seed, WAL watermark, and the
+      resident ObsBuffer npz blobs (``obs_buffer_npz_bytes``).
+    * ``path.wal``   -- the :class:`~hyperopt_tpu.utils.wal.TellWAL`:
+      one ``ask`` record per algo call (docs + rstate cursor), one
+      ``tell`` record per applied result, each durable BEFORE the
+      corresponding in-memory mutation.
+
+    Exactly-once semantics: a tell present in the WAL is never
+    re-evaluated (replay marks its doc DONE before the driver runs) and
+    never double-applied (replay skips docs already terminal); an ask
+    that never reached the WAL is re-issued from the restored rstate
+    cursor and draws the identical seed.
+
+    ``fs`` is the PR-3 fault-injection seam; the chaos suite arms the
+    :data:`~hyperopt_tpu.distributed.faults.DRIVER_CRASH_POINTS` on it.
+    ``cadence`` is how many tells ride on the WAL between full bundle
+    publishes (replay length is bounded by it).
+    """
+
+    META_FORMAT = 1
+
+    def __init__(self, path, fs=None, cadence=25, guard=None):
+        from ..distributed.faults import REAL_FS
+        from .wal import TellWAL
+
+        self.path = str(path)
+        self.meta_path = self.path + ".meta"
+        self.fs = REAL_FS if fs is None else fs
+        self.cadence = max(1, int(cadence))
+        self.guard = None if guard is None else list(guard)
+        self.wal = TellWAL(self.path + ".wal", fs=self.fs, guard=self.guard)
+        self._tells_since_ckpt = 0
+        #: accumulated wall-clock spent on durability (WAL appends +
+        #: bundle publishes) -- bench.py's ``resume_overhead_per_trial``
+        self.seconds_spent = 0.0
+
+    def set_guard(self, guard):
+        """Attach the study fingerprint (space/algo/objective identity;
+        ``fmin`` builds it) -- checked against every artifact on load
+        and stamped into everything written."""
+        self.guard = None if guard is None else list(guard)
+        self.wal.guard = self.guard
+
+    def exists(self):
+        from ..distributed import _common
+
+        return _common.with_retries(
+            lambda: self.fs.exists(self.path), label="ckpt exists"
+        )
+
+    # -- write-ahead logging ----------------------------------------------
+    def log_ask(self, docs, rstate):
+        """Durably record an algo call's new trial docs plus the rstate
+        cursor AFTER its seed draw, before the docs are inserted."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self.fs.crashpoint("before_wal_append")
+        # flush-only (no fsync barrier): a lost ask re-derives bitwise
+        # from the restored cursor; the tell's fsync covers it -- one
+        # disk barrier per trial, not two
+        self.wal.append("ask", {
+            "docs": docs,
+            "rstate": encode_rstate(rstate),
+        }, sync=False)
+        self.fs.crashpoint("after_wal_append_before_tell")
+        self.seconds_spent += _time.perf_counter() - t0
+
+    def log_tell(self, tid, state, result=None, error=None, tb=None):
+        """Durably record one completed (or errored) evaluation before
+        its result is applied to the Trials store."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        self.fs.crashpoint("before_wal_append")
+        rec = {"tid": int(tid), "state": int(state)}
+        if result is not None:
+            rec["result"] = result
+        if error is not None:
+            rec["error"] = error
+        if tb is not None:
+            rec["traceback"] = tb
+        self.wal.append("tell", rec)
+        self.fs.crashpoint("after_wal_append_before_tell")
+        self.seconds_spent += _time.perf_counter() - t0
+        self._tells_since_ckpt += 1
+
+    # -- checkpoint bundles ------------------------------------------------
+    def maybe_checkpoint(self, trials, rstate, ask_ahead_seed=None,
+                         force=False):
+        """Publish a bundle when the cadence (or ``force``) says so."""
+        if not force and self._tells_since_ckpt < self.cadence:
+            return False
+        self.checkpoint(trials, rstate, ask_ahead_seed=ask_ahead_seed)
+        return True
+
+    def checkpoint(self, trials, rstate, ask_ahead_seed=None):
+        """Atomically publish the full driver state: trials pickle,
+        then the metadata bundle, then compact the WAL.  Every crash
+        window in between is covered: a stale artifact is always
+        superseded by the WAL records that outlived it, and replay
+        deduplicates by tid."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        obs_npz = []
+        for buf in getattr(trials, "_buffers", {}).values():
+            try:
+                obs_npz.append(obs_buffer_npz_bytes(buf))
+            except Exception:  # graftlint: disable=GL302 the blob is an optimization; resume falls back to a doc-list rescan
+                logger.exception("obs-buffer snapshot failed; resume "
+                                 "will rebuild from the doc list")
+        meta = {
+            "format": self.META_FORMAT,
+            "guard": self.guard,
+            "n_trials": len(trials._dynamic_trials),
+            "wal_seq": self.wal.next_seq,
+            "total_tells": self.wal.total_tells,
+            "rstate": encode_rstate(rstate),
+            "ask_ahead_seed": (
+                None if ask_ahead_seed is None else int(ask_ahead_seed)
+            ),
+            "obs_npz": obs_npz,
+        }
+        from ..distributed import _common
+
+        # each publish retries whole on a transient fault (the tmp file
+        # is rewritten from scratch, so retry is idempotent); a crash
+        # point firing inside is a BaseException and propagates
+        _common.with_retries(
+            lambda: durable_pickle(
+                trials, self.path, fs=self.fs,
+                crash_between="after_ckpt_tmp_before_rename",
+            ),
+            label="trials publish",
+        )
+        _common.with_retries(
+            lambda: durable_pickle(
+                meta, self.meta_path, fs=self.fs,
+                crash_between="after_ckpt_tmp_before_rename",
+            ),
+            label="bundle publish",
+        )
+        self.fs.crashpoint("after_ckpt_publish_before_wal_reset")
+        _common.with_retries(self.wal.reset, label="wal reset")
+        self._tells_since_ckpt = 0
+        self.seconds_spent += _time.perf_counter() - t0
+        return self.path
+
+    # -- restore -----------------------------------------------------------
+    def load(self):
+        """Load + WAL-replay the durable driver state, or None when no
+        trials artifact exists yet.  Refuses (CheckpointError) guard
+        mismatches and mid-file WAL corruption; merely-torn WAL tails
+        are truncated and survive."""
+        from ..exceptions import CheckpointError
+
+        if not self.exists():
+            return None
+        trials = load_pickle_guarded(
+            self.path, fs=self.fs, what="trials checkpoint"
+        )
+        meta = None
+        if self.fs.exists(self.meta_path):
+            meta = load_pickle_guarded(
+                self.meta_path, fs=self.fs, what="checkpoint bundle"
+            )
+            if (
+                self.guard is not None
+                and meta.get("guard") is not None
+                and list(meta["guard"]) != list(self.guard)
+            ):
+                raise CheckpointError(
+                    f"checkpoint bundle {self.meta_path!r} was written "
+                    f"by a different study (guard {meta['guard']!r} != "
+                    f"{self.guard!r}); refusing to resume"
+                )
+        records = self.wal.replay() if self.wal.exists() else []
+        watermark = meta["wal_seq"] if meta else 0
+        suffix = [r for r in records if int(r["seq"]) >= watermark]
+        n_asks, n_tells, last_cursor = self._apply_records(trials, suffix)
+        if last_cursor is not None:
+            rstate, seed = decode_rstate(last_cursor), None
+        elif meta is not None:
+            rstate = decode_rstate(meta["rstate"])
+            seed = meta.get("ask_ahead_seed")
+        else:
+            rstate, seed = None, None
+            logger.warning(
+                "resuming %r without recovery metadata (legacy "
+                "checkpoint): trials are restored but the suggestion "
+                "stream will not match the uninterrupted run",
+                self.path,
+            )
+        if meta is not None and meta.get("obs_npz"):
+            # stashed for JaxTrials.obs_buffer: the resident mirror
+            # re-materializes from these instead of re-scanning docs
+            trials._stashed_obs_npz = list(meta["obs_npz"])
+        return RestoredDriverState(trials, rstate, seed, n_tells, n_asks)
+
+    @staticmethod
+    def _apply_records(trials, records):
+        """Replay a WAL suffix into ``trials`` exactly once: asks
+        insert docs not yet present (in record order -- tid order), and
+        tells finalize docs that are not already terminal."""
+        from ..base import (
+            JOB_STATE_DONE,
+            JOB_STATE_ERROR,
+            validate_trial,
+        )
+
+        by_tid = {t["tid"]: t for t in trials._dynamic_trials}
+        n_asks = n_tells = 0
+        last_cursor = None
+        for rec in records:
+            if rec.get("kind") == "ask":
+                last_cursor = rec["rstate"]
+                fresh = [
+                    validate_trial(d)
+                    for d in rec["docs"]
+                    if d["tid"] not in by_tid
+                ]
+                if fresh:
+                    trials._insert_trial_docs(fresh)
+                    for doc in fresh:
+                        by_tid[doc["tid"]] = doc
+                    n_asks += 1
+            elif rec.get("kind") == "tell":
+                doc = by_tid.get(rec["tid"])
+                if doc is not None and doc["state"] not in (
+                    JOB_STATE_DONE, JOB_STATE_ERROR,
+                ):
+                    doc["state"] = rec["state"]
+                    if "result" in rec:
+                        doc["result"] = rec["result"]
+                    if "error" in rec:
+                        doc["misc"]["error"] = rec["error"]
+                    if "traceback" in rec:
+                        doc["misc"]["traceback"] = rec["traceback"]
+                    n_tells += 1
+        trials.refresh()
+        return n_asks, n_tells, last_cursor
 
 
 def load_guarded(path, guard):

@@ -1,0 +1,18 @@
+"""GL305 true positives: checkpoint-style state dumps with no fsync in
+scope -- a crash mid-dump publishes a truncated file under the real
+name (the fmin.py:285 latent bug class).  Two sites: an in-place
+pickle checkpoint and an in-place npz snapshot."""
+
+import pickle
+
+import numpy as np
+
+
+def save_trials_in_place(trials, path):
+    with open(path, "wb") as f:
+        pickle.dump(trials, f)  # no tmp, no fsync, no rename
+
+
+def snapshot_arrays(values, losses, path):
+    with open(path, "wb") as f:
+        np.savez_compressed(f, values=values, losses=losses)

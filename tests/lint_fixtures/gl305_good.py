@@ -1,0 +1,29 @@
+"""GL305 near-misses: the legal idioms closest to the bad fixture --
+the durable tmp+fsync+rename shape, an in-memory BytesIO dump (nothing
+on disk to make durable), and bytes-level serialization without a file
+target at all."""
+
+import io
+import os
+import pickle
+
+import numpy as np
+
+
+def save_trials_durably(trials, path):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        pickle.dump(trials, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def snapshot_arrays_to_bytes(values, losses):
+    bio = io.BytesIO()
+    np.savez_compressed(bio, values=values, losses=losses)
+    return bio.getvalue()
+
+
+def serialize_doc(doc):
+    return pickle.dumps(doc)

@@ -74,3 +74,8 @@ def test_bench_prints_one_json_line():
     # unbaselined findings, and the grandfathered baseline stays small
     assert d["lint_findings_total"] == 0
     assert 0 <= d["lint_baseline_size"] <= 6
+    # round-10: crash-recovery cost rows -- the per-trial durability
+    # overhead is measured (WAL append + amortized bundle publish) and
+    # stamped both raw and relative to the fused dispatch time
+    assert d["resume_overhead_per_trial"] >= 0
+    assert d["resume_overhead_frac_of_fused"] >= 0

@@ -18,7 +18,7 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 PACK_RULES = [
     "GL101", "GL102", "GL103", "GL104",
     "GL201", "GL202", "GL203",
-    "GL301", "GL302", "GL303", "GL304",
+    "GL301", "GL302", "GL303", "GL304", "GL305",
 ]
 
 
@@ -62,6 +62,7 @@ def test_known_finding_counts():
     assert len(_lint(_fixture_path("GL101", "bad"))) == 3
     assert len(_lint(_fixture_path("GL202", "bad"))) == 2
     assert len(_lint(_fixture_path("GL304", "bad"))) == 2
+    assert len(_lint(_fixture_path("GL305", "bad"))) == 2
 
 
 def test_findings_carry_location_and_hash():

@@ -1,0 +1,620 @@
+"""Driver-level chaos: kill ``fmin`` at every armed crash point of the
+crash-recovery protocol, resume, and assert the suggestion stream is
+BITWISE identical to the uninterrupted same-seed run -- with zero lost
+and zero duplicated tells (WAL tell counter == trials count, tids
+contiguous).
+
+This is the PR-3 fault-injection discipline extended upward into the
+sequential driver (ISSUE 6): the armed points live in
+``DRIVER_CRASH_POINTS`` (faults.py), fire inside the write-ahead log
+append, the checkpoint publish, the tell-apply, and the ask-ahead
+handoff, and every scenario here is deterministic -- fixed seeds,
+burst-bounded transient injection, no real sleeps.
+"""
+
+import os
+import pickle
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp, rand, tpe_jax
+from hyperopt_tpu.jax_trials import JaxTrials
+from hyperopt_tpu.base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    STATUS_FAIL,
+    STATUS_OK,
+)
+from hyperopt_tpu.distributed import fsck
+from hyperopt_tpu.distributed.faults import (
+    DRIVER_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import CheckpointError
+from hyperopt_tpu.fmin import partial
+from hyperopt_tpu.utils.checkpoint import DriverRecovery, load_trials
+
+pytestmark = pytest.mark.chaos
+
+SPACE = {"x": hp.uniform("x", -5, 5), "lr": hp.loguniform("lr", -4, 0)}
+
+
+def quad(cfg):
+    return (cfg["x"] - 1) ** 2 + abs(np.log(cfg["lr"]) + 2) / 3
+
+
+def stream_of(trials):
+    return [t["misc"]["vals"] for t in trials.trials]
+
+
+def run_clean(algo, n, seed=0, trials=None, obj=quad):
+    trials = Trials() if trials is None else trials
+    fmin(
+        obj, SPACE, algo=algo, max_evals=n, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        return_argmin=False,
+    )
+    return stream_of(trials)
+
+
+def crash_then_resume(tmp_path, algo, n, point, at, seed=0, cadence=5,
+                      tag="", trials_factory=Trials):
+    """Kill fmin at the ``at``-th firing of ``point``, then resume with
+    a clean fs (the restarted driver) and the ORIGINAL submit seed (the
+    bundle-restored rstate supersedes it whenever anything durable
+    survived the crash)."""
+    path = str(tmp_path / f"ck-{tag}-{point}-{at}.pkl")
+    plan = FaultPlan(seed=11).arm(point, at=at)
+    rec = DriverRecovery(path, fs=plan.fs(), cadence=cadence)
+    with pytest.raises(SimulatedCrash):
+        fmin(
+            quad, SPACE, algo=algo, max_evals=n,
+            trials=trials_factory(), resume_from=rec,
+            rstate=np.random.default_rng(seed), show_progressbar=False,
+            return_argmin=False,
+        )
+    assert plan.stats[f"crash:{point}"] == 1, "armed point never fired"
+    rec2 = DriverRecovery(path, cadence=cadence)
+    fmin(
+        quad, SPACE, algo=algo, max_evals=n,
+        trials=trials_factory(), resume_from=rec2,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        return_argmin=False,
+    )
+    final = load_trials(path)
+    return final, rec2
+
+
+def assert_exactly_once(final, rec, n):
+    """Zero lost, zero duplicated: n contiguous tids, all DONE, and the
+    WAL's monotone tell counter agrees with the trials count."""
+    tids = [t["tid"] for t in final.trials]
+    assert tids == list(range(n)), "lost or duplicated trial ids"
+    assert all(t["state"] == JOB_STATE_DONE for t in final.trials)
+    assert rec.wal.total_tells == n, (
+        f"WAL logged {rec.wal.total_tells} tells for {n} trials"
+    )
+
+
+# ---------------------------------------------------------------------------
+# THE fast-tier acceptance twin: every driver crash point, two depths,
+# resumed stream bitwise equal to the uninterrupted run
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", DRIVER_CRASH_POINTS)
+def test_resume_parity_every_crash_point(tmp_path, point):
+    n = 40
+    ref = run_clean(rand.suggest, n)
+    for at in (1, 4):
+        final, rec = crash_then_resume(
+            tmp_path, rand.suggest, n, point, at, tag=f"a{at}",
+        )
+        assert stream_of(final) == ref, (
+            f"stream diverged after crash at {point} (hit {at})"
+        )
+        assert_exactly_once(final, rec, n)
+
+
+def test_resume_parity_crash_points_deterministic(tmp_path):
+    """Same-seed replay of a kill-and-resume scenario produces the
+    identical final stream twice (the chaos-suite determinism bar)."""
+    n = 30
+    streams = []
+    for rep in ("r1", "r2"):
+        final, _rec = crash_then_resume(
+            tmp_path, rand.suggest, n, "after_wal_append_before_tell",
+            at=9, tag=rep,
+        )
+        streams.append(stream_of(final))
+    assert streams[0] == streams[1]
+
+
+def test_resume_parity_fused_resident_tpe(tmp_path):
+    """The fused one-dispatch driver (tpe_jax fused=True over a
+    device-resident JaxTrials) killed mid-run past a checkpoint
+    boundary resumes bitwise -- the resident HistoryState mirror is
+    rebuilt from the bundle's obs npz + WAL suffix, and the ask-ahead
+    seam position survives."""
+    n = 36
+    kw = dict(n_EI_candidates=16)
+    algo = partial(tpe_jax.suggest, fused=True, **kw)
+    ref = run_clean(algo, n, trials=JaxTrials(resident=True))
+    final, rec = crash_then_resume(
+        tmp_path, algo, n, "after_wal_append_before_tell", at=29,
+        cadence=10, tag="fused",
+        trials_factory=lambda: JaxTrials(resident=True),
+    )
+    assert stream_of(final) == ref
+    assert_exactly_once(final, rec, n)
+
+
+@pytest.mark.slow
+def test_resume_parity_200_fused_every_point_twice(tmp_path):
+    """THE acceptance run (ISSUE 6): 200 fused tpe trials; for every
+    driver crash point, kill-and-resume reproduces the uninterrupted
+    same-seed 200-trial suggestion stream bitwise, zero lost / zero
+    duplicate tells -- and the whole sweep repeats identically under
+    the same seed."""
+    n = 200
+    kw = dict(n_EI_candidates=16)
+    algo = partial(tpe_jax.suggest, fused=True, **kw)
+    ref = run_clean(algo, n, trials=JaxTrials(resident=True))
+    assert len(ref) == n
+    # kill depth per point: WAL/tell points fire once or twice per
+    # trial (deep hit counts reach mid-run); checkpoint-publish points
+    # fire only at the 25-tell cadence
+    depth = {
+        "before_wal_append": 150,
+        "after_wal_append_before_tell": 150,
+        "after_tell_before_ask_ahead": 150,
+        "after_ckpt_tmp_before_rename": 9,
+        "after_ckpt_publish_before_wal_reset": 5,
+    }
+    for rep in ("r1", "r2"):
+        for point in DRIVER_CRASH_POINTS:
+            final, rec = crash_then_resume(
+                tmp_path, algo, n, point, at=depth[point], cadence=25,
+                tag=f"acc-{rep}",
+                trials_factory=lambda: JaxTrials(resident=True),
+            )
+            assert stream_of(final) == ref, (
+                f"{rep}: stream diverged after crash at {point}"
+            )
+            assert_exactly_once(final, rec, n)
+
+
+def test_driver_survives_transient_fault_storm(tmp_path):
+    """No crash points -- a 15% transient errno rate plus 5% torn
+    writes on every recovery fs primitive: the retry scaffold absorbs
+    it all, the run completes, and the stream still matches the
+    fault-free run (twice, same seed)."""
+    n = 40
+    ref = run_clean(rand.suggest, n)
+    for tag in ("s1", "s2"):
+        path = str(tmp_path / f"storm-{tag}.pkl")
+        plan = FaultPlan(seed=5, rate=0.15, partial_rate=0.05, burst=2)
+        rec = DriverRecovery(path, fs=plan.fs(), cadence=5)
+        trials = Trials()
+        fmin(
+            quad, SPACE, algo=rand.suggest, max_evals=n, trials=trials,
+            resume_from=rec, rstate=np.random.default_rng(0),
+            show_progressbar=False, return_argmin=False,
+        )
+        assert stream_of(trials) == ref
+        assert_exactly_once(load_trials(path), rec, n)
+        assert sum(
+            v for k, v in plan.stats.items() if k.startswith("error:")
+        ) > 0, "the storm never actually injected anything"
+
+
+# ---------------------------------------------------------------------------
+# restore semantics
+# ---------------------------------------------------------------------------
+
+
+def test_restored_rstate_supersedes_passed_rstate(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=10,
+        trials_save_file=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    # resume under a DIFFERENT rstate: the bundle's bit-generator wins
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=25,
+        trials_save_file=path, rstate=np.random.default_rng(999),
+        show_progressbar=False, return_argmin=False,
+    )
+    ref = run_clean(rand.suggest, 25, seed=0)
+    assert stream_of(load_trials(path)) == ref
+
+
+def test_resume_from_missing_checkpoint_refused(tmp_path):
+    with pytest.raises(CheckpointError, match="does not exist"):
+        fmin(
+            quad, SPACE, algo=rand.suggest, max_evals=5,
+            resume_from=str(tmp_path / "nope.pkl"),
+            rstate=np.random.default_rng(0), show_progressbar=False,
+        )
+
+
+def test_corrupt_checkpoint_raises_clear_error(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=5,
+        trials_save_file=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    with open(path, "wb") as f:
+        f.write(b"\x80\x05garbage-truncated")  # torn pickle
+    with pytest.raises(CheckpointError) as exc:
+        fmin(
+            quad, SPACE, algo=rand.suggest, max_evals=10,
+            trials_save_file=path, rstate=np.random.default_rng(0),
+            show_progressbar=False, return_argmin=False,
+        )
+    msg = str(exc.value)
+    assert path in msg and "fsck" in msg  # names the file + the remedy
+    assert f"{path}.meta" in msg  # points at the surviving artifacts
+
+
+def test_guard_mismatch_refused(tmp_path):
+    path = str(tmp_path / "ck.pkl")
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=5,
+        trials_save_file=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+
+    def other_objective(cfg):
+        return cfg["x"] ** 2
+
+    with pytest.raises(CheckpointError, match="different study"):
+        fmin(
+            other_objective, SPACE, algo=rand.suggest, max_evals=10,
+            resume_from=path, rstate=np.random.default_rng(0),
+            show_progressbar=False, return_argmin=False,
+        )
+
+
+def test_legacy_plain_pickle_still_resumes(tmp_path, caplog):
+    """A pre-recovery checkpoint (bare Trials pickle, no meta/WAL)
+    loads and continues -- with a warning that the stream cannot match
+    the uninterrupted run (the exact silent divergence this PR fixes)."""
+    path = str(tmp_path / "legacy.pkl")
+    trials = Trials()
+    run_clean(rand.suggest, 10, trials=trials)
+    with open(path, "wb") as f:
+        pickle.dump(trials, f)
+    with caplog.at_level("WARNING", logger="hyperopt_tpu.utils.checkpoint"):
+        fmin(
+            quad, SPACE, algo=rand.suggest, max_evals=20,
+            trials_save_file=path, rstate=np.random.default_rng(1),
+            show_progressbar=False, return_argmin=False,
+        )
+    assert len(load_trials(path)) == 20
+    assert any(
+        "without recovery metadata" in r.message for r in caplog.records
+    )
+
+
+def test_bundle_obs_npz_restores_resident_buffer(tmp_path):
+    """The checkpoint bundle carries the dense obs arrays: a resumed
+    JaxTrials serves its buffer from the bundle blob (cursor already at
+    the bundle's doc count) instead of re-scanning every doc."""
+    from hyperopt_tpu.jax_trials import packed_space_for
+    from hyperopt_tpu.base import Domain
+
+    path = str(tmp_path / "ck.pkl")
+    algo = partial(tpe_jax.suggest, resident=True, n_EI_candidates=16)
+    fmin(
+        quad, SPACE, algo=algo, max_evals=25,
+        trials=JaxTrials(resident=True), trials_save_file=path,
+        rstate=np.random.default_rng(3), show_progressbar=False,
+        return_argmin=False,
+    )
+    rec = DriverRecovery(path)
+    restored = rec.load()
+    trials = restored.trials
+    blobs = getattr(trials, "_stashed_obs_npz", [])
+    assert blobs, "bundle carried no obs npz"
+    space = packed_space_for(Domain(quad, SPACE))
+    buf = trials.obs_buffer(space)
+    assert not getattr(trials, "_stashed_obs_npz", []), "stash unconsumed"
+    assert buf.count == 25
+    # bitwise: the restored arrays equal a from-scratch doc-list rebuild
+    fresh = JaxTrials(resident=True)
+    fresh.insert_trial_docs([dict(t) for t in trials.trials])
+    fresh.refresh()
+    ref = fresh.obs_buffer(space)
+    for a, b in zip(buf.arrays(), ref.arrays()):
+        np.testing.assert_array_equal(a[..., :buf.count],
+                                      b[..., :ref.count])
+
+
+# ---------------------------------------------------------------------------
+# satellite: non-finite losses are quarantined, not telled as "ok"
+# ---------------------------------------------------------------------------
+
+
+def _nonfinite_objective():
+    calls = {"n": 0}
+
+    def obj(cfg):
+        calls["n"] += 1
+        if calls["n"] % 7 == 3:
+            return float("inf")
+        if calls["n"] % 7 == 5:
+            return float("nan")
+        return quad(cfg)
+
+    return obj
+
+
+@pytest.mark.parametrize("resident", [False, True])
+def test_nonfinite_quarantined_on_both_paths(resident):
+    """Inf/NaN objective results record as STATUS_FAIL trials and never
+    enter the Parzen split -- on the re-upload AND the device-resident
+    path -- instead of poisoning best_trial and every later ask."""
+    n = 30
+    algo = partial(
+        tpe_jax.suggest,
+        n_EI_candidates=16,
+        **({"resident": True} if resident else {}),
+    )
+    trials = JaxTrials(resident=resident)
+    fmin(
+        _nonfinite_objective(), SPACE, algo=algo, max_evals=n,
+        trials=trials, rstate=np.random.default_rng(2),
+        show_progressbar=False, return_argmin=False,
+    )
+    statuses = [t["result"]["status"] for t in trials.trials]
+    n_fail = statuses.count(STATUS_FAIL)
+    assert n_fail == len([i for i in range(1, n + 1) if i % 7 in (3, 5)])
+    assert all(
+        t["result"]["loss"] is None
+        for t in trials.trials if t["result"]["status"] == STATUS_FAIL
+    )
+    assert np.isfinite(trials.best_trial["result"]["loss"])
+    # the dense posterior saw only the finite completions
+    buf = next(iter(trials._buffers.values()))
+    buf.sync(trials)  # ingest the final tell (no ask followed it)
+    assert buf.count == n - n_fail
+    assert np.all(np.isfinite(buf.losses[: buf.count]))
+
+
+def test_nonfinite_streams_identical_resident_vs_reupload():
+    n = 25
+    streams = {}
+    for resident in (False, True):
+        trials = Trials()
+        fmin(
+            _nonfinite_objective(), SPACE,
+            algo=partial(
+                tpe_jax.suggest, n_EI_candidates=16,
+                resident=True if resident else None,
+            ),
+            max_evals=n, trials=trials,
+            rstate=np.random.default_rng(4), show_progressbar=False,
+            return_argmin=False,
+        )
+        streams[resident] = stream_of(trials)
+    assert streams[False] == streams[True]
+
+
+def test_nonfinite_dict_result_also_quarantined():
+    def obj(cfg):
+        return {"status": STATUS_OK, "loss": float("inf")}
+
+    trials = Trials()
+    fmin(
+        obj, SPACE, algo=rand.suggest, max_evals=3, trials=trials,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        return_argmin=False,
+    )
+    assert all(
+        t["result"]["status"] == STATUS_FAIL
+        and t["result"]["loss"] is None
+        for t in trials.trials
+    )
+
+
+# ---------------------------------------------------------------------------
+# satellite: per-trial exception / timeout containment
+# ---------------------------------------------------------------------------
+
+
+def test_catch_records_failed_trial_with_traceback_and_continues():
+    calls = {"n": 0}
+
+    def flaky(cfg):
+        calls["n"] += 1
+        if calls["n"] % 4 == 2:
+            raise ValueError("synthetic objective bug")
+        return quad(cfg)
+
+    trials = Trials()
+    fmin(
+        flaky, SPACE, algo=rand.suggest, max_evals=12, trials=trials,
+        catch=(ValueError,), rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert len(trials) == 12  # the driver continued past every failure
+    failed = [
+        t for t in trials.trials if t["result"]["status"] == STATUS_FAIL
+    ]
+    assert len(failed) == 3
+    assert all("synthetic objective bug" in t["result"]["failure"]
+               for t in failed)
+    assert all("ValueError" in t["result"]["traceback"] for t in failed)
+    # an uncaught class still aborts: catch is a whitelist, not a net
+    with pytest.raises(KeyError):
+        fmin(
+            lambda cfg: {}["missing"], SPACE, algo=rand.suggest,
+            max_evals=3, catch=(ValueError,),
+            rstate=np.random.default_rng(0), show_progressbar=False,
+        )
+
+
+def test_trial_timeout_records_fail_and_continues():
+    calls = {"n": 0}
+
+    def slow_sometimes(cfg):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            time.sleep(0.4)  # well past the deadline
+        return quad(cfg)
+
+    trials = Trials()
+    fmin(
+        slow_sometimes, SPACE, algo=rand.suggest, max_evals=5,
+        trials=trials, trial_timeout=0.05,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        return_argmin=False,
+    )
+    assert len(trials) == 5
+    failed = [
+        t for t in trials.trials if t["result"]["status"] == STATUS_FAIL
+    ]
+    assert len(failed) == 1
+    assert "trial_timeout" in failed[0]["result"]["failure"]
+
+
+def test_wal_logged_failure_not_rerun_on_resume(tmp_path):
+    """An objective crash (no catch=) aborts fmin AFTER the failure is
+    WAL-durable: the resumed run skips the known-bad trial (exactly N
+    objective calls across both runs) and its stream matches the
+    uninterrupted catch_eval_exceptions run."""
+    n = 14
+    crash_at = 8
+
+    def make_obj(calls):
+        def obj(cfg):
+            calls["n"] += 1
+            if calls["n"] == crash_at:
+                raise RuntimeError("boom")
+            return quad(cfg)
+
+        return obj
+
+    # uninterrupted reference: same failure, driver carries on
+    ref_calls = {"n": 0}
+    ref_trials = Trials()
+    fmin(
+        make_obj(ref_calls), SPACE, algo=rand.suggest, max_evals=n,
+        trials=ref_trials, catch_eval_exceptions=True,
+        rstate=np.random.default_rng(0), show_progressbar=False,
+        return_argmin=False,
+    )
+    # crashing run + resume
+    path = str(tmp_path / "ck.pkl")
+    calls = {"n": 0}
+    obj = make_obj(calls)
+    with pytest.raises(RuntimeError, match="boom"):
+        fmin(
+            obj, SPACE, algo=rand.suggest, max_evals=n,
+            trials_save_file=path, rstate=np.random.default_rng(0),
+            show_progressbar=False, return_argmin=False,
+        )
+    assert calls["n"] == crash_at
+    fmin(
+        obj, SPACE, algo=rand.suggest, max_evals=n,
+        trials_save_file=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert calls["n"] == n  # the errored trial was NOT re-evaluated
+    final = load_trials(path)
+    assert stream_of(final) == stream_of(ref_trials)
+    errored = [
+        t for t in final.trials if t["state"] == JOB_STATE_ERROR
+    ]
+    assert len(errored) == 1
+    assert "boom" in errored[0]["misc"]["error"][1]
+    assert "RuntimeError" in errored[0]["misc"]["traceback"]
+
+
+# ---------------------------------------------------------------------------
+# satellite: fsck --driver audits + repairs the new corruption classes
+# ---------------------------------------------------------------------------
+
+
+def _driver_family(tmp_path, n=8):
+    path = str(tmp_path / "study.pkl")
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=n,
+        trials_save_file=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    return path
+
+
+def test_fsck_driver_detects_and_repairs(tmp_path, capsys):
+    path = _driver_family(tmp_path)
+    # torn WAL tail (crash mid-append)
+    with open(path + ".wal", "a") as f:
+        f.write('deadbeef {"seq": 999, "kind": "tell"')
+    # foreign bundle parked under this family's name
+    with open(path + ".meta", "wb") as f:
+        pickle.dump({"format": 1, "guard": ["foreign-study"],
+                     "wal_seq": 0, "rstate": None, "obs_npz": []}, f)
+    # orphaned snapshot tmp residue
+    old = time.time() - 3600
+    tmp = f"{path}.tmp.4242"
+    with open(tmp, "w") as f:
+        f.write("partial")
+    os.utime(tmp, (old, old))
+
+    issues = fsck.audit_driver(path, tmp_grace=60.0)
+    assert {i.kind for i in issues} == {
+        "wal_torn_tail", "ckpt_fingerprint_mismatch",
+        "orphaned_snapshot_tmp",
+    }
+    assert fsck.main(["--driver", path]) == 1  # audit-only: issues found
+    capsys.readouterr()
+    assert fsck.main(["--driver", path, "--repair", "--tmp-grace", "60"]) == 0
+    capsys.readouterr()
+    assert fsck.audit_driver(path, tmp_grace=60.0) == []
+    assert not os.path.exists(tmp)
+    assert not os.path.exists(path + ".meta")  # quarantined, not deleted
+    assert any(".quarantined." in f for f in os.listdir(tmp_path))
+    # the repaired family resumes (degraded: no bundle, valid WAL prefix)
+    fmin(
+        quad, SPACE, algo=rand.suggest, max_evals=12,
+        resume_from=path, rstate=np.random.default_rng(0),
+        show_progressbar=False, return_argmin=False,
+    )
+    assert len(load_trials(path)) == 12
+
+
+def test_fsck_driver_midfile_corruption_quarantines_wal(tmp_path):
+    from hyperopt_tpu.utils.wal import TellWAL
+
+    path = _driver_family(tmp_path)
+    # repopulate the (checkpoint-compacted) WAL, then corrupt a MIDDLE
+    # record -- residue no crash of the protocol itself can produce
+    wal_path = path + ".wal"
+    wal = TellWAL(wal_path)
+    for tid in (100, 101, 102):
+        wal.append("tell", {"tid": tid, "state": 2})
+    wal.close()
+    lines = open(wal_path).read().splitlines(keepends=True)
+    assert len(lines) >= 3
+    lines[1] = "00000000 " + lines[1].split(" ", 1)[1]
+    lines.append("torn-tail-too")
+    with open(wal_path, "w") as f:
+        f.write("".join(lines))
+    issues = fsck.audit_driver(path)
+    assert {i.kind for i in issues} == {"wal_corrupt"}
+    assert fsck.repair_driver(path, issues) == 1
+    assert not os.path.exists(wal_path)  # quarantined aside
+    assert fsck.audit_driver(path) == []
+
+
+def test_fsck_driver_clean_family_is_clean(tmp_path, capsys):
+    path = _driver_family(tmp_path)
+    assert fsck.audit_driver(path) == []
+    assert fsck.main(["--driver", path]) == 0

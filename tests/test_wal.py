@@ -1,0 +1,145 @@
+"""Unit pins for the write-ahead tell log (utils/wal.py): record
+round-trip, checksum enforcement, the torn-tail truncation rule,
+monotone counters across compaction, and guard refusal -- the
+primitives the resume-parity suite (test_resume_parity.py) composes."""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.distributed.faults import FaultPlan
+from hyperopt_tpu.exceptions import CheckpointError
+from hyperopt_tpu.utils.checkpoint import decode_rstate, encode_rstate
+from hyperopt_tpu.utils.wal import TellWAL
+
+
+def test_append_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path, guard=["g", 1])
+    s0 = wal.append("ask", {"docs": [{"tid": 0}], "rstate": {"k": 1}})
+    s1 = wal.append("tell", {"tid": 0, "state": 2,
+                             "result": {"status": "ok", "loss": 0.5}})
+    assert (s0, s1) == (0, 1)
+    wal.close()
+
+    fresh = TellWAL(path, guard=["g", 1])
+    records = fresh.replay()
+    assert [r["kind"] for r in records] == ["ask", "tell"]
+    assert records[0]["docs"] == [{"tid": 0}]
+    assert records[1]["result"]["loss"] == 0.5
+    assert fresh.next_seq == 2
+    assert fresh.total_tells == 1
+
+
+def test_torn_tail_is_truncated_not_fatal(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path)
+    for i in range(5):
+        wal.append("tell", {"tid": i, "state": 2})
+    wal.close()
+    good_size = os.path.getsize(path)
+    # a torn append: half a record, no trailing newline
+    with open(path, "a") as f:
+        f.write('deadbeef {"seq": 5, "kind": "tell", "tid": 99')
+    fresh = TellWAL(path)
+    records = fresh.replay()
+    assert [r["tid"] for r in records] == [0, 1, 2, 3, 4]
+    assert os.path.getsize(path) == good_size  # tail truncated in place
+    # appends continue from the valid prefix
+    assert fresh.append("tell", {"tid": 5, "state": 2}) == 5
+    assert fresh.total_tells == 6
+
+
+def test_torn_binary_garbage_tail(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path)
+    wal.append("tell", {"tid": 0, "state": 2})
+    wal.close()
+    with open(path, "ab") as f:
+        f.write(b"\xff\xfe\x00garbage")
+    fresh = TellWAL(path)
+    assert [r["tid"] for r in fresh.replay()] == [0]
+
+
+def test_midfile_corruption_is_refused(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path)
+    for i in range(3):
+        wal.append("tell", {"tid": i, "state": 2})
+    wal.close()
+    lines = open(path).read().splitlines(keepends=True)
+    lines[1] = "00000000 " + lines[1].split(" ", 1)[1]  # bad crc mid-file
+    with open(path, "w") as f:
+        f.write("".join(lines))
+    with pytest.raises(CheckpointError, match="not a torn tail"):
+        TellWAL(path).replay()
+
+
+def test_reset_compacts_but_counters_survive(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path, guard=["g"])
+    for i in range(4):
+        wal.append("tell", {"tid": i, "state": 2})
+    wal.reset()
+    assert wal.replay() == []  # records absorbed
+    assert wal.total_tells == 4  # ...but the monotone counter survives
+    assert wal.append("tell", {"tid": 4, "state": 2}) == 4  # seq monotone
+    fresh = TellWAL(path, guard=["g"])
+    assert fresh.total_tells == 5
+    assert fresh.next_seq == 5
+
+
+def test_guard_mismatch_refused(tmp_path):
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path, guard=["study-A"])
+    wal.append("tell", {"tid": 0, "state": 2})
+    wal.close()
+    with pytest.raises(CheckpointError, match="different study"):
+        TellWAL(path, guard=["study-B"]).replay()
+    # no guard = no opinion (fsck reads logs without study context)
+    assert len(TellWAL(path).replay()) == 1
+
+
+def test_injected_partial_write_behaves_as_torn_tail(tmp_path):
+    """A FaultPlan partial write mid-append is exactly the torn-tail
+    case: the prefix survives, the torn record is truncated away."""
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path)
+    for i in range(3):
+        wal.append("tell", {"tid": i, "state": 2})
+    wal.close()
+    plan = FaultPlan(seed=3, partial_rate=1.0, burst=1)
+    faulty = TellWAL(path, fs=plan.fs())
+    try:
+        faulty.append("tell", {"tid": 3, "state": 2})
+    except OSError:
+        pass  # the injected EIO mid-record
+    faulty.close()
+    fresh = TellWAL(path)
+    tids = [r["tid"] for r in fresh.replay()]
+    assert tids[:3] == [0, 1, 2]  # prefix intact, tail (if torn) dropped
+    assert plan.stats["error:partial_write"] >= 1
+
+
+def test_rstate_cursor_roundtrip_reproduces_stream():
+    rng = np.random.default_rng(123)
+    rng.integers(2**31 - 1)  # advance
+    cursor = encode_rstate(rng)
+    import json
+
+    cursor = json.loads(json.dumps(cursor))  # must survive JSON
+    expected = [int(rng.integers(2**31 - 1)) for _ in range(5)]
+    restored = decode_rstate(cursor)
+    assert [int(restored.integers(2**31 - 1)) for _ in range(5)] == expected
+
+
+def test_rstate_cursor_roundtrip_legacy_randomstate():
+    import json
+
+    rs = np.random.RandomState(7)
+    rs.randint(2**31 - 1)
+    cursor = json.loads(json.dumps(encode_rstate(rs)))
+    expected = [int(rs.randint(2**31 - 1)) for _ in range(5)]
+    restored = decode_rstate(cursor)
+    assert [int(restored.randint(2**31 - 1)) for _ in range(5)] == expected
