@@ -56,6 +56,24 @@ def bench_lint():
     return len(result.findings), result.baseline_size
 
 
+def bench_ir():
+    """graftir (hyperopt-tpu-lint --ir) over the program registry: the
+    count of dispatch-critical families whose jaxpr/lowering checked
+    out, and how many drifted from the committed shape/cost manifest --
+    stamped so a program whose contract moved (shape, donation, FLOPs)
+    is visible in the round JSON even when nobody ran the fast tier.
+
+    Traces and lowers on CPU only -- no device execution, so the rows
+    are identical on- and off-accelerator."""
+    from hyperopt_tpu.analysis.ir import check_programs
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    result = check_programs(
+        contracts_path=os.path.join(repo, "program_contracts.json")
+    )
+    return result.programs_checked, result.contract_drift
+
+
 def bench_rtt(n_calls=20):
     """Dispatch round-trip of a trivial device program, in ms.
 
@@ -707,6 +725,7 @@ def main():
     )
     rtt_ms = bench_rtt()
     lint_findings_total, lint_baseline_size = bench_lint()
+    ir_programs_checked, ir_contract_drift = bench_ir()
 
     print(
         json.dumps(
@@ -788,6 +807,12 @@ def main():
                 # tracks the grandfathered-debt burn-down
                 "lint_findings_total": lint_findings_total,
                 "lint_baseline_size": lint_baseline_size,
+                # round-11 graftir contract rows: registered program
+                # families checked at the IR level, and how many
+                # drifted from program_contracts.json (0 on a healthy
+                # tree -- drift is accepted only via --update-contracts)
+                "ir_programs_checked": ir_programs_checked,
+                "ir_contract_drift": ir_contract_drift,
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,

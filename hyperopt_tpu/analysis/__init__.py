@@ -15,6 +15,11 @@ table mapping each rule to the PR that motivated it):
 * GL1xx -- trace discipline inside jit/shard_map/pallas_call scopes
 * GL2xx -- dispatch hygiene (donation, device sync, per-call jit)
 * GL3xx -- crash consistency & fault routing
+* GL4xx -- graftir: jaxpr/lowering-level program contracts over the
+  registered dispatch-critical program families (:mod:`.ir`,
+  ``hyperopt-tpu-lint --ir``) -- host callbacks, f64 creep, declined
+  donation, oversized baked constants, mid-program transfers, and
+  shape/cost drift against the committed ``program_contracts.json``
 
 Inline suppression::
 
@@ -33,7 +38,7 @@ and ``tokenize`` only.
 
 from .baseline import load_baseline, write_baseline
 from .engine import Finding, LintResult, lint_paths, lint_source
-from .report import format_json, format_text
+from .report import format_ir_json, format_ir_text, format_json, format_text
 from .rules import RULES
 
 __all__ = [
@@ -46,4 +51,10 @@ __all__ = [
     "write_baseline",
     "format_text",
     "format_json",
+    "format_ir_text",
+    "format_ir_json",
 ]
+
+# NOTE: the graftir checker itself (analysis.ir) imports lazily -- it
+# needs jax at check time; `from hyperopt_tpu.analysis import ir` keeps
+# the package import jax-free for the AST-only paths.

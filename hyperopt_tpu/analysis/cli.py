@@ -1,15 +1,26 @@
-"""``hyperopt-tpu-lint``: the graftlint console entry point.
+"""``hyperopt-tpu-lint``: the graftlint/graftir console entry point.
 
-Exit-code contract (pinned by tests/test_lint_suppress.py):
+Exit-code contract (pinned by tests/test_lint_suppress.py and
+tests/test_graftir.py -- identical for the AST and ``--ir`` paths):
 
-* 0 -- clean (no findings after baseline + pragmas)
+* 0 -- clean (no findings after baseline + pragmas / contracts)
 * 1 -- findings
-* 2 -- usage error or internal failure (bad path, unreadable baseline,
-  engine exception); argparse's own usage errors also exit 2
+* 2 -- usage error or internal failure (bad path, unreadable baseline
+  or contracts manifest, engine exception); argparse's own usage errors
+  also exit 2
 
 ``lint_baseline.json`` in the current directory is picked up
 automatically so ``hyperopt-tpu-lint hyperopt_tpu/`` from the repo root
-runs against the committed baseline with no flags.
+runs against the committed baseline with no flags.  Finding paths are
+anchored at ``--root`` (default: the baseline file's directory when a
+baseline is in play, else the cwd), so the CLI reports identical
+findings no matter where it is invoked from.
+
+``--ir`` switches to the graftir jaxpr-level pack (GL4xx, see
+:mod:`.ir`): it checks the REGISTERED program families, not the path
+arguments, against the committed ``program_contracts.json`` (resolved
+next to the package by default -- cwd-independent).  Accept deliberate
+contract changes with ``--ir --update-contracts``.
 """
 
 from __future__ import annotations
@@ -20,7 +31,7 @@ import sys
 
 from . import baseline as baseline_mod
 from .engine import lint_paths
-from .report import format_json, format_text
+from .report import format_ir_json, format_ir_text, format_json, format_text
 from .rules import RULES
 
 __all__ = ["main"]
@@ -32,11 +43,13 @@ def _build_parser():
     p = argparse.ArgumentParser(
         prog="hyperopt-tpu-lint",
         description="AST-based invariant checker for trace discipline, "
-        "dispatch hygiene, and crash consistency (graftlint).",
+        "dispatch hygiene, and crash consistency (graftlint), plus the "
+        "jaxpr-level program contract checker (graftir, --ir).",
     )
     p.add_argument(
         "paths", nargs="*", default=["hyperopt_tpu"],
-        help="files or directories to lint (default: hyperopt_tpu)",
+        help="files or directories to lint (default: hyperopt_tpu; "
+        "ignored under --ir, which checks registered programs)",
     )
     p.add_argument(
         "--format", choices=("text", "json"), default="text",
@@ -56,10 +69,63 @@ def _build_parser():
         help="write the current findings to the baseline file and exit 0",
     )
     p.add_argument(
+        "--root", default=None, metavar="DIR",
+        help="anchor finding paths at this directory (default: the "
+        "baseline file's directory when a baseline is used, else the "
+        "cwd) -- makes reports identical regardless of invocation cwd",
+    )
+    p.add_argument(
+        "--ir", action="store_true",
+        help="run the graftir jaxpr-level pack (GL4xx) over the "
+        "registered dispatch-critical program families",
+    )
+    p.add_argument(
+        "--contracts", default=None, metavar="FILE",
+        help="program-contracts manifest for --ir (default: the "
+        "committed program_contracts.json next to the package)",
+    )
+    p.add_argument(
+        "--update-contracts", action="store_true",
+        help="with --ir: re-pin the shape/cost manifest to the current "
+        "programs instead of diffing against it",
+    )
+    p.add_argument(
         "--list-rules", action="store_true",
         help="print the rule pack and exit",
     )
     return p
+
+
+def _main_ir(args):
+    from . import ir as ir_mod
+
+    contracts = args.contracts
+    if contracts is None:
+        contracts = ir_mod.default_contracts_path(root=args.root)
+    try:
+        result = ir_mod.check_programs(
+            contracts_path=contracts, update=args.update_contracts,
+        )
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"hyperopt-tpu-lint: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # internal failure is 2, never a traceback
+        print(
+            f"hyperopt-tpu-lint: internal error: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+    if result.updated:
+        print(
+            f"pinned {result.programs_checked} program contract(s) to "
+            f"{result.contracts_path}",
+            file=sys.stderr,
+        )
+    print(
+        format_ir_json(result) if args.format == "json"
+        else format_ir_text(result)
+    )
+    return 0 if result.clean else 1
 
 
 def main(argv=None):
@@ -72,6 +138,15 @@ def main(argv=None):
             print(f"{r.id}  {r.name:28s} {r.summary}")
         return 0
 
+    if args.update_contracts and not args.ir:
+        print(
+            "hyperopt-tpu-lint: error: --update-contracts requires --ir",
+            file=sys.stderr,
+        )
+        return 2
+    if args.ir:
+        return _main_ir(args)
+
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline:
         if os.path.exists(DEFAULT_BASELINE):
@@ -79,11 +154,18 @@ def main(argv=None):
     if args.no_baseline:
         baseline_path = None
 
+    # cwd-independence: anchor finding paths at the baseline's home (so
+    # they keep matching its committed repo-relative keys) unless the
+    # caller pins --root explicitly
+    root = args.root
+    if root is None and baseline_path is not None:
+        root = os.path.dirname(os.path.abspath(baseline_path))
+
     try:
         counter = None
         if baseline_path is not None and not args.write_baseline:
             counter = baseline_mod.load_baseline(baseline_path)
-        result = lint_paths(args.paths, baseline=counter)
+        result = lint_paths(args.paths, baseline=counter, root=root)
     except (FileNotFoundError, ValueError, OSError) as e:
         print(f"hyperopt-tpu-lint: error: {e}", file=sys.stderr)
         return 2

@@ -229,7 +229,19 @@ class FileContext:
                 if any(_is_jit_decorator(d) for d in node.decorator_list):
                     jitted.add(node)
 
-        # one level of plain-name aliasing: fn = a / fn = a if c else b
+        # one level of plain-name aliasing: fn = a / fn = a if c else b /
+        # fn = partial(a, ...) -- a partial binds arguments, it does not
+        # change which function body traces, so scoped rules must see
+        # through it (the fn = functools.partial(f, cfg); jit(fn) gap)
+        def _unwrap_partial(node):
+            if (
+                isinstance(node, ast.Call)
+                and terminal_name(node.func) == "partial"
+                and node.args
+            ):
+                return node.args[0]
+            return node
+
         alias = {}
         for node in ast.walk(self.tree):
             if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
@@ -238,11 +250,12 @@ class FileContext:
             if not isinstance(tgt, ast.Name):
                 continue
             names = set()
-            v = node.value
+            v = _unwrap_partial(node.value)
             if isinstance(v, ast.Name):
                 names.add(v.id)
             elif isinstance(v, ast.IfExp):
                 for leg in (v.body, v.orelse):
+                    leg = _unwrap_partial(leg)
                     if isinstance(leg, ast.Name):
                         names.add(leg.id)
             if names:
@@ -266,6 +279,10 @@ class FileContext:
                     if kw.arg in ("fun", "f", "fn"):
                         target = kw.value
                         break
+            # jit(partial(f, x), ...) / shard_map(functools.partial(f,
+            # b), mesh=...): the partial wrapper is transparent -- f's
+            # body is what traces
+            target = _unwrap_partial(target)
             if isinstance(target, ast.Lambda):
                 jitted.add(target)
             elif isinstance(target, ast.Name):

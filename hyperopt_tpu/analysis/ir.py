@@ -1,0 +1,435 @@
+"""graftir: jaxpr-level program contract checker (the GL4xx pack).
+
+graftlint's AST rules (:mod:`.rules`) see source text only; what
+actually ends up INSIDE a compiled program is invisible to them -- a
+host callback smuggled in via a helper function, a silent f64
+promotion, a donation XLA never received, a 10 MB constant baked into
+the fused tell+ask jaxpr.  graftir closes that gap: every
+dispatch-critical program family registers itself with the program
+registry (:mod:`hyperopt_tpu.ops.compile`, ``register_program``) as a
+builder over ABSTRACT inputs, and this module traces and lowers each
+one on the CPU backend -- ``jax.make_jaxpr``-level work, zero device
+execution -- then audits the IR:
+
+* **GL401** host callback (``io_callback``/``pure_callback``/
+  ``debug_callback``) inside a dispatch-critical program.
+* **GL402** f64/complex128 creep: the program is re-traced under
+  ``enable_x64`` and any NON-weak wide-float intermediate is flagged --
+  weak-typed Python-scalar promotions are exempt, so a finding means an
+  un-dtyped array op that silently doubles compute/traffic the moment
+  x64 is on.
+* **GL403** donation not honored: the registry entry declares the
+  program family's donation contract; the lowered module's
+  input-output aliasing must match exactly.
+* **GL404** oversized baked-in constant: any closed-over array bigger
+  than :data:`CONST_BYTES_MAX` re-uploads with every program -- the
+  hazard class the resident-history work (PR 4) exists to kill.
+* **GL405** mid-program transfer (``device_put`` inside the jaxpr).
+* **GL406** contract drift: output shapes/dtypes, the honored donation,
+  ``cost_analysis()`` FLOPs/bytes, and total baked-constant bytes are
+  pinned in the committed ``program_contracts.json``; any drift fails
+  with a field-level diff and is accepted only via
+  ``hyperopt-tpu-lint --ir --update-contracts``.
+
+Everything here is cwd-independent: the registry anchors finding paths
+at the package parent, and the default manifest path is resolved next
+to the package, never the process cwd.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import re
+
+from .engine import Finding
+
+__all__ = [
+    "IRResult",
+    "check_capture",
+    "check_programs",
+    "default_contracts_path",
+    "load_contracts",
+    "write_contracts",
+    "CONST_BYTES_MAX",
+    "DEFAULT_CONTRACTS",
+]
+
+DEFAULT_CONTRACTS = "program_contracts.json"
+CONTRACTS_VERSION = 1
+
+#: GL404 threshold: a closed-over constant at or past this many bytes is
+#: a re-upload hazard (it rides along with EVERY dispatch of the
+#: program).  PackedSpace._consts are O(D) -- hundreds of bytes; one MiB
+#: means somebody baked a history-sized array into a trace.
+CONST_BYTES_MAX = 1 << 20
+
+_CALLBACK_PRIMS = frozenset({
+    "io_callback", "pure_callback", "debug_callback",
+})
+_TRANSFER_PRIMS = frozenset({"device_put"})
+_WIDE_DTYPES = frozenset({"float64", "complex128"})
+
+_ALIASING_RE = re.compile(r"%arg(\d+):[^,)]*?\btf\.aliasing_output\b")
+
+
+@dataclasses.dataclass
+class IRResult:
+    """What one ``--ir`` run produced (the reporter's input)."""
+
+    findings: list
+    programs_checked: int = 0
+    contract_drift: int = 0
+    contracts_path: str = ""
+    updated: bool = False
+
+    @property
+    def clean(self):
+        return not self.findings
+
+
+def repo_root():
+    """The package parent -- the anchor for finding paths and the
+    default manifest location (cwd-independent by construction)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def default_contracts_path(root=None):
+    return os.path.join(root or repo_root(), DEFAULT_CONTRACTS)
+
+
+def _finding(spec, rule, message):
+    f = Finding(
+        path=spec.path, rule=rule, line=spec.line, col=0,
+        message=f"[{spec.name}] {message}",
+    )
+    object.__setattr__(f, "_scope_lines", [])
+    return f
+
+
+def _walk_eqns(jaxpr, out):
+    """Every eqn of ``jaxpr`` and its nested sub-jaxprs (pjit / scan /
+    while / cond / shard_map / pallas bodies), depth-first."""
+    for eq in jaxpr.eqns:
+        out.append(eq)
+        for v in eq.params.values():
+            items = v if isinstance(v, (tuple, list)) else [v]
+            for item in items:
+                if hasattr(item, "eqns"):
+                    _walk_eqns(item, out)
+                else:
+                    inner = getattr(item, "jaxpr", None)
+                    if hasattr(inner, "eqns"):
+                        _walk_eqns(inner, out)
+    return out
+
+
+def _aval_str(aval):
+    dt = getattr(aval, "dtype", None)
+    shape = getattr(aval, "shape", ())
+    name = getattr(dt, "name", str(dt))
+    return f"{name}[{','.join(str(int(s)) for s in shape)}]"
+
+
+def _donated_argnums(lowered_text):
+    """Input positions the lowered module aliases to outputs -- the
+    donations XLA actually received (``tf.aliasing_output`` on the main
+    function's arguments)."""
+    main = lowered_text
+    m = re.search(r"func\.func public @main\((.*?)\)\s*->", main, re.S)
+    if m:
+        main = m.group(1)
+    return tuple(sorted(int(i) for i in _ALIASING_RE.findall(main)))
+
+
+@contextlib.contextmanager
+def _on_cpu():
+    """Force tracing/lowering onto the CPU backend: the checker must be
+    runnable on a TPU-attached host (bench stamps it every round)
+    without dispatching anything over the tunnel, and the committed
+    contracts are pinned against CPU lowering."""
+    import jax
+
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is None:
+        yield
+    else:
+        with jax.default_device(cpu):
+            yield
+
+
+def build_contract(capture):
+    """Trace + lower one :class:`~hyperopt_tpu.ops.compile.
+    ProgramCapture` on CPU; returns ``(traced, traced_x64, lowered,
+    contract)`` where ``contract`` is the committed-manifest row."""
+    import jax
+    import numpy as np
+
+    with _on_cpu():
+        traced = capture.fn.trace(*capture.args, **capture.kwargs)
+        lowered = traced.lower()
+        traced_x64 = None
+        if getattr(capture, "x64_check", True):
+            with jax.experimental.enable_x64():
+                traced_x64 = capture.fn.trace(
+                    *capture.args, **capture.kwargs
+                )
+
+    cost = {}
+    try:
+        cost = lowered.cost_analysis() or {}
+    except Exception:  # backend without HLO cost analysis: pin shapes only
+        cost = {}
+
+    def _cost_int(key):
+        v = cost.get(key)
+        return int(round(float(v))) if v is not None else None
+
+    closed = traced.jaxpr
+    contract = {
+        "outputs": [_aval_str(v) for v in closed.out_avals],
+        "donation": list(_donated_argnums(lowered.as_text())),
+        "flops": _cost_int("flops"),
+        "bytes_accessed": _cost_int("bytes accessed"),
+        "const_bytes": int(sum(
+            np.asarray(c).nbytes for c in closed.consts
+        )),
+    }
+    return traced, traced_x64, lowered, contract
+
+
+def check_capture(spec, capture, stored=None, const_bytes_max=None):
+    """Run the GL4xx pack over one registered program.
+
+    Returns ``(findings, contract)``.  ``stored`` is the committed
+    contract row to diff against (GL406); ``None`` skips the drift
+    check (the caller handles missing manifests itself).
+    """
+    limit = CONST_BYTES_MAX if const_bytes_max is None else const_bytes_max
+    findings = []
+    traced, traced_x64, _lowered, contract = build_contract(capture)
+
+    eqns = _walk_eqns(traced.jaxpr.jaxpr, [])
+
+    # GL401: host callbacks have no place inside a hot program family
+    cb = sorted({
+        e.primitive.name for e in eqns if e.primitive.name in _CALLBACK_PRIMS
+    })
+    for prim in cb:
+        findings.append(_finding(
+            spec, "GL401",
+            f"host callback primitive {prim!r} inside a dispatch-critical "
+            "program: every dispatch now blocks on a host round-trip; "
+            "hoist it out of the traced scope",
+        ))
+
+    # GL405: a transfer inside the program serializes dispatch.  Only
+    # device_put with an EXPLICIT target counts: jnp.array/asarray emit
+    # target-less device_put eqns (devices=[None], alias semantics) that
+    # move nothing, while jax.device_put(x, some_device_or_sharding)
+    # inside a trace pins a real mid-program transfer.
+    tr = sorted({
+        e.primitive.name
+        for e in eqns
+        if e.primitive.name in _TRANSFER_PRIMS
+        and any(d is not None for d in e.params.get("devices", ()))
+    })
+    for prim in tr:
+        findings.append(_finding(
+            spec, "GL405",
+            f"mid-program transfer primitive {prim!r} with an explicit "
+            "placement target: placement belongs to the caller "
+            "(ObsBuffer/device_arrays), not inside the compiled program",
+        ))
+
+    # GL402: strong wide-float intermediates under enable_x64
+    wide = {}
+    for e in ([] if traced_x64 is None
+              else _walk_eqns(traced_x64.jaxpr.jaxpr, [])):
+        for ov in e.outvars:
+            av = ov.aval
+            dt = getattr(av, "dtype", None)
+            if (
+                dt is not None
+                and str(dt) in _WIDE_DTYPES
+                and not getattr(av, "weak_type", False)
+            ):
+                wide[e.primitive.name] = wide.get(e.primitive.name, 0) + 1
+    for prim, n in sorted(wide.items()):
+        findings.append(_finding(
+            spec, "GL402",
+            f"{n} {prim!r} intermediate(s) promote to a strong 64-bit "
+            "float under enable_x64: an un-dtyped op is widening "
+            "silently; pin dtype=jnp.float32 at the producing site",
+        ))
+
+    # GL404: oversized baked-in constants (the re-upload hazard class)
+    import numpy as np
+
+    for c in traced.jaxpr.consts:
+        arr = np.asarray(c)
+        if arr.nbytes >= limit:
+            findings.append(_finding(
+                spec, "GL404",
+                f"closed-over constant {_aval_str(arr)} ({arr.nbytes} "
+                f"bytes >= {limit}) is baked into the jaxpr and rides "
+                "along with every dispatch; pass it as an argument "
+                "(device-resident) instead",
+            ))
+
+    # GL403: the declared donation contract vs what lowering recorded
+    declared = tuple(sorted(int(i) for i in capture.donate_argnums))
+    honored = tuple(contract["donation"])
+    if declared != honored:
+        findings.append(_finding(
+            spec, "GL403",
+            f"donation contract mismatch: registry declares argnums "
+            f"{list(declared)} but the lowered program aliases "
+            f"{list(honored)} -- a dropped donate_argnums doubles peak "
+            "device memory for the state buffers",
+        ))
+
+    # GL406: drift against the committed contract
+    if stored is not None:
+        for line in _diff_contract(stored, contract):
+            findings.append(_finding(spec, "GL406", line))
+
+    return findings, contract
+
+
+def _diff_contract(stored, fresh):
+    """Field-level readable diff lines, empty when identical."""
+    out = []
+    for key in ("outputs", "donation", "flops", "bytes_accessed",
+                "const_bytes"):
+        a, b = stored.get(key), fresh.get(key)
+        if a != b:
+            out.append(
+                f"contract drift in {key!r}: committed {a!r} != traced "
+                f"{b!r} (accept deliberate changes with "
+                "`hyperopt-tpu-lint --ir --update-contracts`)"
+            )
+    return out
+
+
+def load_contracts(path):
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    if payload.get("version") != CONTRACTS_VERSION:
+        raise ValueError(
+            f"contracts manifest {path!r} has version "
+            f"{payload.get('version')!r}; this checker reads version "
+            f"{CONTRACTS_VERSION}"
+        )
+    return payload
+
+
+def write_contracts(path, programs, params):
+    payload = {
+        "version": CONTRACTS_VERSION,
+        "params": {
+            "n_obs": params.n_obs,
+            "batch": params.batch,
+            "k_spec": params.k_spec,
+            "space_dims": params.space.n_dims,
+        },
+        "programs": programs,
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+#: per-process memo of the default-parameterization trace results:
+#: (name -> (findings-sans-GL406, contract)).  Source cannot change
+#: under a live process, and tracing every family costs seconds -- the
+#: CLI, the tier-1 gate, and bench all call check_programs repeatedly
+#: in one process and only the manifest diff (GL406) varies per call.
+_DEFAULT_TRACE_CACHE = {}
+
+
+def _trace_once(name, spec, params, cache):
+    if cache is not None and name in cache:
+        fs, contract = cache[name]
+        return list(fs), contract
+    capture = spec.build(params)
+    fs, contract = check_capture(spec, capture)
+    if cache is not None:
+        cache[name] = (tuple(fs), contract)
+    return list(fs), contract
+
+
+def check_programs(contracts_path=None, update=False, params=None):
+    """Run the GL4xx pack over every registered program family.
+
+    ``contracts_path`` defaults to the committed manifest next to the
+    package.  ``update=True`` re-pins the manifest instead of diffing
+    (GL401-405 still report).  Returns :class:`IRResult`.
+    """
+    from ..ops.compile import default_program_params, registered_programs
+
+    path = contracts_path or default_contracts_path()
+    specs = registered_programs()
+    cache = None
+    if params is None:
+        params = default_program_params()
+        cache = _DEFAULT_TRACE_CACHE
+
+    manifest = {}
+    manifest_missing = not os.path.exists(path)
+    if not manifest_missing and not update:
+        manifest = load_contracts(path).get("programs", {})
+
+    findings = []
+    fresh = {}
+    drift = 0
+    for name, spec in specs.items():
+        fs, contract = _trace_once(name, spec, params, cache)
+        fresh[name] = contract
+        stored = None if update else manifest.get(name)
+        if stored is not None:
+            for line in _diff_contract(stored, contract):
+                fs.append(_finding(spec, "GL406", line))
+        if not update and stored is None:
+            fs.append(_finding(
+                spec, "GL406",
+                "no committed contract"
+                + (" (manifest missing)" if manifest_missing else "")
+                + "; pin it with `hyperopt-tpu-lint --ir "
+                "--update-contracts`",
+            ))
+        if any(f.rule == "GL406" for f in fs):
+            drift += 1
+        findings.extend(fs)
+
+    # stale manifest rows: a program family that no longer registers
+    for name in sorted(set(manifest) - set(specs)):
+        f = Finding(
+            path=os.path.basename(path), rule="GL406", line=1, col=0,
+            message=f"[{name}] manifest pins a program no longer in the "
+            "registry; refresh with `hyperopt-tpu-lint --ir "
+            "--update-contracts`",
+        )
+        object.__setattr__(f, "_scope_lines", [])
+        findings.append(f)
+        drift += 1
+
+    if update:
+        write_contracts(path, fresh, params)
+
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return IRResult(
+        findings=findings,
+        programs_checked=len(specs),
+        contract_drift=drift,
+        contracts_path=path,
+        updated=bool(update),
+    )

@@ -47,3 +47,42 @@ def format_json(result):
         indent=2,
         sort_keys=True,
     )
+
+
+def ir_summary(result):
+    """Summary block of an :class:`~.ir.IRResult` (the bench stamps
+    ``ir_programs_checked`` / ``ir_contract_drift`` from this)."""
+    return {
+        "total": len(result.findings),
+        "programs_checked": result.programs_checked,
+        "contract_drift": result.contract_drift,
+        "contracts": result.contracts_path,
+        "updated": result.updated,
+    }
+
+
+def format_ir_text(result):
+    lines = []
+    for f in result.findings:
+        rule = RULES.get(f.rule)
+        name = f" ({rule.name})" if rule else ""
+        lines.append(f"{f.path}:{f.line}: {f.rule}{name} {f.message}")
+    s = ir_summary(result)
+    lines.append(
+        f"graftir: {s['total']} finding(s) across "
+        f"{s['programs_checked']} program(s), "
+        f"{s['contract_drift']} with contract drift"
+        + (" [contracts updated]" if result.updated else "")
+    )
+    return "\n".join(lines)
+
+
+def format_ir_json(result):
+    return json.dumps(
+        {
+            "summary": ir_summary(result),
+            "findings": [f.to_dict() for f in result.findings],
+        },
+        indent=2,
+        sort_keys=True,
+    )

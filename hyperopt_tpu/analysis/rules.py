@@ -56,6 +56,33 @@ RULES["GL002"] = Rule(
     "GL002", "parse-error", "file does not parse (syntax error)"
 )
 
+# GL4xx -- the graftir IR pack (hyperopt-tpu-lint --ir): checked over
+# the TRACED jaxprs/lowerings of registered program families, not the
+# AST, so no checker here walks a tree (see analysis/ir.py; the rule
+# metadata lives in this table so --list-rules and pragma validation
+# cover the whole pack without importing jax)
+for _id, _name, _summary in (
+    ("GL401", "ir-host-callback",
+     "io_callback/pure_callback/debug_callback primitive inside a "
+     "dispatch-critical program's jaxpr"),
+    ("GL402", "ir-f64-promotion",
+     "a non-weak float64/complex128 intermediate appears when the "
+     "program is traced under enable_x64 (an un-dtyped op widening "
+     "silently)"),
+    ("GL403", "ir-donation-not-honored",
+     "the registry's declared donate_argnums are absent from (or "
+     "exceed) the lowered program's input-output aliasing"),
+    ("GL404", "ir-oversized-constant",
+     "a closed-over array constant >= the byte threshold is baked into "
+     "the jaxpr (re-uploaded with every dispatch)"),
+    ("GL405", "ir-mid-program-transfer",
+     "a device_put transfer primitive inside the program body"),
+    ("GL406", "ir-contract-drift",
+     "output shapes/dtypes, donation, or cost_analysis FLOPs/bytes "
+     "drifted from the committed program_contracts.json"),
+):
+    RULES[_id] = Rule(_id, _name, _summary)
+
 
 def _is_test_file(ctx):
     base = ctx.parts[-1] if ctx.parts else ""

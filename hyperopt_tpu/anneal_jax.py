@@ -64,8 +64,12 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
         order = jnp.argsort(jnp.where(ok, losses, jnp.inf), stable=True)
 
         # geometric(p)-1 ranks via inverse transform; p = 1/avg_best_idx
+        # (explicit f32: an un-dtyped uniform widens to f64 under x64,
+        # the promotion class the GL402 IR check pins at trace time)
         p = 1.0 / max(abi, 1.0 + 1e-9)
-        u = jax.random.uniform(kr, (batch,), minval=1e-12, maxval=1.0)
+        u = jax.random.uniform(
+            kr, (batch,), dtype=jnp.float32, minval=1e-12, maxval=1.0
+        )
         rank = jnp.floor(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int32)
         rank = jnp.clip(rank, 0, jnp.maximum(n_ok - 1, 0))
         cols = order[rank]  # [B] anchor slots
@@ -113,7 +117,7 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
 
         if Dk:
             ki = c["cat_idx"]
-            coin = jax.random.uniform(kcoin, (Dk, batch))
+            coin = jax.random.uniform(kcoin, (Dk, batch), dtype=jnp.float32)
             redraw = coin < frac[ki][:, None]
             cat = jnp.where(
                 redraw | ~anchor_act[ki], prior_vals[ki], anchor_vals[ki]
@@ -243,3 +247,38 @@ def suggest(
             avg_best_idx=avg_best_idx, shrink_coef=shrink_coef,
         )
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
+
+
+# ---------------------------------------------------------------------------
+# graftir registrations (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+_ANNEAL_FAMILIES = ("hyperopt_tpu.anneal_jax:build_anneal_fn",)
+
+
+@register_program("anneal_jax.suggest", families=_ANNEAL_FAMILIES)
+def _registry_anneal_suggest(p):
+    _ = p.space._consts
+    fn = build_anneal_fn(p.space, _default_avg_best_idx,
+                         _default_shrink_coef)
+    return ProgramCapture(
+        fn=fn, args=(p.key_spec(),) + p.history_specs(),
+        kwargs={"batch": p.batch},
+    )
+
+
+@register_program("anneal_jax.fused_tell_ask", families=_ANNEAL_FAMILIES)
+def _registry_anneal_fused(p):
+    """The annealing twin of ``tpe_jax.fused_tell_ask`` (same donated
+    ``state_io`` contract, shared ``_state_dispatch`` driver)."""
+    _ = p.space._consts
+    fn = build_anneal_fn(p.space, _default_avg_best_idx,
+                         _default_shrink_coef, state_io=True)
+    return ProgramCapture(
+        fn=fn,
+        args=(p.key_spec(),) + p.history_specs() + p.delta_specs(),
+        kwargs={"batch": 1},
+        donate_argnums=(1, 2, 3, 4),
+    )

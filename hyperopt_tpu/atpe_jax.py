@@ -284,7 +284,8 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
         prior_vals, _ = ps.sample_prior_fn(k_prior, batch)
         k_explore, k_lock = jax.random.split(k_roll)
         explore_col = (
-            jax.random.uniform(k_explore, (batch,)) < explore_frac
+            jax.random.uniform(k_explore, (batch,), dtype=jnp.float32)
+            < explore_frac
         )
         new_values = jnp.where(explore_col[None, :], prior_vals, new_values)
 
@@ -293,7 +294,8 @@ def build_atpe_device_fn(ps, lf, prior_weight=1.0, elite_count=8,
         # values is not a restart)
         lock_mask, lock_vals = lock_set(values, active, losses, ok, n)
         lock_col = (
-            jax.random.uniform(k_lock, (batch,)) < lock_fraction
+            jax.random.uniform(k_lock, (batch,), dtype=jnp.float32)
+            < lock_fraction
         ) & ~explore_col
         apply = lock_mask[:, None] & lock_col[None, :]
         new_values = jnp.where(apply, lock_vals[:, None], new_values)
@@ -483,3 +485,25 @@ def suggest(
     idxs, vals = dense_to_idxs_vals(new_ids, ps.labels, values, active)
     idxs, vals = tpe_jax._cast_vals(ps, idxs, vals)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
+
+
+# ---------------------------------------------------------------------------
+# graftir registrations (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "atpe_jax.device_step",
+    families=("hyperopt_tpu.atpe_jax:build_atpe_device_fn",),
+)
+def _registry_atpe_device(p):
+    """The adaptive on-device suggest step (traced settings + locking),
+    the ``algo='atpe'`` body of ``device_loop.compile_fmin``'s scan."""
+    _ = p.space._consts
+    fn = build_atpe_device_fn(p.space, 25.0)
+    return ProgramCapture(
+        fn=fn, args=(p.key_spec(),) + p.history_specs(),
+        kwargs={"batch": p.batch},
+    )

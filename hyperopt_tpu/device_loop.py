@@ -578,6 +578,11 @@ def compile_fmin(
             out["trials"] = _to_trials(ps, values_np, active_np, losses_np)
         return out
 
+    # the jitted experiment program itself, exposed for the graftir
+    # registry (analysis/ir.py traces it over abstract inputs) -- the
+    # runner closure is the only other holder
+    runner._compiled_run = run
+    runner._history_capacity = cap
     return runner
 
 
@@ -587,6 +592,52 @@ def fmin_on_device(fn, space, max_evals, seed=0, return_trials=False, **kw):
     return compile_fmin(fn, space, max_evals, **kw)(
         seed=seed, return_trials=return_trials
     )
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "device_loop.scan",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_device_loop(p):
+    """The whole-experiment scan (``compile_fmin``'s jitted ``run``):
+    the suggest kernels, the vmapped objective, and the history carry
+    fused into one program.  Traced over abstract zero-history inputs
+    at a small step count -- the IR shape is step-count-scaled but
+    structurally identical to production runs."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ops.compile import reference_space
+
+    def _objective(cfg):
+        t = jnp.zeros((), jnp.float32)
+        for label in sorted(cfg):
+            t = t + (cfg[label] - 1.0) ** 2
+        return t
+
+    runner = compile_fmin(
+        _objective, reference_space(), max_evals=4, batch_size=1,
+        algo="tpe", n_startup_jobs=2, n_EI_candidates=24,
+    )
+    cap = runner._history_capacity
+    D = p.space.n_dims
+    args = (
+        jax.ShapeDtypeStruct((), np.uint32),           # seed
+        jax.ShapeDtypeStruct((D, cap), jnp.float32),   # values
+        jax.ShapeDtypeStruct((D, cap), jnp.bool_),     # active
+        jax.ShapeDtypeStruct((cap,), jnp.float32),     # losses
+        jax.ShapeDtypeStruct((cap,), jnp.bool_),       # valid
+        jax.ShapeDtypeStruct((), jnp.int32),           # warm offset c0
+        jax.ShapeDtypeStruct((), jnp.float32),         # best0
+    )
+    return ProgramCapture(fn=runner._compiled_run, args=args)
 
 
 def _to_trials(ps, values, active, losses):

@@ -19,7 +19,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "blob_key_from_doc", "TTLSet", "lru_get", "claim_heartbeat",
-    "with_retries", "is_transient", "TRANSIENT_ERRNOS",
+    "retry_delay", "with_retries", "is_transient", "TRANSIENT_ERRNOS",
 ]
 
 DEFAULT_DOMAIN_KEY = "FMinIter_Domain"
@@ -61,6 +61,16 @@ def is_transient(exc):
     )
 
 
+def retry_delay(attempt, base_delay=0.005, max_delay=0.05):
+    """THE backoff schedule: ``min(max_delay, base_delay * 2**attempt)``.
+
+    One definition shared by :func:`with_retries` and the worker CLIs'
+    crash-loop guards, so every sleep-on-error in the fault domain backs
+    off on the same bounded exponential curve (GL303's contract: no
+    hand-rolled retry schedules)."""
+    return min(float(max_delay), float(base_delay) * (2 ** int(attempt)))
+
+
 def with_retries(fn, attempts=10, base_delay=0.005, max_delay=0.05,
                  sleep=time.sleep, classify=is_transient, label=None):
     """Call ``fn()``; on a transient failure (per ``classify``) retry
@@ -87,7 +97,7 @@ def with_retries(fn, attempts=10, base_delay=0.005, max_delay=0.05,
         except Exception as e:
             if attempt == attempts - 1 or not classify(e):
                 raise
-            delay = min(max_delay, base_delay * (2 ** attempt))
+            delay = retry_delay(attempt, base_delay, max_delay)
             logger.debug(
                 "transient failure in %s (attempt %d/%d), retrying in "
                 "%.0f ms: %s", label or getattr(fn, "__name__", "op"),

@@ -464,6 +464,10 @@ def main_worker(argv=None):
         if drain.requested:
             logger.info("drained after %d job(s), exiting 0", n)
             return 0
+        # backoff computed in-handler, slept at loop level on the shared
+        # with_retries schedule (_common.retry_delay) -- no hand-rolled
+        # sleep-in-except retry loop (GL303)
+        backoff = None
         try:
             jobs.reap(options.reserve_timeout)
             ran = worker.run_one(owner)
@@ -474,27 +478,31 @@ def main_worker(argv=None):
                 # crash-looping the process on the same lowest-tid doc
                 logger.error("job %s returned to queue: %s", e.failed_tid, e)
                 consecutive_errors = 0
-                time.sleep(options.poll_interval)
-                continue
-            # crash-loop guard (the filequeue worker's contract): back
-            # off on unexpected errors -- an AutoReconnect storm that
-            # outlives the per-op retries costs backoff, not the
-            # process -- then exit loudly so a supervisor restart loop
-            # cannot silently spin forever
-            consecutive_errors += 1
-            if consecutive_errors >= options.max_crash_loop:
-                logger.critical(
-                    "%d consecutive unexpected errors (last: %s); "
-                    "exiting loudly", consecutive_errors, e, exc_info=True,
+                backoff = options.poll_interval
+            else:
+                # crash-loop guard (the filequeue worker's contract):
+                # back off on unexpected errors -- an AutoReconnect
+                # storm that outlives the per-op retries costs backoff,
+                # not the process -- then exit loudly so a supervisor
+                # restart loop cannot silently spin forever
+                consecutive_errors += 1
+                if consecutive_errors >= options.max_crash_loop:
+                    logger.critical(
+                        "%d consecutive unexpected errors (last: %s); "
+                        "exiting loudly", consecutive_errors, e,
+                        exc_info=True,
+                    )
+                    return 2
+                logger.error(
+                    "unexpected worker error (%d/%d): %s",
+                    consecutive_errors, options.max_crash_loop, e,
                 )
-                return 2
-            logger.error(
-                "unexpected worker error (%d/%d): %s",
-                consecutive_errors, options.max_crash_loop, e,
-            )
-            time.sleep(min(
-                options.poll_interval * (2 ** consecutive_errors), 2.0
-            ))
+                backoff = _common.retry_delay(
+                    consecutive_errors,
+                    base_delay=options.poll_interval, max_delay=2.0,
+                )
+        if backoff is not None:
+            time.sleep(backoff)
             continue
         consecutive_errors = 0
         if ran:

@@ -237,6 +237,11 @@ def main_worker_helper(options, drain=None):
         if drain.requested:
             logger.info("drained after %d job(s), exiting 0", n_done)
             return 0
+        # backoff decisions are made IN the handler, the sleep happens
+        # at loop level on the shared with_retries schedule
+        # (_common.retry_delay) -- one backoff curve for the whole
+        # fault domain, no hand-rolled sleep-in-except retry (GL303)
+        backoff = None
         try:
             queue.reap(options.reserve_timeout)
             ran = run_one(
@@ -263,26 +268,30 @@ def main_worker_helper(options, drain=None):
                 logger.error("job %s returned to queue: %s", tid, e)
                 bad_tids.add(tid)
                 consecutive_errors = 0  # per-job failure, not a crash loop
-                time.sleep(options.poll_interval)
-                continue
-            consecutive_errors += 1
-            if consecutive_errors >= max_crash_loop:
-                logger.critical(
-                    "%d consecutive unexpected errors (last: %s); "
-                    "exiting loudly", consecutive_errors, e, exc_info=True,
+                backoff = options.poll_interval
+            else:
+                consecutive_errors += 1
+                if consecutive_errors >= max_crash_loop:
+                    logger.critical(
+                        "%d consecutive unexpected errors (last: %s); "
+                        "exiting loudly", consecutive_errors, e,
+                        exc_info=True,
+                    )
+                    return 2
+                level = (
+                    logging.WARNING if _common.is_transient(e)
+                    else logging.ERROR
                 )
-                return 2
-            level = (
-                logging.WARNING if _common.is_transient(e)
-                else logging.ERROR
-            )
-            logger.log(
-                level, "unexpected worker error (%d/%d): %s",
-                consecutive_errors, max_crash_loop, e, exc_info=True,
-            )
-            time.sleep(min(
-                options.poll_interval * (2 ** consecutive_errors), 2.0
-            ))
+                logger.log(
+                    level, "unexpected worker error (%d/%d): %s",
+                    consecutive_errors, max_crash_loop, e, exc_info=True,
+                )
+                backoff = _common.retry_delay(
+                    consecutive_errors,
+                    base_delay=options.poll_interval, max_delay=2.0,
+                )
+        if backoff is not None:
+            time.sleep(backoff)
             continue
         consecutive_errors = 0
         if ran:

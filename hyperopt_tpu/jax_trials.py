@@ -502,6 +502,32 @@ def host_key(seed):
         return jax.random.key(seed)
 
 
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "jax_trials.apply_delta",
+    families=("hyperopt_tpu.ops.kernels:apply_delta",),
+)
+def _registry_apply_delta(p):
+    """The standalone O(D) delta-tell program the resident mirror
+    dispatches per staged observation (``_apply_delta_fn``) -- donated
+    state, exactly as :meth:`ObsBuffer._resident_sync` builds it."""
+    import jax
+
+    from .ops.kernels import apply_delta
+
+    fn = jax.jit(apply_delta, donate_argnums=(0, 1, 2, 3))
+    return ProgramCapture(
+        fn=fn, args=p.history_specs() + p.delta_specs(),
+        donate_argnums=(0, 1, 2, 3),
+    )
+
+
 def cached_suggest_fn(domain, cache_attr, params, builder):
     """Per-domain cache of compiled suggest programs, shared by every JAX
     algo path (tpe_jax / anneal_jax / parallel.sharded).

@@ -15,7 +15,10 @@ pure elementwise work, no control flow, so nested choice spaces
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import importlib
+import os
 
 import numpy as np
 
@@ -23,7 +26,17 @@ from ..exceptions import CompileError
 from ..pyll.base import as_apply
 from ..pyll_utils import expr_to_config
 
-__all__ = ["PackedSpace", "compile_space"]
+__all__ = [
+    "PackedSpace",
+    "ProgramCapture",
+    "ProgramParams",
+    "ProgramSpec",
+    "compile_space",
+    "program_family",
+    "reference_space",
+    "register_program",
+    "registered_programs",
+]
 
 _CONT_DISTS = {
     "uniform": (False, False),  # (logspace, quantized)
@@ -272,3 +285,214 @@ def compile_space(space):
     if not labels:
         raise CompileError("space has no hyperparameters")
     return PackedSpace(labels, hps)
+
+
+# ---------------------------------------------------------------------------
+# graftir program registry: the dispatch-critical program families
+# ---------------------------------------------------------------------------
+#
+# graftlint (analysis/rules.py) sees source AST only; nothing there can
+# know what actually ends up INSIDE a compiled program -- a host callback
+# smuggled in via a helper, a silent f64 promotion, a donation XLA never
+# saw, a 10 MB constant baked into the jaxpr.  The registry is the other
+# half: every dispatch-critical program family registers a builder that
+# reconstructs the program over ABSTRACT inputs (jax.ShapeDtypeStruct),
+# so the IR checker (analysis/ir.py) can trace and lower each one on CPU
+# with zero device execution and audit the jaxpr the AST rules cannot
+# see.  Shape/cost contracts are pinned in the committed
+# ``program_contracts.json`` (see ``hyperopt-tpu-lint --ir``).
+
+
+def program_family(fn):
+    """The program-FAMILY identity of a callable handed to a trace
+    wrapper: ``module:qualname`` with any ``<locals>`` suffix stripped,
+    so every closure a builder constructs maps back to the builder that
+    owns the family (``build_suggest_fn.<locals>.fused`` ->
+    ``hyperopt_tpu.tpe_jax:build_suggest_fn``).  ``functools.partial``
+    wrappers resolve to the wrapped callable.  The registry-completeness
+    test records these at ``jax.jit`` construction time and asserts
+    every family reachable from the dispatch-critical entry points is
+    claimed by a registered program."""
+    while isinstance(fn, functools.partial):
+        fn = fn.func
+    fn = getattr(fn, "__wrapped__", fn)
+    mod = getattr(fn, "__module__", None) or "<unknown>"
+    qn = getattr(fn, "__qualname__", None) or getattr(
+        fn, "__name__", "<anonymous>"
+    )
+    return f"{mod}:{qn.split('.<locals>')[0]}"
+
+
+@dataclasses.dataclass
+class ProgramParams:
+    """The knobs every registered builder is parameterized by: the
+    compiled reference space plus history width / suggestion batch /
+    speculative draw width.  Helpers build the abstract input specs all
+    history-shaped programs share (zero device execution: even the PRNG
+    key spec comes from ``jax.eval_shape``)."""
+
+    space: PackedSpace
+    n_obs: int = 128
+    batch: int = 4
+    k_spec: int = 8
+
+    def key_spec(self):
+        import jax
+
+        return jax.eval_shape(lambda: jax.random.key(0))
+
+    def history_specs(self):
+        """(values, active, losses, valid) at the registry bucket."""
+        import jax
+        import jax.numpy as jnp
+
+        D, N = self.space.n_dims, self.n_obs
+        return (
+            jax.ShapeDtypeStruct((D, N), jnp.float32),
+            jax.ShapeDtypeStruct((D, N), jnp.bool_),
+            jax.ShapeDtypeStruct((N,), jnp.float32),
+            jax.ShapeDtypeStruct((N,), jnp.bool_),
+        )
+
+    def delta_specs(self):
+        """The O(D) tell delta: (vcol, acol, loss, slot)."""
+        import jax
+        import jax.numpy as jnp
+
+        D = self.space.n_dims
+        return (
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+            jax.ShapeDtypeStruct((D,), jnp.bool_),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jax.ShapeDtypeStruct((), jnp.int32),
+        )
+
+
+@dataclasses.dataclass
+class ProgramCapture:
+    """What a registered builder hands the IR checker: a jitted callable
+    (anything supporting ``.trace(*args, **kwargs)``), the abstract
+    arguments to trace it over, and the DECLARED donation contract --
+    the argnums the program family promises to donate (checked against
+    the lowered program's input-output aliasing, GL403)."""
+
+    fn: object
+    args: tuple
+    kwargs: dict = dataclasses.field(default_factory=dict)
+    donate_argnums: tuple = ()
+    #: run the enable_x64 re-trace (GL402)?  A program that shares its
+    #: closure with another registered program (same build, different
+    #: static batch) may skip the duplicate re-trace -- the family's
+    #: promotion behavior is already pinned by the sibling.
+    x64_check: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    name: str
+    build: object          # build(params: ProgramParams) -> ProgramCapture
+    families: tuple        # program_family() keys this spec covers
+    path: str              # repo-relative source file of the registration
+    line: int
+
+
+PROGRAM_REGISTRY = {}
+
+#: modules that own dispatch-critical program families; imported (once)
+#: by :func:`registered_programs` so their registrations run.  A new
+#: program family starts by adding its module here and a
+#: ``@register_program`` builder there.
+_PROGRAM_MODULES = (
+    "hyperopt_tpu.ops.compile",
+    "hyperopt_tpu.jax_trials",
+    "hyperopt_tpu.tpe_jax",
+    "hyperopt_tpu.anneal_jax",
+    "hyperopt_tpu.atpe_jax",
+    "hyperopt_tpu.device_loop",
+    "hyperopt_tpu.parallel.sharded",
+    "hyperopt_tpu.ops.pallas_kernels",
+)
+
+
+def _rel_source_path(filename):
+    """Repo-relative posix path of a registration site (cwd-independent:
+    anchored at the package parent, never the process cwd)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    try:
+        rel = os.path.relpath(os.path.abspath(filename), start=pkg_root)
+    except ValueError:  # different drive (windows)
+        rel = filename
+    return rel.replace(os.sep, "/")
+
+
+def register_program(name, families=()):
+    """Decorator registering a dispatch-critical program family.
+
+    The decorated ``build(params)`` must return a :class:`ProgramCapture`
+    over ABSTRACT inputs -- it may build jitted closures (cheap) but must
+    not execute device programs.  ``families`` lists the
+    :func:`program_family` keys of every callable this program wraps,
+    the completeness contract the registry test enforces."""
+
+    def deco(build):
+        code = getattr(build, "__code__", None)
+        spec = ProgramSpec(
+            name=name,
+            build=build,
+            families=tuple(families),
+            path=_rel_source_path(
+                code.co_filename if code else __file__
+            ),
+            line=code.co_firstlineno if code else 1,
+        )
+        if name in PROGRAM_REGISTRY:
+            raise ValueError(f"program {name!r} registered twice")
+        PROGRAM_REGISTRY[name] = spec
+        return build
+
+    return deco
+
+
+def registered_programs():
+    """Import every program-owning module and return the registry
+    (name -> :class:`ProgramSpec`, insertion-ordered)."""
+    for mod in _PROGRAM_MODULES:
+        importlib.import_module(mod)
+    return dict(PROGRAM_REGISTRY)
+
+
+def reference_space():
+    """The registry's canonical mixed space: two continuous families
+    (bounded + log), one quantized, one categorical -- enough structure
+    that every kernel family (uniform/log/quantize/categorical paths)
+    appears in the traced programs without bloating trace time."""
+    from .. import hp
+
+    return {
+        "x": hp.uniform("x", -5.0, 5.0),
+        "lr": hp.loguniform("lr", -6.0, 0.0),
+        "width": hp.quniform("width", 16.0, 256.0, 16.0),
+        "unit": hp.choice("unit", [0, 1, 2]),
+    }
+
+
+def default_program_params(n_obs=128, batch=4, k_spec=8):
+    """The parameterization the committed contracts are pinned at."""
+    ps = compile_space(reference_space())
+    return ProgramParams(space=ps, n_obs=n_obs, batch=batch, k_spec=k_spec)
+
+
+@register_program(
+    "compile.sample_prior",
+    families=("hyperopt_tpu.ops.compile:PackedSpace.sample_prior_fn",),
+)
+def _registry_sample_prior(p):
+    """The startup-regime ask: every suggest path below ``n_startup_jobs``
+    serves prior draws through this program."""
+    import jax
+
+    _ = p.space._consts
+    fn = jax.jit(p.space.sample_prior_fn, static_argnums=(1,))
+    return ProgramCapture(fn=fn, args=(p.key_spec(), p.batch))

@@ -213,3 +213,35 @@ def ei_scores(x_lat, below, above, interpret=False):
     ll_b = gmm_logpdf_rows(x_lat, *below, interpret=interpret)
     ll_a = gmm_logpdf_rows(x_lat, *above, interpret=interpret)
     return ll_b - ll_a
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "pallas.ei_scores",
+    families=(
+        "hyperopt_tpu.ops.pallas_kernels:ei_scores",
+        "hyperopt_tpu.ops.pallas_kernels:gmm_logpdf_rows",
+    ),
+)
+def _registry_pallas_ei_scores(p):
+    """The Pallas GMM-scoring kernel pair, traced in interpret mode so
+    the pallas_call lowers on CPU; the jaxpr (and the VMEM-streaming
+    structure it wraps) is the same object Mosaic lowers on TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    R, S, K = 8, 128, 128
+    comp = tuple(
+        jax.ShapeDtypeStruct((R, K), jnp.float32) for _ in range(4)
+    )
+    fn = jax.jit(functools.partial(ei_scores, interpret=True))
+    return ProgramCapture(
+        fn=fn,
+        args=(jax.ShapeDtypeStruct((R, S), jnp.float32), comp, comp),
+    )

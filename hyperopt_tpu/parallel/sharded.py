@@ -365,3 +365,34 @@ def sharded_suggest(
 
 
 suggest = sharded_suggest
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from ..ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "sharded.suggest",
+    families=("hyperopt_tpu.parallel.sharded:build_sharded_suggest_fn",),
+)
+def _registry_sharded_suggest(p):
+    """The mesh-sharded candidate sweep, traced over a one-CPU-device
+    mesh: the shard_map slab draw + argmax-allgather structure is
+    device-count-independent, so the single-shard IR pins the same
+    program family the multi-chip mesh runs."""
+    import jax
+
+    _ = p.space._consts
+    mesh = default_mesh(devices=jax.local_devices(backend="cpu")[:1])
+    fn = build_sharded_suggest_fn(
+        p.space, mesh, _default_n_EI_per_device, _default_gamma,
+        _default_linear_forgetting, _default_prior_weight,
+        n_cand_cat_per_device=_default_n_EI_cat_total,
+    )
+    return ProgramCapture(
+        fn=fn, args=(p.key_spec(),) + p.history_specs(),
+        kwargs={"batch": 1},
+    )

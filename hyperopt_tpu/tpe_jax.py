@@ -676,3 +676,61 @@ def suggest(
     else:
         idxs, vals = suggest_batch(new_ids, domain, trials, seed, **kw)
     return docs_from_idxs_vals(new_ids, domain, trials, idxs, vals)
+
+
+# ---------------------------------------------------------------------------
+# graftir registrations (hyperopt-tpu-lint --ir): TPE's program families
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+_TPE_FAMILIES = ("hyperopt_tpu.tpe_jax:build_suggest_fn",)
+
+
+def _registry_build(ps, n_cand, state_io=False):
+    _ = ps._consts
+    return build_suggest_fn(
+        ps, n_cand, _default_gamma, _default_linear_forgetting,
+        _default_prior_weight, n_cand_cat=_default_n_EI_candidates_cat,
+        state_io=state_io,
+    )
+
+
+@register_program("tpe_jax.suggest", families=_TPE_FAMILIES)
+def _registry_tpe_suggest(p):
+    """The plain batched ask: one dispatch draws ``batch`` suggestions
+    from the settled history (``suggest_batch`` / ``suggest_dense``)."""
+    fn = _registry_build(p.space, _default_n_EI_candidates)
+    return ProgramCapture(
+        fn=fn, args=(p.key_spec(),) + p.history_specs(),
+        kwargs={"batch": p.batch},
+    )
+
+
+@register_program("tpe_jax.fused_tell_ask", families=_TPE_FAMILIES)
+def _registry_tpe_fused(p):
+    """The ``state_io=True`` fused tell+ask program of the sequential
+    driver (one dispatch per trial, donated state buffers -- PR 4's
+    whole perf story rides on what is, and is not, inside this one)."""
+    fn = _registry_build(p.space, _default_n_EI_candidates, state_io=True)
+    return ProgramCapture(
+        fn=fn,
+        args=(p.key_spec(),) + p.history_specs() + p.delta_specs(),
+        kwargs={"batch": 1},
+        donate_argnums=(1, 2, 3, 4),
+    )
+
+
+@register_program("tpe_jax.speculative_redraw", families=_TPE_FAMILIES)
+def _registry_tpe_speculative(p):
+    """The k-wide speculative draw (``suggest(speculative=k)``): the same
+    suggest family at ``batch=k`` -- its own contract because its output
+    shapes ARE the speculation cache layout ``_speculative_cols`` pops."""
+    fn = _registry_build(p.space, _default_n_EI_candidates)
+    return ProgramCapture(
+        fn=fn, args=(p.key_spec(),) + p.history_specs(),
+        kwargs={"batch": p.k_spec},
+        # same closure as tpe_jax.suggest at a different static batch:
+        # the family's GL402 promotion behavior is pinned there already
+        x64_check=False,
+    )

@@ -1,0 +1,24 @@
+"""Engine regression: scoped rules see through functools.partial.
+
+A partial binds arguments -- the wrapped function's body is still what
+traces, so GL1xx/GL2xx scope resolution must treat ``jit(partial(f,
+...))`` (inline or via a one-level alias) exactly like ``jit(f)``.
+"""
+import functools
+
+import jax
+import numpy as np
+
+
+def scorer(cfg, x):
+    return float(np.asarray(x).mean()) * cfg  # GL101 x2 once jitted
+
+
+def kernel(v):
+    return v.item()  # GL101 once jitted
+
+
+fast_scorer = jax.jit(functools.partial(scorer, 2.0))
+
+bound = functools.partial(kernel)
+fast_kernel = jax.jit(bound)

@@ -1,0 +1,13 @@
+"""Near miss: a partial-wrapped function that is never handed to a
+trace wrapper stays host code -- no jitted scope, no GL101."""
+import functools
+
+import numpy as np
+
+
+def scorer(cfg, x):
+    return float(np.asarray(x).mean()) * cfg
+
+
+bound = functools.partial(scorer, 2.0)
+result = bound(np.ones(4))

@@ -71,9 +71,15 @@ def test_bench_prints_one_json_line():
     res = [r["resident_bytes_per_ask"] for r in rows]
     assert max(res) <= 2 * min(res)
     # round-9: graftlint trend rows -- a healthy tree has zero
-    # unbaselined findings, and the grandfathered baseline stays small
+    # unbaselined findings; the grandfathered baseline was burned to
+    # zero in round 11 and must stay there
     assert d["lint_findings_total"] == 0
-    assert 0 <= d["lint_baseline_size"] <= 6
+    assert d["lint_baseline_size"] == 0
+    # round-11: graftir contract rows -- every registered
+    # dispatch-critical program family IR-checked, zero drift against
+    # the committed program_contracts.json
+    assert d["ir_programs_checked"] >= 10
+    assert d["ir_contract_drift"] == 0
     # round-10: crash-recovery cost rows -- the per-trial durability
     # overhead is measured (WAL append + amortized bundle publish) and
     # stamped both raw and relative to the fused dispatch time

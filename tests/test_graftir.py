@@ -1,0 +1,485 @@
+"""graftir (hyperopt-tpu-lint --ir): the jaxpr-level contract gate.
+
+Three layers, mirroring the AST pack's tests:
+
+* the tier-1 GATE: every registered program family checks clean against
+  the committed ``program_contracts.json``, inside a 10 s CPU budget;
+* registry COMPLETENESS: every jit-wrapped program family reachable
+  from the dispatch-critical entry points (``suggest(fused=True)``,
+  ``device_loop``, the sharded suite, resident delta tells) is claimed
+  by a registered program -- an unregistered callsite fails by name;
+* per-rule bad/good capture pairs with exact-count pins (the IR twin of
+  ``tests/lint_fixtures/``), plus the CLI exit-code/format/cwd
+  contracts.
+"""
+
+import functools
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONTRACTS = os.path.join(REPO, "program_contracts.json")
+
+_CACHED_RESULT = []
+
+
+def _checked():
+    """One full check_programs() run shared by the gate + CLI tests
+    (tracing every family costs seconds; pay once per session)."""
+    if not _CACHED_RESULT:
+        from hyperopt_tpu.analysis.ir import check_programs
+
+        t0 = time.perf_counter()
+        res = check_programs(contracts_path=CONTRACTS)
+        _CACHED_RESULT.append((res, time.perf_counter() - t0))
+    return _CACHED_RESULT[0]
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate
+# ---------------------------------------------------------------------------
+
+
+def test_ir_gate_clean_and_fast():
+    from hyperopt_tpu.analysis.report import format_ir_text
+
+    res, elapsed = _checked()
+    assert res.clean, "\n" + format_ir_text(res)
+    assert res.contract_drift == 0
+    # the whole registry, not a subset: every dispatch-critical family
+    # the issue names (fused tell+ask x2, apply_delta, device-loop scan,
+    # speculative redraw, sharded, pallas, prior, plain asks)
+    assert res.programs_checked >= 10
+    # fast-tier budget: tracing + lowering every family on CPU must be
+    # noise inside the 9-minute wallclock pin
+    assert elapsed < 10.0, f"--ir took {elapsed:.2f}s (budget 10s)"
+
+
+def test_manifest_covers_every_registered_program():
+    from hyperopt_tpu.analysis.ir import load_contracts
+    from hyperopt_tpu.ops.compile import registered_programs
+
+    manifest = load_contracts(CONTRACTS)["programs"]
+    specs = registered_programs()
+    assert set(manifest) == set(specs), (
+        "program_contracts.json out of sync with the registry: "
+        f"missing {sorted(set(specs) - set(manifest))}, "
+        f"stale {sorted(set(manifest) - set(specs))}"
+    )
+    for name, row in manifest.items():
+        assert row["outputs"], name
+        assert isinstance(row["flops"], int), name
+        assert isinstance(row["bytes_accessed"], int), name
+        assert row["const_bytes"] < (1 << 20), (
+            f"{name}: baked constants within a dispatch of the GL404 "
+            "threshold -- the manifest itself says re-upload hazard"
+        )
+    # the donated state families really pin their donation in the manifest
+    for fused in ("tpe_jax.fused_tell_ask", "anneal_jax.fused_tell_ask"):
+        assert manifest[fused]["donation"] == [1, 2, 3, 4], fused
+    assert manifest["jax_trials.apply_delta"]["donation"] == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# registry completeness: exercised flows vs registered families
+# ---------------------------------------------------------------------------
+
+
+def _record_jits(monkeypatch, recorded):
+    import jax
+
+    from hyperopt_tpu.ops.compile import program_family
+
+    real_jit = jax.jit
+
+    def recording_jit(fun, *args, **kwargs):
+        fam = program_family(fun)
+        if fam.startswith("hyperopt_tpu."):
+            recorded.add(fam)
+        return real_jit(fun, *args, **kwargs)
+
+    monkeypatch.setattr(jax, "jit", recording_jit)
+
+
+def test_registry_covers_every_reachable_program_family(monkeypatch):
+    """Drive the real dispatch-critical entry points while recording
+    which hyperopt_tpu-owned callables get jit-wrapped; every recorded
+    family must be claimed by a registered program, with the offender
+    named in the failure."""
+    import jax
+
+    from hyperopt_tpu import fmin, hp, tpe_jax
+    from hyperopt_tpu.jax_trials import JaxTrials, ObsBuffer
+    from hyperopt_tpu.device_loop import compile_fmin
+    from hyperopt_tpu.ops.compile import compile_space, registered_programs
+
+    recorded = set()
+    _record_jits(monkeypatch, recorded)
+
+    space = {"a": hp.uniform("a", -2.0, 2.0), "b": hp.choice("b", [0, 1])}
+
+    def objective(cfg):
+        return float(cfg["a"]) ** 2 + float(cfg["b"])
+
+    # 1. the fused sequential driver (suggest(fused=True) end to end)
+    fmin(
+        objective, space,
+        algo=functools.partial(tpe_jax.suggest, fused=True,
+                               n_startup_jobs=2, n_EI_candidates=8),
+        max_evals=5, trials=JaxTrials(resident=True),
+        rstate=np.random.default_rng(0), show_progressbar=False,
+    )
+
+    # 2. the resident delta-tell program (multi-tell backlog path)
+    ps = compile_space({"a": hp.uniform("a", -1.0, 1.0)})
+    buf = ObsBuffer(ps, resident=True)
+    for i in range(3):
+        buf.add({"a": 0.1 * i}, float(i))
+    buf.device_arrays()  # materialize the mirror
+    buf.add({"a": 0.5}, 3.0)
+    buf.add({"a": 0.6}, 4.0)
+    buf.device_arrays()  # two staged deltas -> jitted apply_delta
+
+    # 3. every device-loop algo family (traced, not executed: tracing
+    # is what constructs the nested suggest programs)
+    import jax.numpy as jnp
+
+    def dl_obj(cfg):
+        t = jnp.zeros((), jnp.float32)
+        for k in sorted(cfg):
+            t = t + (cfg[k] - 0.5) ** 2
+        return t
+
+    for algo in ("tpe", "anneal", "atpe", "rand"):
+        runner = compile_fmin(
+            dl_obj, {"a": hp.uniform("a", -2.0, 2.0),
+                     "b": hp.choice("b", [0, 1])},
+            max_evals=4, batch_size=1, algo=algo, n_startup_jobs=2,
+            n_EI_candidates=8,
+        )
+        cap = runner._history_capacity
+        runner._compiled_run.trace(
+            jax.ShapeDtypeStruct((), np.uint32),
+            jax.ShapeDtypeStruct((2, cap), jnp.float32),
+            jax.ShapeDtypeStruct((2, cap), jnp.bool_),
+            jax.ShapeDtypeStruct((cap,), jnp.float32),
+            jax.ShapeDtypeStruct((cap,), jnp.bool_),
+            jax.ShapeDtypeStruct((), jnp.int32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+        )
+
+    # 4. the sharded suite (single-device mesh; the family is the same)
+    from hyperopt_tpu.base import Domain, Trials
+    from hyperopt_tpu.parallel import sharded
+    from hyperopt_tpu.parallel.mesh import default_mesh
+
+    domain = Domain(objective, space)
+    trials = Trials()
+    mesh = default_mesh(devices=jax.local_devices()[:1])
+    fn = sharded.build_sharded_suggest_fn(
+        tpe_jax.packed_space_for(domain), mesh, 8, 0.25, 25.0, 1.0,
+    )
+    from hyperopt_tpu.jax_trials import host_key
+
+    fn.trace(
+        host_key(0),
+        jax.ShapeDtypeStruct((2, 128), jnp.float32),
+        jax.ShapeDtypeStruct((2, 128), jnp.bool_),
+        jax.ShapeDtypeStruct((128,), jnp.float32),
+        jax.ShapeDtypeStruct((128,), jnp.bool_),
+        batch=1,
+    )
+
+    registered = set()
+    for spec in registered_programs().values():
+        registered.update(spec.families)
+
+    unclaimed = sorted(recorded - registered)
+    assert not unclaimed, (
+        "program families constructed by the dispatch-critical entry "
+        "points but NOT claimed by any registered graftir program "
+        f"(register them in their owning module): {unclaimed}"
+    )
+    # and the exercise really reached the core families (a silently
+    # skipped flow must not turn this test into a tautology)
+    for fam in (
+        "hyperopt_tpu.tpe_jax:build_suggest_fn",
+        "hyperopt_tpu.ops.kernels:apply_delta",
+        "hyperopt_tpu.ops.compile:PackedSpace.sample_prior_fn",
+        "hyperopt_tpu.anneal_jax:build_anneal_fn",
+        "hyperopt_tpu.atpe_jax:build_atpe_device_fn",
+        "hyperopt_tpu.device_loop:compile_fmin",
+        "hyperopt_tpu.parallel.sharded:build_sharded_suggest_fn",
+    ):
+        assert fam in recorded, f"flow never constructed {fam}"
+
+
+# ---------------------------------------------------------------------------
+# per-rule bad/good capture pairs (exact-count pins)
+# ---------------------------------------------------------------------------
+
+
+def _capture(fn, *args, donate=(), static=(), **kwargs):
+    import jax
+
+    from hyperopt_tpu.ops.compile import ProgramCapture
+
+    jitted = jax.jit(
+        fn,
+        static_argnames=static or None,
+        donate_argnums=donate or None,
+    )
+    return ProgramCapture(
+        fn=jitted, args=args, kwargs=kwargs, donate_argnums=donate,
+    )
+
+
+def _spec(name):
+    from hyperopt_tpu.ops.compile import ProgramSpec
+
+    return ProgramSpec(
+        name=name, build=None, families=(),
+        path="tests/test_graftir.py", line=1,
+    )
+
+
+def _check(name, capture, stored=None):
+    from hyperopt_tpu.analysis.ir import check_capture
+
+    return check_capture(_spec(name), capture, stored=stored)
+
+
+def _vec():
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((8,), jnp.float32)
+
+
+def test_gl401_host_callback_bad_and_good():
+    import jax
+    import jax.numpy as jnp
+
+    def bad(x):
+        from jax.experimental import io_callback
+
+        jax.debug.callback(lambda v: None, x)
+        y = jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype), x
+        )
+        return io_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), y,
+        )
+
+    findings, _ = _check("fixture.gl401_bad", _capture(bad, _vec()))
+    assert [f.rule for f in findings] == ["GL401"] * 3  # one per primitive
+    assert {"io_callback", "pure_callback", "debug_callback"} == {
+        f.message.split("'")[1] for f in findings
+    }
+
+    def good(x):
+        return jnp.sum(x * 2.0)
+
+    findings, _ = _check("fixture.gl401_good", _capture(good, _vec()))
+    assert findings == []
+
+
+def test_gl402_f64_promotion_bad_and_good():
+    import jax.numpy as jnp
+
+    def bad(x):
+        wide = x.astype(jnp.float64)  # the silent widening under x64
+        return (wide * 2.0).sum()
+
+    findings, _ = _check("fixture.gl402_bad", _capture(bad, _vec()))
+    rules = [f.rule for f in findings]
+    # one finding per offending primitive: convert_element_type, mul,
+    # reduce_sum all carry strong f64 avals
+    assert set(rules) == {"GL402"} and len(findings) == 3
+
+    def good(x):
+        # python-scalar weak promotion is NOT a finding: 2.0 stays weak
+        # and the strong f32 array wins the binop
+        return (x * 2.0).sum()
+
+    findings, _ = _check("fixture.gl402_good", _capture(good, _vec()))
+    assert findings == []
+
+
+def test_gl403_donation_bad_and_good():
+    import jax.numpy as jnp
+
+    def step(state, d):
+        return state + d
+
+    # BAD: the registry contract declares donation but the jit lost it
+    from hyperopt_tpu.ops.compile import ProgramCapture
+    import jax
+
+    cap = ProgramCapture(
+        fn=jax.jit(step), args=(_vec(), _vec()), donate_argnums=(0,),
+    )
+    findings, _ = _check("fixture.gl403_bad", cap)
+    assert [f.rule for f in findings] == ["GL403"]
+    assert "[0]" in findings[0].message and "[]" in findings[0].message
+
+    # GOOD: declared donation present in the lowered aliasing
+    findings, contract = _check(
+        "fixture.gl403_good", _capture(step, _vec(), _vec(), donate=(0,))
+    )
+    assert findings == []
+    assert contract["donation"] == [0]
+
+
+def test_gl404_oversized_constant_bad_and_good():
+    import jax.numpy as jnp
+
+    big = jnp.zeros((512, 600), jnp.float32)  # ~1.2 MB baked constant
+
+    def bad(x):
+        return x.sum() + big.sum()
+
+    findings, contract = _check("fixture.gl404_bad", _capture(bad, _vec()))
+    assert [f.rule for f in findings] == ["GL404"]
+    assert "float32[512,600]" in findings[0].message
+    assert contract["const_bytes"] >= big.size * 4
+
+    small = jnp.zeros((8,), jnp.float32)
+
+    def good(x):
+        return x.sum() + small.sum()
+
+    findings, _ = _check("fixture.gl404_good", _capture(good, _vec()))
+    assert findings == []
+
+
+def test_gl405_mid_program_transfer_bad_and_good():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+
+    def bad(x):
+        pinned = jax.device_put(x, dev)  # explicit mid-program placement
+        return pinned * 2.0
+
+    findings, _ = _check("fixture.gl405_bad", _capture(bad, _vec()))
+    assert [f.rule for f in findings] == ["GL405"]
+
+    def good(x):
+        # jnp.asarray emits a target-less device_put (alias semantics,
+        # no transfer) -- must NOT be flagged
+        return jnp.asarray([1.0, 2.0], jnp.float32).sum() + x.sum()
+
+    findings, _ = _check("fixture.gl405_good", _capture(good, _vec()))
+    assert findings == []
+
+
+def test_gl406_contract_drift_bad_and_good():
+    import jax.numpy as jnp
+
+    def prog(x):
+        return jnp.stack([x, x * 2.0])
+
+    _, fresh = _check("fixture.gl406", _capture(prog, _vec()))
+
+    # GOOD: identical stored contract -> no drift
+    findings, _ = _check("fixture.gl406", _capture(prog, _vec()),
+                         stored=dict(fresh))
+    assert findings == []
+
+    # BAD: a stored contract from "before the shape change"
+    stale = dict(fresh)
+    stale["outputs"] = ["float32[3,8]"]
+    stale["flops"] = (fresh["flops"] or 0) + 7
+    findings, _ = _check("fixture.gl406", _capture(prog, _vec()),
+                         stored=stale)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["GL406", "GL406"]
+    drifted = {f.message.split("'")[1] for f in findings}
+    assert drifted == {"outputs", "flops"}
+    # the diff is readable: names the program, the field, both values
+    assert all("fixture.gl406" in f.message for f in findings)
+    assert any("float32[3,8]" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes, formats, --update-contracts, cwd-independence
+# ---------------------------------------------------------------------------
+
+
+def test_cli_ir_exit_codes_and_json(tmp_path, monkeypatch, capsys):
+    from hyperopt_tpu.analysis.cli import main
+
+    # clean tree against the committed manifest -> 0
+    assert main(["--ir", "--contracts", CONTRACTS]) == 0
+    capsys.readouterr()
+
+    # --format json carries the bench-stamped summary fields
+    assert main(["--ir", "--contracts", CONTRACTS, "--format", "json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["summary"]["programs_checked"] >= 10
+    assert payload["summary"]["contract_drift"] == 0
+    assert payload["findings"] == []
+
+    # doctored manifest -> drift findings, exit 1, diff names the field
+    doctored = json.loads(open(CONTRACTS).read())
+    doctored["programs"]["tpe_jax.fused_tell_ask"]["flops"] += 1
+    bad = tmp_path / "contracts.json"
+    bad.write_text(json.dumps(doctored))
+    assert main(["--ir", "--contracts", str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "GL406" in out and "tpe_jax.fused_tell_ask" in out
+    assert "flops" in out
+
+    # unreadable manifest -> usage error 2
+    garbage = tmp_path / "garbage.json"
+    garbage.write_text("{not json")
+    assert main(["--ir", "--contracts", str(garbage)]) == 2
+    capsys.readouterr()
+
+    # missing manifest -> every program unpinned (exit 1), then
+    # --update-contracts pins it and the check goes green (exit 0)
+    fresh = tmp_path / "fresh.json"
+    assert main(["--ir", "--contracts", str(fresh)]) == 1
+    out = capsys.readouterr().out
+    assert "no committed contract" in out
+    assert main(["--ir", "--contracts", str(fresh),
+                 "--update-contracts"]) == 0
+    capsys.readouterr()
+    assert main(["--ir", "--contracts", str(fresh)]) == 0
+    capsys.readouterr()
+
+    # --update-contracts without --ir is a usage error
+    assert main(["--update-contracts"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_findings_identical_from_any_cwd(tmp_path, monkeypatch, capsys):
+    """The satellite bugfix: both the AST CLI and --ir must report the
+    exact same findings whether invoked from / or from the repo root."""
+    from hyperopt_tpu.analysis.cli import main
+
+    pkg = os.path.join(REPO, "hyperopt_tpu")
+    baseline = os.path.join(REPO, "lint_baseline.json")
+
+    outputs = {}
+    for cwd in ("/", REPO):
+        monkeypatch.chdir(cwd)
+        rc = main([pkg, "--baseline", baseline, "--format", "json"])
+        assert rc == 0
+        outputs[cwd] = json.loads(capsys.readouterr().out)
+    assert outputs["/"] == outputs[REPO]
+
+    ir_outputs = {}
+    for cwd in ("/", REPO):
+        monkeypatch.chdir(cwd)
+        rc = main(["--ir", "--format", "json"])
+        assert rc == 0
+        ir_outputs[cwd] = json.loads(capsys.readouterr().out)
+    assert ir_outputs["/"] == ir_outputs[REPO]

@@ -24,7 +24,10 @@ BASELINE = os.path.join(REPO, "lint_baseline.json")
 
 # the baseline is grandfathered debt: it may shrink, it must not grow.
 # Raising this number in a diff is the signal to stop and fix instead.
-MAX_BASELINE_ENTRIES = 6
+# Burned to ZERO in PR 7 (the 4 GL303 worker-CLI sleeps now route
+# through _common.retry_delay): the whole package lints clean with no
+# grandfathered findings, and it stays that way.
+MAX_BASELINE_ENTRIES = 0
 
 
 @pytest.fixture
@@ -62,6 +65,11 @@ def test_every_pack_rule_has_a_fixture_pair():
     for rule_id in RULES:
         if rule_id in ("GL001", "GL002"):
             continue  # engine rules: pinned in test_lint_suppress.py
+        if rule_id.startswith("GL4"):
+            # graftir IR rules check traced programs, not source text;
+            # their bad/good pairs are in-memory program captures pinned
+            # by tests/test_graftir.py
+            continue
         stem = rule_id.lower()
         assert f"{stem}_bad.py" in names, f"missing TP fixture for {rule_id}"
         assert f"{stem}_good.py" in names, f"missing FP fixture for {rule_id}"

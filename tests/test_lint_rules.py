@@ -65,6 +65,18 @@ def test_known_finding_counts():
     assert len(_lint(_fixture_path("GL305", "bad"))) == 2
 
 
+def test_partial_wrapped_functions_resolve_as_jitted():
+    # engine regression (PR 7): jit(partial(f, ...)) -- inline or via a
+    # one-level `bound = partial(f); jit(bound)` alias -- must open f's
+    # body as a jitted scope so GL101/GL102/GL201 see through the
+    # wrapper; a partial never handed to a wrapper must not
+    path = os.path.join(FIXTURES, "engine_partial_bad.py")
+    findings = _lint(path)
+    assert {f.rule for f in findings} == {"GL101"}
+    assert len(findings) == 3  # np.asarray + float() in scorer, .item()
+    assert not _lint(os.path.join(FIXTURES, "engine_partial_good.py"))
+
+
 def test_findings_carry_location_and_hash():
     findings = _lint(_fixture_path("GL301", "bad"))
     (f,) = findings
