@@ -424,6 +424,78 @@ def bench_resume_overhead(n_trials=60, seed=11):
         return rec.seconds_spent / n_trials, rec.wal.total_tells
 
 
+def bench_serve(space, n_studies=64, rounds=6, n_cand=128,
+                n_startup_jobs=3):
+    """The multi-tenant suggestion service (round 12): ``n_studies``
+    concurrent studies, one slotted batch, ``rounds`` full ask+tell
+    rounds -- so each timed round is ONE study-batched fused tell+ask
+    dispatch serving every study.  The solo baseline is the same
+    engine at one study (the sequential fused ask a lone tenant pays),
+    so the speedup column isolates what continuous batching buys.
+
+    Returns a dict of the stamped keys: ``serve_studies_per_sec``
+    (asks served per second across studies), ``serve_ask_p50_ms`` /
+    ``serve_ask_p99_ms`` (submit-to-ack latency percentiles),
+    ``serve_batch_occupancy`` (mean filled-slot fraction of the timed
+    rounds), ``serve_vs_solo_speedup_x``, and the config stamps.
+    """
+    from hyperopt_tpu.serve import SuggestService
+
+    def run(n, n_rounds, warmup_rounds=1):
+        svc = SuggestService(
+            space, max_batch=max(n, 4), background=False,
+            n_startup_jobs=n_startup_jobs, n_cand=n_cand,
+        )
+        handles = [
+            svc.create_study(f"bench{i:03d}", seed=i) for i in range(n)
+        ]
+
+        def loss(vals):
+            return sum(
+                float(v) for v in vals.values()
+                if isinstance(v, (int, float))
+            )
+
+        def round_once():
+            futs = [h.ask_async() for h in handles]
+            svc.pump()
+            for h, f in zip(handles, futs):
+                tid, vals = f.result(timeout=120)
+                h.tell(tid, loss(vals))
+
+        for _ in range(warmup_rounds):
+            round_once()  # compile + first materialization
+        lat0 = len(svc.scheduler.ask_latencies)
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            round_once()
+        dt = time.perf_counter() - t0
+        lats = svc.scheduler.ask_latencies[lat0:]
+        occ = svc.scheduler.occupancy[-n_rounds:]
+        svc.shutdown()
+        return n * n_rounds / dt, lats, occ
+
+    rate, lats, occ = run(n_studies, rounds)
+    # solo baseline: same engine, one tenant, same ask count budget
+    solo_rate, _, _ = run(1, min(max(rounds * 4, 8), 32))
+    lats_ms = sorted(1000.0 * x for x in lats)
+
+    def pct(p):
+        return lats_ms[min(len(lats_ms) - 1, int(p * len(lats_ms)))]
+
+    return {
+        "serve_studies_per_sec": round(rate, 1),
+        "serve_ask_p50_ms": round(pct(0.50), 3),
+        "serve_ask_p99_ms": round(pct(0.99), 3),
+        "serve_batch_occupancy": round(float(np.mean(occ)), 4),
+        "serve_vs_solo_speedup_x": (
+            round(rate / solo_rate, 2) if solo_rate else None
+        ),
+        "serve_solo_asks_per_sec": round(solo_rate, 1),
+        "serve_batch": n_studies,
+    }
+
+
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
@@ -693,6 +765,15 @@ def main():
         n_trials=min(60, n_trials_1k)
     )
     assert resume_wal_tells == min(60, n_trials_1k)
+    # round-12 multi-tenant service rows: studies/sec served out of one
+    # slotted batch, ask-latency percentiles, occupancy, and the
+    # continuous-batching speedup over the one-tenant sequential rate
+    serve_rows = bench_serve(
+        space,
+        n_studies=int(os.environ.get("BENCH_SERVE_STUDIES", "64")),
+        rounds=int(os.environ.get("BENCH_SERVE_ROUNDS", "6")),
+        n_cand=n_cand,
+    )
     loop_rate = bench_device_loop() if platform != "cpu" else None
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
@@ -754,6 +835,9 @@ def main():
                 "resume_overhead_frac_of_fused": round(
                     resume_overhead * fused_sync_rate, 4
                 ),
+                # round-12 serve rows (bench_serve): study-batched
+                # fused tell+ask with continuous batching
+                **serve_rows,
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),

@@ -338,6 +338,7 @@ def write_contracts(path, programs, params):
             "n_obs": params.n_obs,
             "batch": params.batch,
             "k_spec": params.k_spec,
+            "n_studies": params.n_studies,
             "space_dims": params.space.n_dims,
         },
         "programs": programs,

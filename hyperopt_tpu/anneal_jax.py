@@ -23,7 +23,8 @@ _default_avg_best_idx = 2.0
 _default_shrink_coef = 0.1
 
 
-def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
+def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False,
+                    raw=False):
     """Compile the full annealing suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
@@ -32,7 +33,9 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
     contract as :func:`hyperopt_tpu.tpe_jax.build_suggest_fn`'s: a
     staged O(D) observation delta is applied to the donated state
     buffers and the suggestion drawn from the updated history, one
-    dispatch total).  Matches
+    dispatch total).  ``raw=True`` returns the unjitted closure (the
+    :mod:`hyperopt_tpu.serve.batched` vmap seam -- same contract as
+    :func:`tpe_jax.build_suggest_fn`'s).  Matches
     :class:`hyperopt_tpu.anneal.AnnealingAlgo` semantics:
 
     * anchor trial per suggestion: rank ``geometric(1/avg_best_idx) - 1``
@@ -127,6 +130,8 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
         return new_values, ps.active_fn(new_values)
 
     if not state_io:
+        if raw:
+            return fn
         return jax.jit(fn, static_argnames=("batch",))
 
     from .ops import kernels as K
@@ -139,6 +144,8 @@ def build_anneal_fn(ps, avg_best_idx, shrink_coef, state_io=False):
         new_values, new_active = fn(key, *state, batch)
         return tuple(state) + (new_values, new_active)
 
+    if raw:
+        return fused
     return jax.jit(
         fused, static_argnames=("batch",), donate_argnums=(1, 2, 3, 4)
     )

@@ -49,7 +49,8 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
-    "CRASH_POINTS", "DRIVER_CRASH_POINTS", "ALL_CRASH_POINTS",
+    "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
+    "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -86,7 +87,27 @@ DRIVER_CRASH_POINTS = (
     "after_ckpt_publish_before_wal_reset",
 )
 
-ALL_CRASH_POINTS = CRASH_POINTS + DRIVER_CRASH_POINTS
+#: crash points of the multi-tenant suggestion SERVICE's batching loop
+#: (hyperopt_tpu/serve): the scheduler coalesces many studies' tells and
+#: asks into one device dispatch, so its crash windows sit between the
+#: per-study WAL appends and the shared batch.  The serve chaos suite
+#: (tests/test_serve_chaos.py) iterates this tuple the way the driver
+#: suite iterates :data:`DRIVER_CRASH_POINTS`::
+#:
+#:     serve_after_wal_before_dispatch  tell durable in the study WAL,
+#:                                      batch not yet dispatched
+#:     serve_mid_batch                  batch assembled, device program
+#:                                      not yet dispatched
+#:     serve_after_dispatch_before_ack  device state committed, clients
+#:                                      not yet acked / served records
+#:                                      not yet logged
+SERVE_CRASH_POINTS = (
+    "serve_after_wal_before_dispatch",
+    "serve_mid_batch",
+    "serve_after_dispatch_before_ack",
+)
+
+ALL_CRASH_POINTS = CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError
 #: (ENOENT) may be added to a plan's ``errors`` to simulate NFS

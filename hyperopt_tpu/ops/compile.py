@@ -335,11 +335,62 @@ class ProgramParams:
     n_obs: int = 128
     batch: int = 4
     k_spec: int = 8
+    #: study-axis width the serve-batched program contracts are pinned
+    #: at (a small pow2 slot capacity; the family retraces per capacity
+    #: exactly like history buckets, so one representative width pins
+    #: the whole family's IR behavior)
+    n_studies: int = 4
 
     def key_spec(self):
         import jax
 
         return jax.eval_shape(lambda: jax.random.key(0))
+
+    def keys_spec(self, n=None):
+        """[S] stacked PRNG keys (one per study slot)."""
+        import jax
+
+        s = self.n_studies if n is None else int(n)
+        return jax.eval_shape(lambda: jax.random.split(jax.random.key(0), s))
+
+    def study_history_specs(self, n=None):
+        """The four history arrays with a leading study axis -- the
+        :class:`hyperopt_tpu.serve.batched.StudyBatchState` layout."""
+        import jax
+        import jax.numpy as jnp
+
+        s = self.n_studies if n is None else int(n)
+        D, N = self.space.n_dims, self.n_obs
+        return (
+            jax.ShapeDtypeStruct((s, D, N), jnp.float32),
+            jax.ShapeDtypeStruct((s, D, N), jnp.bool_),
+            jax.ShapeDtypeStruct((s, N), jnp.float32),
+            jax.ShapeDtypeStruct((s, N), jnp.bool_),
+        )
+
+    def study_delta_specs(self, n=None):
+        """Per-slot O(D) tell deltas + the apply mask:
+        (vcol, acol, loss, slot, apply)."""
+        import jax
+        import jax.numpy as jnp
+
+        s = self.n_studies if n is None else int(n)
+        D = self.space.n_dims
+        return (
+            jax.ShapeDtypeStruct((s, D), jnp.float32),
+            jax.ShapeDtypeStruct((s, D), jnp.bool_),
+            jax.ShapeDtypeStruct((s,), jnp.float32),
+            jax.ShapeDtypeStruct((s,), jnp.int32),
+            jax.ShapeDtypeStruct((s,), jnp.bool_),
+        )
+
+    def study_mask_spec(self, n=None):
+        """A [S] bool per-slot mask (warm/active flags)."""
+        import jax
+        import jax.numpy as jnp
+
+        s = self.n_studies if n is None else int(n)
+        return jax.ShapeDtypeStruct((s,), jnp.bool_)
 
     def history_specs(self):
         """(values, active, losses, valid) at the registry bucket."""
@@ -411,6 +462,7 @@ _PROGRAM_MODULES = (
     "hyperopt_tpu.device_loop",
     "hyperopt_tpu.parallel.sharded",
     "hyperopt_tpu.ops.pallas_kernels",
+    "hyperopt_tpu.serve.batched",
 )
 
 

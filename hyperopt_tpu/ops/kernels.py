@@ -27,6 +27,7 @@ __all__ = [
     "DEFAULT_ABOVE_CAP",
     "HistoryState",
     "apply_delta",
+    "apply_delta_masked",
     "check_prior_weight",
     "compact_gmm",
     "forgetting_weights",
@@ -101,6 +102,27 @@ def apply_delta(values, active, losses, valid, vcol, acol, loss, idx):
         valid, jnp.ones((1,), valid.dtype), (idx,)
     )
     return HistoryState(values, active, losses, valid)
+
+
+def apply_delta_masked(values, active, losses, valid, vcol, acol, loss,
+                       idx, apply):
+    """:func:`apply_delta` gated by a traced ``apply`` flag.
+
+    The per-slot form the study-batched service engine
+    (:mod:`hyperopt_tpu.serve.batched`) vmaps over a leading study
+    axis: slots WITH a staged tell apply their O(D) delta, slots
+    without pass their state through untouched -- one program shape
+    covers every tell/no-tell mix, so join/leave churn never retraces.
+    ``jnp.where(True, new, old)`` selects ``new`` elementwise, so an
+    applying slot's state is bitwise :func:`apply_delta`'s output and
+    a skipping slot's is bitwise its input -- the per-study parity
+    contract of the batched engine reduces to PR 4's.
+    """
+    new = apply_delta(values, active, losses, valid, vcol, acol, loss, idx)
+    old = (values, active, losses, valid)
+    return HistoryState(
+        *(jnp.where(apply, n, o) for n, o in zip(new, old))
+    )
 
 
 def check_prior_weight(prior_weight):

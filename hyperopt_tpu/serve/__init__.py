@@ -1,0 +1,36 @@
+"""graftserve: the multi-tenant suggestion service.
+
+The "millions of users" scenario (ROADMAP open item 1) is not one giant
+study -- it is thousands of small concurrent ones arriving as traffic.
+This package batches the paper's ask/tell plugin boundary ACROSS studies
+the way LLM serving batches requests (continuous batching):
+
+* :mod:`.batched` -- the device engine: N independent studies' resident
+  :class:`~hyperopt_tpu.ops.kernels.HistoryState`\\ s stacked along a
+  leading study axis (:class:`~.batched.StudyBatchState`) and the fused
+  tell+ask program ``vmap``-ed over it, so ONE dispatch serves every
+  active study's ask;
+* :mod:`.scheduler` -- the continuous-batching scheduler: a slotted
+  batch (fixed pow2 capacities + an active-slot mask, so studies join
+  and leave without retracing) that coalesces incoming asks under a
+  max-wait / max-batch budget, with per-study rstate streams keeping
+  every suggestion sequence deterministic regardless of batching order;
+* :mod:`.service` -- the front: an in-process ``StudyHandle`` API
+  (``create_study / ask / tell / best``), per-study WAL-backed
+  durability (PR-6 :class:`~hyperopt_tpu.utils.wal.TellWAL` machinery,
+  exactly-once tells across a service crash), and a stdlib JSON-line
+  socket transport behind the ``hyperopt-tpu-serve`` console script.
+"""
+
+__all__ = ["StudyHandle", "SuggestService"]
+
+
+def __getattr__(name):
+    # lazy: the graftir registry imports ``serve.batched`` on every
+    # lint/bench run; pulling the scheduler/service front along would
+    # be dead weight there
+    if name in __all__:
+        from . import service
+
+        return getattr(service, name)
+    raise AttributeError(name)

@@ -1,0 +1,305 @@
+"""The study-batched device engine: one dispatch serves N studies.
+
+PR 4 made a single study's tell+ask one device dispatch over a resident
+:class:`~hyperopt_tpu.ops.kernels.HistoryState`.  This module stacks N
+independent studies' states along a leading study axis
+(:class:`StudyBatchState`) and ``vmap``\\ s the very same per-study
+suggest closure over it, so one compiled program applies every slot's
+staged O(D) tell delta AND draws every slot's next suggestion -- the
+fused tell+ask of the sequential driver, amortized across tenants.
+
+Parity contract: the per-slot body is the UNJITTED closure the solo
+builders jit (``build_suggest_fn(..., raw=True)`` /
+``build_anneal_fn(..., raw=True)``), the delta write is
+:func:`~hyperopt_tpu.ops.kernels.apply_delta_masked` (bitwise
+:func:`~hyperopt_tpu.ops.kernels.apply_delta` where the mask applies),
+and regime selection is an elementwise ``where`` between the warm
+suggestion and the prior draw computed from the same per-study key --
+so slot ``i`` of a batched dispatch is bitwise-identical to the solo
+fused path run on study ``i``'s state alone (pinned per-study against
+the unbatched programs in ``tests/test_serve.py``).
+
+Shape discipline: all studies share one space template, one obs-bucket
+width (the max of the per-study pow2 buckets) and one pow2 SLOT
+capacity, so the program family retraces only on bucket/capacity
+growth -- studies joining and leaving a slotted batch reuse the same
+trace, exactly like history growth in the solo path.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+__all__ = [
+    "StudyBatchState",
+    "build_batched_step_fn",
+    "build_batched_delta_fn",
+    "stack_states",
+    "slot_capacity",
+    "MIN_SLOTS",
+]
+
+#: smallest slot capacity a batch is allocated at; capacities grow by
+#: pow2 doubling up to the scheduler's ``max_batch`` (same bounded-
+#: recompile argument as ObsBuffer's history buckets).
+MIN_SLOTS = 4
+
+
+class StudyBatchState(NamedTuple):
+    """N stacked :class:`~hyperopt_tpu.ops.kernels.HistoryState`\\ s.
+
+    The four dense history arrays with a leading study axis -- the
+    device-resident state of one slotted batch.  Slot ``i`` IS study
+    ``i``'s ``HistoryState`` (``jax.tree.map(lambda a: a[i], state)``),
+    so every per-study invariant of the solo resident mirror carries
+    over slot-wise; freed slots hold garbage behind the scheduler's
+    active-slot mask and are never read back.
+    """
+
+    values: object  # [S, D, cap] natural-space draws
+    active: object  # [S, D, cap] per-dim activity mask
+    losses: object  # [S, cap]
+    valid: object   # [S, cap] slot occupancy (per-study prefix mask)
+
+
+def slot_capacity(n_studies, max_batch):
+    """The pow2 slot capacity a batch of ``n_studies`` runs at:
+    doubling from :data:`MIN_SLOTS`, clamped to ``max_batch`` (the
+    scheduler's configured ceiling)."""
+    cap = MIN_SLOTS
+    while cap < n_studies and cap < max_batch:
+        cap <<= 1
+    return min(cap, max_batch)
+
+
+def stack_states(buffers, slot_cap, bucket):
+    """Stack per-study host buffers into a device StudyBatchState.
+
+    ``buffers`` maps slot index -> ObsBuffer (missing slots are zero
+    history -- freed or never-joined, masked out by the scheduler).
+    One ``device_put`` of the stacked arrays; the upload that happens
+    on joins, bucket growth, and out-of-order re-materializations (the
+    log schedule of the solo resident mirror, batch-wide).
+    Returns ``(state, nbytes)``.
+    """
+    import jax
+
+    d = None
+    for buf in buffers.values():
+        d = buf.space.n_dims
+        break
+    if d is None:
+        raise ValueError("stack_states needs at least one study buffer")
+    s = int(slot_cap)
+    b = int(bucket)
+    values = np.zeros((s, d, b), dtype=np.float32)
+    active = np.zeros((s, d, b), dtype=bool)
+    losses = np.zeros((s, b), dtype=np.float32)
+    valid = np.zeros((s, b), dtype=bool)
+    for i, buf in buffers.items():
+        # a sibling's host capacity may trail the batch bucket (the
+        # bucket tracks the LARGEST study); its tail stays zero/invalid
+        w = min(buf.values.shape[1], b)
+        values[i, :, :w] = buf.values[:, :w]
+        active[i, :, :w] = buf.active[:, :w]
+        losses[i, :w] = buf.losses[:w]
+        valid[i, :w] = buf.valid[:w]
+    arrays = (values, active, losses, valid)
+    nbytes = sum(a.nbytes for a in arrays)
+    return StudyBatchState(*(jax.device_put(a) for a in arrays)), nbytes
+
+
+def _dummy_delta(ps, slot_cap):
+    """Host-side no-op delta rows for slots with nothing staged (the
+    ``apply=False`` mask makes them pure pass-through on device)."""
+    d = ps.n_dims
+    s = int(slot_cap)
+    return (
+        np.zeros((s, d), dtype=np.float32),
+        np.zeros((s, d), dtype=bool),
+        np.zeros((s,), dtype=np.float32),
+        np.zeros((s,), dtype=np.int32),
+        np.zeros((s,), dtype=bool),
+    )
+
+
+def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
+                          prior_weight=1.0, n_cand_cat=None,
+                          above_cap=None, avg_best_idx=2.0,
+                          shrink_coef=0.1):
+    """Compile (once per parameterization) the batched fused tell+ask
+    step for a PackedSpace.
+
+    Returns jitted ``fn(keys, values, active, losses, valid, vcol,
+    acol, loss, idx, apply, warm, batch) -> (values', active', losses',
+    valid', new_values [S, D, B], new_active [S, D, B])`` with
+    ``batch`` static and the four state buffers DONATED -- the stacked
+    twin of ``build_suggest_fn(state_io=True)``.
+
+    Per slot: the staged delta applies where ``apply`` is set
+    (:func:`~hyperopt_tpu.ops.kernels.apply_delta_masked`), then the
+    suggestion is drawn from the updated slot state -- through the
+    solo algo closure where ``warm`` is set, through the prior program
+    otherwise (the startup regime), both from the SAME per-slot key, so
+    each slot's output is bitwise the solo path's for that regime.
+    Slots without a pending ask receive a placeholder key and their
+    suggestion columns are simply never read back.
+
+    ``algo`` selects the per-study suggest body: ``"tpe"``
+    (:func:`hyperopt_tpu.tpe_jax.build_suggest_fn`) or ``"anneal"``
+    (:func:`hyperopt_tpu.anneal_jax.build_anneal_fn`).
+
+    The jitted program is cached ON the PackedSpace (the
+    ``cached_suggest_fn`` pattern): a restarted service over the same
+    compiled space -- the crash-recovery loop -- reuses the program and
+    its traces instead of recompiling.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import kernels as K
+
+    cache_key = (
+        str(algo), int(n_cand), float(gamma), float(lf),
+        float(prior_weight),
+        None if n_cand_cat is None else int(n_cand_cat),
+        None if above_cap is None else int(above_cap),
+        float(avg_best_idx), float(shrink_coef),
+    )
+    cache = getattr(ps, "_serve_step_cache", None)
+    if cache is None:
+        cache = {}
+        ps._serve_step_cache = cache
+    cached = cache.get(cache_key)
+    if cached is not None:
+        return cached
+
+    if algo == "tpe":
+        from ..tpe_jax import _resolve_above_cap, build_suggest_fn
+
+        core = build_suggest_fn(
+            ps, int(n_cand), float(gamma), float(lf), float(prior_weight),
+            n_cand_cat=n_cand_cat,
+            above_cap=0 if _resolve_above_cap(above_cap) is None
+            else _resolve_above_cap(above_cap),
+            raw=True,
+        )
+    elif algo == "anneal":
+        from ..anneal_jax import build_anneal_fn
+
+        core = build_anneal_fn(
+            ps, float(avg_best_idx), float(shrink_coef), raw=True
+        )
+    else:
+        raise ValueError(f"unknown serve algo {algo!r}")
+    _ = ps._consts  # materialize constants outside the trace
+
+    def step(keys, values, active, losses, valid, vcol, acol, loss, idx,
+             apply, warm, batch):
+        def one(key, v, a, l, vd, vc, ac, lo, ix, ap, wm):
+            st = K.apply_delta_masked(v, a, l, vd, vc, ac, lo, ix, ap)
+            warm_v, warm_a = core(key, *st, batch)
+            pri_v, pri_a = ps.sample_prior_fn(key, batch)
+            nv = jnp.where(wm, warm_v, pri_v)
+            na = jnp.where(wm, warm_a, pri_a)
+            return tuple(st) + (nv, na)
+
+        return jax.vmap(one)(
+            keys, values, active, losses, valid, vcol, acol, loss, idx,
+            apply, warm,
+        )
+
+    fn = jax.jit(
+        step, static_argnames=("batch",), donate_argnums=(1, 2, 3, 4)
+    )
+    cache[cache_key] = fn
+    return fn
+
+
+_BATCHED_DELTA_FN = None  # lazily-built; shared by every scheduler
+
+
+def build_batched_delta_fn():
+    """The stacked twin of the standalone O(D) delta-tell program:
+    ``fn(values, active, losses, valid, vcol, acol, loss, idx, apply)``
+    -- one dispatch applies (at most) one staged delta per slot, the
+    backlog-drain path when a study told more than once between asks.
+    Donated state, like the solo ``_apply_delta_fn`` (and like it,
+    built once per process -- it has no space dependence)."""
+    global _BATCHED_DELTA_FN
+    if _BATCHED_DELTA_FN is None:
+        import jax
+
+        from ..ops.kernels import apply_delta_masked
+
+        _BATCHED_DELTA_FN = jax.jit(
+            jax.vmap(apply_delta_masked), donate_argnums=(0, 1, 2, 3)
+        )
+    return _BATCHED_DELTA_FN
+
+
+# ---------------------------------------------------------------------------
+# graftir registrations (hyperopt-tpu-lint --ir): the batched families
+# ---------------------------------------------------------------------------
+
+from ..ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "serve.batched_step",
+    families=("hyperopt_tpu.serve.batched:build_batched_step_fn",),
+)
+def _registry_serve_step(p):
+    """The service's one-dispatch-per-round program: every slot's
+    staged tell applied and every slot's ask drawn, vmapped over the
+    study axis (donated stacked state)."""
+    fn = build_batched_step_fn(p.space, algo="tpe", n_cand=16)
+    return ProgramCapture(
+        fn=fn,
+        args=(p.keys_spec(),) + p.study_history_specs()
+        + p.study_delta_specs() + (p.study_mask_spec(),),
+        kwargs={"batch": 1},
+        donate_argnums=(1, 2, 3, 4),
+        # vmap of closures whose GL402 promotion behavior is already
+        # pinned by their solo registrations (tpe_jax.suggest,
+        # compile.sample_prior, jax_trials.apply_delta) -- skip the
+        # duplicate re-trace, same precedent as speculative_redraw
+        x64_check=False,
+    )
+
+
+@register_program(
+    "serve.batched_anneal_step",
+    families=("hyperopt_tpu.serve.batched:build_batched_step_fn",),
+)
+def _registry_serve_anneal_step(p):
+    """The annealing twin of ``serve.batched_step`` (same stacked
+    state contract, anneal per-study body)."""
+    fn = build_batched_step_fn(p.space, algo="anneal")
+    return ProgramCapture(
+        fn=fn,
+        args=(p.keys_spec(),) + p.study_history_specs()
+        + p.study_delta_specs() + (p.study_mask_spec(),),
+        kwargs={"batch": 1},
+        donate_argnums=(1, 2, 3, 4),
+        # constituent closures x64-pinned by anneal_jax.suggest /
+        # compile.sample_prior / jax_trials.apply_delta
+        x64_check=False,
+    )
+
+
+@register_program(
+    "serve.batched_apply_delta",
+    families=("hyperopt_tpu.ops.kernels:apply_delta_masked",),
+)
+def _registry_serve_delta(p):
+    """The backlog-drain program: one masked O(D) delta per slot,
+    donated stacked state (the batched ``jax_trials.apply_delta``)."""
+    fn = build_batched_delta_fn()
+    return ProgramCapture(
+        fn=fn,
+        args=p.study_history_specs() + p.study_delta_specs(),
+        donate_argnums=(0, 1, 2, 3),
+    )

@@ -1,0 +1,486 @@
+"""The continuous-batching scheduler: coalesce many studies' asks into
+one device dispatch.
+
+The LLM-serving idiom applied to the ask/tell plugin boundary: incoming
+asks queue up; a dispatch round picks at most one ask per study, fills
+a SLOTTED batch (fixed pow2 slot capacities + an active-slot mask, so
+studies join and leave without retracing -- :func:`~hyperopt_tpu.serve.
+batched.slot_capacity`), rides every slot's staged O(D) tell delta
+along, and runs ONE :func:`~hyperopt_tpu.serve.batched.
+build_batched_step_fn` program for the whole round.  A background
+thread drives rounds under a latency/occupancy budget (``max_wait``
+deadline after the oldest queued ask, early dispatch once every joined
+study has an ask queued); tests and the chaos suite drive :meth:`
+BatchScheduler.step` synchronously instead, so simulated crashes
+propagate to the caller.
+
+Determinism: each study draws its per-ask seed from its OWN
+``np.random.Generator`` stream at SUBMIT time, so the suggestion
+sequence of a study is a pure function of its seed and its own
+tell history -- independent of batching order, sibling churn, or slot
+placement (the 64-study bitwise pin in ``tests/test_serve.py``).
+
+Tells are absorbed synchronously: WAL append (durability first), host
+``ObsBuffer.add``, then an O(D) delta staged for the slot -- exactly
+the PR-4 resident-mirror protocol, per slot.  A backlog past one delta
+drains through the batched masked-delta program; out-of-order (late)
+tells and bucket growth re-materialize the stacked state from host
+truth, the same log schedule as the solo resident mirror.
+"""
+
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..distributed.faults import REAL_FS
+from ..jax_trials import MAX_PENDING_DELTAS, MIN_CAPACITY, ObsBuffer
+from .batched import (
+    StudyBatchState,
+    _dummy_delta,
+    build_batched_delta_fn,
+    build_batched_step_fn,
+    slot_capacity,
+    stack_states,
+)
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["BatchScheduler", "ServeStudy", "dense_to_vals"]
+
+
+def dense_to_vals(ps, col_v, col_a):
+    """One dense suggestion column -> the {label: value} config dict at
+    API types (ints for categorical-family dims, inactive conditional
+    dims omitted) -- the serve twin of ``tpe_jax._cast_vals``."""
+    cat = {int(d) for d in ps.cat_idx}
+    vals = {}
+    for d, label in enumerate(ps.labels):
+        if col_a[d]:
+            v = float(col_v[d])
+            vals[label] = int(round(v)) if d in cat else v
+    return vals
+
+
+class ServeStudy:
+    """One tenant: host-truth history + seed stream + slot bookkeeping.
+
+    The host :class:`~hyperopt_tpu.jax_trials.ObsBuffer` is
+    authoritative (exactly as in the solo resident path); the device
+    only ever holds a slot-wise mirror of it.
+    """
+
+    def __init__(self, name, seed, ps):
+        self.name = name
+        self.seed = int(seed)
+        self.rstate = np.random.default_rng(self.seed)
+        self.buf = ObsBuffer(ps)
+        self.slot = None
+        self.pending = collections.deque()  # staged (vcol, acol, loss, idx)
+        self.dirty = True  # device slot needs re-materialization
+        self.closed = False
+        self.next_tid = 0
+        self.n_asks = 0
+        self.n_tells = 0
+        self.outstanding = {}  # tid -> served vals (awaiting their tell)
+        self.persist = None  # durability hooks (service wires them)
+
+    def best(self):
+        """(loss, vals) of the best finite completed trial, or None --
+        recomputed from the buffer, so it survives restore for free."""
+        buf = self.buf
+        ok = buf.valid[: buf.count] & np.isfinite(buf.losses[: buf.count])
+        if not ok.any():
+            return None
+        i = int(np.argmin(np.where(ok, buf.losses[: buf.count], np.inf)))
+        return float(buf.losses[i]), dense_to_vals(
+            buf.space, buf.values[:, i], buf.active[:, i]
+        )
+
+
+class _AskRequest:
+    __slots__ = ("study", "tid", "seed", "future", "t_submit")
+
+    def __init__(self, study, tid, seed):
+        self.study = study
+        self.tid = tid
+        self.seed = seed
+        self.future = Future()
+        self.t_submit = time.perf_counter()
+
+
+class BatchScheduler:
+    """The slotted continuous-batching engine for one space template.
+
+    ``max_batch`` caps the slot capacity (and so the number of
+    concurrently open studies); ``max_wait`` is the latency budget a
+    queued ask may wait for co-batching before the background loop
+    dispatches anyway.  ``algo`` is ``"tpe"`` or ``"anneal"``;
+    ``algo_kw`` passes through to :func:`~hyperopt_tpu.serve.batched.
+    build_batched_step_fn`.  ``fs`` is the PR-3 fault-injection seam --
+    the serve chaos points fire through it.
+
+    Deterministic counters (never timing): ``dispatch_count`` (batched
+    step programs run), ``delta_drain_dispatches`` (backlog-drain
+    programs, included in ``dispatch_count``), ``upload_events`` /
+    ``upload_bytes`` (stacked re-materializations), ``joins``,
+    ``rebuckets``.  ``ask_latencies`` / ``occupancy`` feed the bench.
+    """
+
+    def __init__(self, ps, algo="tpe", max_batch=64, max_wait=0.002,
+                 n_startup_jobs=20, fs=REAL_FS, **algo_kw):
+        self.ps = ps
+        self.algo = str(algo)
+        self.max_batch = int(max_batch)
+        self.max_wait = float(max_wait)
+        self.n_startup_jobs = int(n_startup_jobs)
+        self.fs = fs
+        self.algo_kw = dict(algo_kw)
+        if self.algo == "tpe":
+            from ..tpe_jax import _resolve_above_cap
+
+            self._pow2_cap = _resolve_above_cap(
+                self.algo_kw.get("above_cap")
+            )
+        else:
+            self._pow2_cap = None
+        self._step_fn = build_batched_step_fn(
+            ps, algo=self.algo, **self.algo_kw
+        )
+        self._delta_fn = build_batched_delta_fn()
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._asks = collections.deque()
+        self._studies = {}
+        self._slots = {}  # slot index -> ServeStudy
+        self._free = []
+        self._state = None  # StudyBatchState (device)
+        self._slot_cap = 0
+        self._bucket = MIN_CAPACITY
+        self._materialize = True
+        self._thread = None
+        self._stopping = False
+
+        # deterministic accounting
+        self.dispatch_count = 0
+        self.delta_drain_dispatches = 0
+        self.upload_events = 0
+        self.upload_bytes = 0
+        self.joins = 0
+        self.rebuckets = 0
+        self.ask_latencies = []
+        self.occupancy = []
+
+    # -- tenancy -----------------------------------------------------------
+    def open_study(self, name, seed=0, study=None):
+        """Join a (new or restored) study to the slotted batch."""
+        with self._lock:
+            if name in self._studies:
+                raise ValueError(f"study {name!r} already open")
+            if len(self._studies) >= self.max_batch:
+                raise ValueError(
+                    f"batch capacity {self.max_batch} studies reached; "
+                    "close a study or raise max_batch"
+                )
+            st = study if study is not None else ServeStudy(
+                name, seed, self.ps
+            )
+            if self._free:
+                st.slot = self._free.pop()
+            else:
+                st.slot = len(self._studies)
+            st.dirty = True
+            self._studies[name] = st
+            self._slots[st.slot] = st
+            self.joins += 1
+            self._materialize = True
+            return st
+
+    def close_study(self, name):
+        """Leave: free the slot (device data becomes garbage behind the
+        active-slot mask -- siblings are untouched, no re-upload)."""
+        with self._lock:
+            st = self._studies.pop(name)
+            st.closed = True
+            self._slots.pop(st.slot, None)
+            self._free.append(st.slot)
+            self._free.sort(reverse=True)  # reuse lowest slots first
+            st.slot = None
+            return st
+
+    def study(self, name):
+        with self._lock:
+            return self._studies[name]
+
+    # -- tell --------------------------------------------------------------
+    def tell(self, study, tid, vals, loss):
+        """Absorb one completed trial: WAL first, host buffer second,
+        device delta staged third.  Synchronous -- the durability
+        barrier is the WAL append, and the host add is O(D).
+
+        Idempotent by tid: a client re-telling work whose ack a
+        crashed service lost (the tell may already have been WAL-
+        replayed on restore) is absorbed exactly once."""
+        with self._lock:
+            buf = study.buf
+            if (buf.tids[: buf.count] == int(tid)).any():
+                study.outstanding.pop(tid, None)
+                return
+            if study.persist is not None:
+                study.persist.log_tell(tid, vals, loss)
+            self.fs.crashpoint("serve_after_wal_before_dispatch")
+            self._apply_tell(study, tid, vals, loss)
+            study.outstanding.pop(tid, None)
+
+    def _apply_tell(self, study, tid, vals, loss):
+        """Host-side tell application (shared with WAL replay, which
+        must skip the durability hooks it is replaying from)."""
+        buf = study.buf
+        n = buf.count
+        in_order = n == 0 or tid > int(buf.tids[n - 1])
+        buf.add(dict(vals), float(loss), tid=int(tid))
+        study.n_tells += 1
+        study.next_tid = max(study.next_tid, int(tid) + 1)
+        if (
+            in_order
+            and not study.dirty
+            and len(study.pending) < MAX_PENDING_DELTAS
+        ):
+            study.pending.append((
+                n,
+                buf.values[:, n].copy(),
+                buf.active[:, n].copy(),
+                float(loss),
+            ))
+        else:
+            # late completion shifted the tail (or the backlog is past
+            # the crossover): slot re-materializes from host truth
+            study.dirty = True
+            study.pending.clear()
+
+    # -- ask ---------------------------------------------------------------
+    def submit_ask(self, study):
+        """Queue one ask; returns ``(tid, Future)``.  The per-ask seed
+        is drawn HERE, from the study's own stream -- the batching
+        order downstream can no longer affect the suggestion."""
+        with self._lock:
+            if study.closed:
+                raise ValueError(f"study {study.name!r} is closed")
+            seed = int(study.rstate.integers(2**31 - 1))
+            tid = study.next_tid
+            study.next_tid = tid + 1
+            study.n_asks += 1
+            if study.persist is not None:
+                study.persist.log_ask(tid, seed, study.rstate)
+            req = _AskRequest(study, tid, seed)
+            self._asks.append(req)
+            self._cond.notify_all()
+            return tid, req.future
+
+    # -- the dispatch round ------------------------------------------------
+    def _compute_bucket(self):
+        b = MIN_CAPACITY
+        for st in self._slots.values():
+            b = max(b, st.buf._device_bucket(self._pow2_cap))
+        return b
+
+    def _rematerialize(self, slot_cap, bucket):
+        buffers = {st.slot: st.buf for st in self._slots.values()}
+        if not buffers:
+            self._state = None
+            return
+        self._state, nbytes = stack_states(buffers, slot_cap, bucket)
+        self.upload_events += 1
+        self.upload_bytes += nbytes
+        for st in self._slots.values():
+            st.dirty = False
+            st.pending.clear()  # host truth already includes them
+
+    def _maintain(self):
+        """Bring the stacked state up to date with tenancy/host truth:
+        slot-capacity growth, obs-bucket growth, joins, dirty slots --
+        all absorbed by ONE re-materialization; then drain any
+        remaining multi-delta backlog down to one staged tell per slot
+        (the fused dispatch absorbs the last one)."""
+        slot_cap = max(
+            slot_capacity(len(self._studies), self.max_batch),
+            self._slot_cap,  # capacities never shrink mid-flight
+        )
+        bucket = self._compute_bucket()
+        if slot_cap != self._slot_cap or bucket != self._bucket:
+            if self._state is not None:
+                self.rebuckets += 1
+            self._materialize = True
+        if any(st.dirty for st in self._slots.values()):
+            self._materialize = True
+        if self._materialize:
+            self._slot_cap, self._bucket = slot_cap, bucket
+            self._rematerialize(slot_cap, bucket)
+            self._materialize = False
+            return
+        # backlog drain: one masked delta per slot per dispatch, FIFO
+        while any(len(st.pending) > 1 for st in self._slots.values()):
+            vcol, acol, dloss, didx, dapply = _dummy_delta(
+                self.ps, self._slot_cap
+            )
+            for st in self._slots.values():
+                if len(st.pending) > 1:
+                    n, vc, ac, lo = st.pending.popleft()
+                    vcol[st.slot] = vc
+                    acol[st.slot] = ac
+                    dloss[st.slot] = lo
+                    didx[st.slot] = n
+                    dapply[st.slot] = True
+            out = self._delta_fn(
+                *self._state, vcol, acol, dloss, didx, dapply
+            )
+            self._state = StudyBatchState(*out)
+            self.dispatch_count += 1
+            self.delta_drain_dispatches += 1
+
+    def _pick_round(self):
+        """At most one queued ask per study this round, FIFO."""
+        picked, leftover, seen = [], collections.deque(), set()
+        while self._asks:
+            req = self._asks.popleft()
+            if req.study.closed:
+                req.future.set_exception(
+                    ValueError(f"study {req.study.name!r} closed")
+                )
+                continue
+            if id(req.study) in seen or len(picked) >= self.max_batch:
+                leftover.append(req)
+                continue
+            seen.add(id(req.study))
+            picked.append(req)
+        self._asks = leftover
+        return picked
+
+    def step(self):
+        """One dispatch round: returns the number of asks served.
+        Synchronous entry point -- the background loop calls this, and
+        tests/chaos harnesses call it directly so crashes propagate."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..jax_trials import host_key
+
+        with self._lock:
+            picked = self._pick_round()
+            if not picked:
+                # tells without asks stay staged (or dirty) until the
+                # next ask round -- a tell-only window never dispatches
+                return 0
+            self._maintain()
+            s = self._slot_cap
+            dummy = host_key(0)
+            keys = [dummy] * s
+            warm = np.zeros(s, dtype=bool)
+            vcol, acol, dloss, didx, dapply = _dummy_delta(self.ps, s)
+            for st in self._slots.values():
+                if st.pending:  # at most one left after _maintain
+                    n, vc, ac, lo = st.pending.popleft()
+                    vcol[st.slot] = vc
+                    acol[st.slot] = ac
+                    dloss[st.slot] = lo
+                    didx[st.slot] = n
+                    dapply[st.slot] = True
+                warm[st.slot] = (
+                    st.buf.count > 0
+                    if self.algo == "anneal"
+                    else st.buf.count >= self.n_startup_jobs
+                )
+            for req in picked:
+                keys[req.study.slot] = host_key(req.seed % (2**31 - 1))
+            self.fs.crashpoint("serve_mid_batch")
+            out = self._step_fn(
+                jnp.stack(keys), *self._state, vcol, acol, dloss, didx,
+                dapply, warm, batch=1,
+            )
+            self._state = StudyBatchState(*out[:4])
+            self.dispatch_count += 1
+            new_v, new_a = jax.device_get((out[4], out[5]))
+            new_v = np.asarray(new_v)
+            new_a = np.asarray(new_a)
+            self.fs.crashpoint("serve_after_dispatch_before_ack")
+            now = time.perf_counter()
+            self.occupancy.append(len(picked) / s)
+            results = []
+            for req in picked:
+                st = req.study
+                vals = dense_to_vals(
+                    self.ps, new_v[st.slot, :, 0], new_a[st.slot, :, 0]
+                )
+                if st.persist is not None:
+                    st.persist.log_served(req.tid, vals)
+                st.outstanding[req.tid] = vals
+                self.ask_latencies.append(now - req.t_submit)
+                results.append((req, vals))
+            # acks last: a crash above leaves every pick un-acked and
+            # replayable, never half-acked
+            for req, vals in results:
+                req.future.set_result((req.tid, vals))
+            return len(picked)
+
+    # -- background loop ---------------------------------------------------
+    def start(self):
+        """Run the continuous-batching loop on a daemon thread."""
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stopping = False
+            self._thread = threading.Thread(
+                target=self._loop, name="graftserve-batcher", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+            t = self._thread
+            self._thread = None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _ready(self):
+        """Dispatch early once every open study has an ask queued (or
+        the queue already fills the batch)."""
+        distinct = {id(r.study) for r in self._asks}
+        return len(distinct) >= min(
+            max(len(self._studies), 1), self.max_batch
+        )
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._asks and not self._stopping:
+                    self._cond.wait(timeout=0.05)
+                if self._stopping:
+                    return
+                deadline = self._asks[0].t_submit + self.max_wait
+                while (
+                    not self._stopping
+                    and not self._ready()
+                    and (remaining := deadline - time.perf_counter()) > 0
+                ):
+                    self._cond.wait(timeout=min(remaining, 0.05))
+                if self._stopping:
+                    return
+            try:
+                self.step()
+            except BaseException:
+                # a dying batcher must not strand blocked clients
+                with self._lock:
+                    while self._asks:
+                        req = self._asks.popleft()
+                        req.future.set_exception(
+                            RuntimeError("serve batcher died")
+                        )
+                raise

@@ -1,0 +1,548 @@
+"""The service front: study handles, WAL durability, socket transport.
+
+Three layers on top of the :mod:`~hyperopt_tpu.serve.scheduler`:
+
+* :class:`SuggestService` / :class:`StudyHandle` -- the in-process API
+  (``create_study / ask / tell / best``), the multi-tenant twin of the
+  paper's ask/tell plugin boundary;
+* :class:`StudyPersistence` -- per-study durability riding the PR-6
+  machinery: every tell is appended to a :class:`~hyperopt_tpu.utils.
+  wal.TellWAL` (fsync-durable, checksummed, guard-fingerprinted)
+  BEFORE it is applied, ask records carry the post-draw rstate cursor
+  (flush-only -- the next tell's fsync covers them), and cadence-driven
+  snapshot bundles (``durable_pickle`` of the dense history npz + the
+  cursor) compact the log.  A service killed mid-batch restores every
+  study with zero lost / zero duplicated tells and a suggestion stream
+  that continues exactly where it stopped;
+* a stdlib JSON-line TCP transport (:func:`serve_forever`) behind the
+  ``hyperopt-tpu-serve`` console script, so external clients drive the
+  same API over a socket -- one JSON object per line, one reply line
+  per request.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+
+import numpy as np
+
+from ..distributed.faults import REAL_FS
+from ..ops.compile import compile_space
+from ..utils.wal import TellWAL
+from .scheduler import BatchScheduler, ServeStudy
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "StudyHandle",
+    "StudyPersistence",
+    "SuggestService",
+    "serve_forever",
+    "main",
+]
+
+_NAME_RE = re.compile(r"[A-Za-z0-9._-]{1,120}")
+
+#: compiled spaces keyed by structural fingerprint: a RESTARTED service
+#: over the same space (the crash-recovery loop, and every test
+#: harness) reuses the PackedSpace -- and with it the program cache the
+#: batched builders hang off it -- instead of recompiling from scratch.
+_PS_CACHE = {}
+
+
+def _compile_space_cached(space):
+    from ..hyperband import _space_fingerprint
+    from ..pyll.base import as_apply
+
+    fp = _space_fingerprint(as_apply(space))
+    ps = _PS_CACHE.get(fp)
+    if ps is None:
+        ps = compile_space(space)
+        _PS_CACHE[fp] = ps
+    return ps
+
+
+def _study_guard(algo, space):
+    """The study-family fingerprint stamped into every WAL/snapshot
+    (PR-3/6 guard discipline): restoring a study dir written by a
+    different space or algo silently changes the experiment and must
+    be refused instead."""
+    from ..hyperband import _space_fingerprint
+    from ..pyll.base import as_apply
+
+    return ["graftserve", 1, str(algo), _space_fingerprint(as_apply(space))]
+
+
+class StudyPersistence:
+    """Per-study WAL + snapshot bundle rooted at ``<root>/<name>``.
+
+    Artifacts: ``<name>.wal`` (the :class:`TellWAL`: ``open`` / ``ask``
+    / ``served`` / ``tell`` records) and ``<name>.snap`` (the durable
+    snapshot bundle the WAL compacts into every ``cadence`` tells).
+    Write-ahead ordering is the crash-recovery contract: a tell is on
+    disk before the host buffer mutates, so replay after a crash is
+    exactly-once (dedup by tid)."""
+
+    def __init__(self, root, name, guard, fs=REAL_FS, cadence=256):
+        self.root = str(root)
+        self.name = name
+        self.fs = fs
+        self.cadence = max(1, int(cadence))
+        self.fs.makedirs(self.root, exist_ok=True)
+        base = os.path.join(self.root, name)
+        self.snap_path = base + ".snap"
+        self.wal = TellWAL(base + ".wal", fs=fs, guard=guard)
+        self._tells_since_snap = 0
+
+    def exists(self):
+        return self.wal.exists() or self.fs.exists(self.snap_path)
+
+    # -- write-ahead records ----------------------------------------------
+    def log_open(self, seed):
+        self.wal.append("open", {"seed": int(seed)})
+
+    def log_ask(self, tid, seed, rstate):
+        from ..utils.checkpoint import encode_rstate
+
+        # flush-only: a lost ask re-derives bitwise from the restored
+        # cursor; the tell's fsync barrier covers it (PR-6 semantics)
+        self.wal.append("ask", {
+            "tid": int(tid),
+            "seed": int(seed),
+            "rstate": encode_rstate(rstate),
+        }, sync=False)
+
+    def log_served(self, tid, vals):
+        self.wal.append(
+            "served", {"tid": int(tid), "vals": dict(vals)}, sync=False
+        )
+
+    def log_tell(self, tid, vals, loss):
+        self.wal.append("tell", {
+            "tid": int(tid), "vals": dict(vals), "loss": float(loss),
+        })
+        self._tells_since_snap += 1
+
+    # -- snapshot bundles --------------------------------------------------
+    def maybe_snapshot(self, study, force=False):
+        if not force and self._tells_since_snap < self.cadence:
+            return False
+        self.snapshot(study)
+        return True
+
+    def snapshot(self, study):
+        """Publish the durable bundle, then compact the WAL (the PR-6
+        checkpoint protocol: every crash window between the two is
+        covered by tid-dedup replay of the old log)."""
+        from ..distributed import _common
+        from ..utils.checkpoint import (
+            durable_pickle,
+            encode_rstate,
+            obs_buffer_npz_bytes,
+        )
+
+        bundle = {
+            "format": 1,
+            "guard": self.wal.guard,
+            "seed": study.seed,
+            "obs_npz": obs_buffer_npz_bytes(study.buf),
+            "rstate": encode_rstate(study.rstate),
+            "next_tid": int(study.next_tid),
+            "n_asks": int(study.n_asks),
+            "n_tells": int(study.n_tells),
+            "total_tells": int(self.wal.total_tells),
+            "outstanding": {
+                int(t): dict(v) for t, v in study.outstanding.items()
+            },
+        }
+        _common.with_retries(
+            lambda: durable_pickle(bundle, self.snap_path, fs=self.fs),
+            label="serve snapshot",
+        )
+        _common.with_retries(self.wal.reset, label="serve wal reset")
+        self._tells_since_snap = 0
+
+    # -- restore -----------------------------------------------------------
+    def restore(self, ps):
+        """Rebuild the study from snapshot + WAL-suffix replay, or
+        return None when no artifact exists.  Tells replay exactly
+        once (dedup by tid); the rstate cursor of the last logged ask
+        supersedes the snapshot's, so the seed stream continues
+        bitwise where the crashed service stopped."""
+        from ..exceptions import CheckpointError
+        from ..utils.checkpoint import (
+            decode_rstate,
+            load_obs_buffer_bytes,
+            load_pickle_guarded,
+        )
+
+        if not self.exists():
+            return None
+        bundle = None
+        if self.fs.exists(self.snap_path):
+            bundle = load_pickle_guarded(
+                self.snap_path, fs=self.fs, what="study snapshot"
+            )
+            if (
+                self.wal.guard is not None
+                and bundle.get("guard") is not None
+                and list(bundle["guard"]) != list(self.wal.guard)
+            ):
+                raise CheckpointError(
+                    f"study snapshot {self.snap_path!r} was written by "
+                    f"a different study family (guard {bundle['guard']!r}"
+                    f" != {self.wal.guard!r}); refusing to restore"
+                )
+        seed = int(bundle["seed"]) if bundle else 0
+        study = ServeStudy(self.name, seed, ps)
+        if bundle is not None:
+            study.buf = load_obs_buffer_bytes(ps, bundle["obs_npz"])
+            study.rstate = decode_rstate(bundle["rstate"])
+            study.next_tid = int(bundle["next_tid"])
+            study.n_asks = int(bundle["n_asks"])
+            study.n_tells = int(bundle["n_tells"])
+            study.outstanding = {
+                int(t): dict(v)
+                for t, v in bundle.get("outstanding", {}).items()
+            }
+        records = self.wal.replay() if self.wal.exists() else []
+        last_cursor = None
+        for rec in records:
+            kind = rec.get("kind")
+            if kind == "open":
+                study.seed = int(rec["seed"])
+                if bundle is None:
+                    study.rstate = np.random.default_rng(study.seed)
+            elif kind == "ask":
+                study.next_tid = max(study.next_tid, int(rec["tid"]) + 1)
+                last_cursor = rec["rstate"]
+            elif kind == "served":
+                study.outstanding[int(rec["tid"])] = dict(rec["vals"])
+            elif kind == "tell":
+                tid = int(rec["tid"])
+                buf = study.buf
+                if not (buf.tids[: buf.count] == tid).any():
+                    buf.add(dict(rec["vals"]), float(rec["loss"]), tid=tid)
+                    study.n_tells += 1
+                study.next_tid = max(study.next_tid, tid + 1)
+                study.outstanding.pop(tid, None)
+        if last_cursor is not None:
+            study.rstate = decode_rstate(last_cursor)
+        study.dirty = True
+        return study
+
+    def close(self):
+        self.wal.close()
+
+
+class StudyHandle:
+    """One tenant's view of the service: the ask/tell plugin boundary
+    as an object.  ``ask`` returns ``(tid, vals)``; evaluate, then
+    ``tell(tid, loss)`` -- the service remembers what it suggested for
+    every outstanding tid (durably, when a root is configured), so the
+    caller never round-trips the config back."""
+
+    def __init__(self, service, study):
+        self._service = service
+        self._study = study
+
+    @property
+    def name(self):
+        return self._study.name
+
+    def ask_async(self):
+        """Queue one ask; returns a Future of ``(tid, vals)``."""
+        return self._service._ask_async(self._study)
+
+    def ask(self, timeout=60.0):
+        """One suggestion, blocking until its batch is served."""
+        fut = self.ask_async()
+        self._service._drive(fut, timeout)
+        return fut.result(timeout=timeout)
+
+    def tell(self, tid, loss, vals=None):
+        """Report one evaluation.  ``vals`` defaults to what the
+        service served for ``tid``; pass it explicitly when re-telling
+        work whose ack a crashed service lost."""
+        self._service._tell(self._study, tid, loss, vals)
+
+    def best(self):
+        """``{"loss", "vals"}`` of the best completed trial, or None."""
+        out = self._study.best()
+        if out is None:
+            return None
+        loss, vals = out
+        return {"loss": loss, "vals": vals}
+
+    @property
+    def n_tells(self):
+        return self._study.n_tells
+
+    def close(self):
+        self._service.close_study(self.name)
+
+
+class SuggestService:
+    """The multi-tenant suggestion service over one space template.
+
+    ``background=True`` (default) runs the continuous-batching loop on
+    a daemon thread: concurrent ``ask()`` calls from many studies
+    coalesce into shared device dispatches under the ``max_wait_ms``
+    latency budget.  ``background=False`` is the deterministic mode the
+    tests and chaos harness drive: submit with ``ask_async`` and pump
+    rounds explicitly with :meth:`pump` (blocking ``ask`` still works
+    -- it pumps inline).
+
+    ``root`` enables per-study WAL durability (:class:`
+    StudyPersistence`); ``create_study`` then restores any study the
+    root already holds.  ``fs`` is the PR-3 fault seam shared by the
+    scheduler and every WAL/snapshot write.
+    """
+
+    def __init__(self, space, algo="tpe", root=None, max_batch=64,
+                 max_wait_ms=2.0, n_startup_jobs=20, background=True,
+                 fs=REAL_FS, snapshot_cadence=256, **algo_kw):
+        self.space = space
+        self.ps = _compile_space_cached(space)
+        self.root = None if root is None else str(root)
+        self.fs = fs
+        self.snapshot_cadence = int(snapshot_cadence)
+        self._guard = _study_guard(algo, space)
+        self._background = bool(background)
+        self._lock = threading.RLock()
+        self._handles = {}
+        self.scheduler = BatchScheduler(
+            self.ps, algo=algo, max_batch=max_batch,
+            max_wait=float(max_wait_ms) / 1000.0,
+            n_startup_jobs=n_startup_jobs, fs=fs, **algo_kw,
+        )
+        if self._background:
+            self.scheduler.start()
+
+    # -- tenancy -----------------------------------------------------------
+    def create_study(self, name, seed=0):
+        """Open (or re-attach to, or restore) a study by name."""
+        if not _NAME_RE.fullmatch(name):
+            raise ValueError(
+                f"study name {name!r} must match {_NAME_RE.pattern}"
+            )
+        with self._lock:
+            if name in self._handles:
+                return self._handles[name]
+            persist = None
+            study = None
+            if self.root is not None:
+                persist = StudyPersistence(
+                    self.root, name, self._guard, fs=self.fs,
+                    cadence=self.snapshot_cadence,
+                )
+                study = persist.restore(self.ps)
+            if study is None:
+                study = ServeStudy(name, seed, self.ps)
+                if persist is not None:
+                    persist.log_open(seed)
+            study.persist = persist
+            self.scheduler.open_study(name, seed, study=study)
+            handle = StudyHandle(self, study)
+            self._handles[name] = handle
+            return handle
+
+    def close_study(self, name):
+        with self._lock:
+            handle = self._handles.pop(name, None)
+            if handle is None:
+                return
+            study = self.scheduler.close_study(name)
+            if study.persist is not None:
+                study.persist.maybe_snapshot(study, force=True)
+                study.persist.close()
+
+    def studies(self):
+        with self._lock:
+            return sorted(self._handles)
+
+    # -- the handle's plumbing ---------------------------------------------
+    def _ask_async(self, study):
+        _tid, fut = self.scheduler.submit_ask(study)
+        return fut
+
+    def _drive(self, fut, timeout):
+        if self._background:
+            return
+        # deterministic mode: serve rounds inline until this future
+        # resolves (each pump is one coalesced dispatch)
+        import time as _time
+
+        deadline = _time.perf_counter() + timeout
+        while not fut.done():
+            if self.scheduler.step() == 0 and not fut.done():
+                if _time.perf_counter() > deadline:
+                    return
+                _time.sleep(0.001)
+
+    def _tell(self, study, tid, loss, vals=None):
+        if vals is None:
+            vals = study.outstanding.get(tid)
+        if vals is None:
+            raise ValueError(
+                f"study {study.name!r} has no outstanding suggestion "
+                f"for tid {tid}; pass vals= explicitly (e.g. when "
+                "re-telling work a crashed service never acked)"
+            )
+        self.scheduler.tell(study, tid, vals, loss)
+        if study.persist is not None:
+            study.persist.maybe_snapshot(study)
+
+    # -- service-level controls --------------------------------------------
+    def pump(self):
+        """Serve one coalesced round inline (deterministic mode)."""
+        return self.scheduler.step()
+
+    @property
+    def counters(self):
+        s = self.scheduler
+        return {
+            "dispatch_count": s.dispatch_count,
+            "delta_drain_dispatches": s.delta_drain_dispatches,
+            "upload_events": s.upload_events,
+            "upload_bytes": s.upload_bytes,
+            "joins": s.joins,
+            "rebuckets": s.rebuckets,
+        }
+
+    def shutdown(self):
+        self.scheduler.stop()
+        with self._lock:
+            for name in list(self._handles):
+                self.close_study(name)
+
+
+# ---------------------------------------------------------------------------
+# JSON-line socket transport + console script
+# ---------------------------------------------------------------------------
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    if op == "create_study":
+        h = service.create_study(req["name"], seed=int(req.get("seed", 0)))
+        return {"ok": True, "study": h.name, "n_tells": h.n_tells}
+    if op == "studies":
+        return {"ok": True, "studies": service.studies()}
+    name = req.get("study")
+    with service._lock:
+        handle = service._handles.get(name)
+    if handle is None:
+        return {"ok": False, "error": f"unknown study {name!r}"}
+    if op == "ask":
+        tid, vals = handle.ask(timeout=float(req.get("timeout", 60.0)))
+        return {"ok": True, "tid": tid, "vals": vals}
+    if op == "tell":
+        handle.tell(
+            int(req["tid"]), float(req["loss"]), vals=req.get("vals")
+        )
+        return {"ok": True}
+    if op == "best":
+        return {"ok": True, "best": handle.best()}
+    if op == "close_study":
+        handle.close()
+        return {"ok": True}
+    return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+def serve_forever(service, host="127.0.0.1", port=0):
+    """Bind the JSON-line TCP front; returns the (not yet serving)
+    ``ThreadingTCPServer`` -- call ``.serve_forever()`` (the console
+    script does) or drive it from a thread (the tests do).  Protocol:
+    one JSON object per request line, one JSON reply line each; every
+    reply carries ``ok`` plus either the result fields or ``error``."""
+    import socketserver
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            for raw in self.rfile:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    reply = _handle_request(service, json.loads(line))
+                except Exception as e:  # one bad request must not
+                    # kill the connection; the error rides the reply
+                    reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+                self.wfile.write(
+                    (json.dumps(reply) + "\n").encode("utf-8")
+                )
+                self.wfile.flush()
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    return Server((host, int(port)), Handler)
+
+
+def _load_space(spec):
+    """``module:attr`` -> the space object (called if it's a factory)."""
+    import importlib
+
+    mod_name, _, attr = spec.partition(":")
+    if not attr:
+        raise SystemExit(
+            f"--space must be module:attr, got {spec!r}"
+        )
+    obj = getattr(importlib.import_module(mod_name), attr)
+    return obj() if callable(obj) else obj
+
+
+def main(argv=None):
+    """``hyperopt-tpu-serve``: the service as a process."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="hyperopt-tpu-serve",
+        description="multi-tenant suggestion service: study-batched "
+        "fused tell+ask with continuous batching over a JSON-line "
+        "TCP transport",
+    )
+    parser.add_argument(
+        "--space", required=True,
+        help="module:attr of the search space (or a zero-arg factory)",
+    )
+    parser.add_argument("--algo", default="tpe", choices=("tpe", "anneal"))
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7077)
+    parser.add_argument(
+        "--root", default=None,
+        help="directory for per-study WAL/snapshot durability",
+    )
+    parser.add_argument("--max-batch", type=int, default=64)
+    parser.add_argument("--max-wait-ms", type=float, default=2.0)
+    parser.add_argument("--n-startup-jobs", type=int, default=20)
+    args = parser.parse_args(argv)
+
+    service = SuggestService(
+        _load_space(args.space), algo=args.algo, root=args.root,
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        n_startup_jobs=args.n_startup_jobs,
+    )
+    server = serve_forever(service, host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    print(f"hyperopt-tpu-serve listening on {host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+        service.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

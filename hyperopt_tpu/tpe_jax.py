@@ -63,13 +63,21 @@ def _resolve_above_cap(above_cap):
 
 
 def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
-                     n_cand_cat=None, above_cap=None, state_io=False):
+                     n_cand_cat=None, above_cap=None, state_io=False,
+                     raw=False):
     """Compile the full TPE suggest step for a PackedSpace.
 
     Returns jitted ``fn(key, values, active, losses, valid, batch) ->
     (new_values [D, B], new_active [D, B])`` with ``batch`` static.
     Buffer capacity is baked into the trace via the array shapes
     (power-of-2 bucketed by ObsBuffer -> bounded recompiles).
+
+    ``raw=True`` returns the UNJITTED closure instead (same signature,
+    ``batch`` an ordinary positional) -- the seam the study-batched
+    service engine (:mod:`hyperopt_tpu.serve.batched`) uses to ``vmap``
+    the very same per-study suggest body over a leading study axis:
+    wrapping the identical closure is what makes the batched per-study
+    math bitwise-equal to this builder's solo programs.
 
     ``state_io=True`` returns the FUSED tell+ask variant instead:
     ``fn(key, values, active, losses, valid, vcol, acol, loss, idx,
@@ -202,6 +210,8 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
 
     fn = fn_joint if joint_ei else fn_factorized
     if not state_io:
+        if raw:
+            return fn
         return jax.jit(fn, static_argnames=("batch",))
 
     def fused(key, values, active, losses, valid, vcol, acol, loss, idx,
@@ -212,6 +222,8 @@ def build_suggest_fn(ps, n_cand, gamma, lf, prior_weight, joint_ei=False,
         new_values, new_active = fn(key, *state, batch)
         return tuple(state) + (new_values, new_active)
 
+    if raw:
+        return fused
     return jax.jit(
         fused, static_argnames=("batch",), donate_argnums=(1, 2, 3, 4)
     )

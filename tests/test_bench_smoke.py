@@ -20,6 +20,8 @@ def test_bench_prints_one_json_line():
     env["BENCH_N_OBS"] = "60"
     env["BENCH_N_TRIALS"] = "40"
     env["BENCH_OBS_SWEEP"] = "60,120"  # CI-sized obs-scaling sweep
+    env["BENCH_SERVE_STUDIES"] = "8"  # CI-sized serve batch
+    env["BENCH_SERVE_ROUNDS"] = "3"
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=900, env=env,
@@ -85,3 +87,11 @@ def test_bench_prints_one_json_line():
     # stamped both raw and relative to the fused dispatch time
     assert d["resume_overhead_per_trial"] >= 0
     assert d["resume_overhead_frac_of_fused"] >= 0
+    # round-12: multi-tenant serve rows -- studies/sec out of one
+    # slotted batch, latency percentiles, occupancy, and the
+    # continuous-batching speedup over the one-tenant rate
+    assert d["serve_studies_per_sec"] > 0
+    assert d["serve_ask_p99_ms"] >= d["serve_ask_p50_ms"] > 0
+    assert 0 < d["serve_batch_occupancy"] <= 1.0
+    assert d["serve_vs_solo_speedup_x"] > 0
+    assert d["serve_batch"] == 8

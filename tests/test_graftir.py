@@ -55,8 +55,9 @@ def test_ir_gate_clean_and_fast():
     # speculative redraw, sharded, pallas, prior, plain asks)
     assert res.programs_checked >= 10
     # fast-tier budget: tracing + lowering every family on CPU must be
-    # noise inside the 9-minute wallclock pin
-    assert elapsed < 10.0, f"--ir took {elapsed:.2f}s (budget 10s)"
+    # noise inside the 9-minute wallclock pin (raised 10 -> 15 s when
+    # the serve-batched families grew the registry 11 -> 14 programs)
+    assert elapsed < 15.0, f"--ir took {elapsed:.2f}s (budget 15s)"
 
 
 def test_manifest_covers_every_registered_program():

@@ -1,0 +1,481 @@
+"""graftserve: the multi-tenant suggestion service (ISSUE 8).
+
+The acceptance contract, pinned deterministically:
+
+* PER-STUDY BITWISE PARITY: every study served out of a batched run --
+  across join/leave churn and two slot capacities -- produces exactly
+  the suggestion stream its SOLO fused-path run produces (same seed
+  stream, same tell cadence);
+* DISPATCH BOUND: a full 64-study run serves all asks in
+  ``ceil(total_asks / batch) + joins`` device dispatches (counted, not
+  timed);
+* BUCKET-BOUNDARY GUARD: a study crossing its pow2 obs bucket
+  re-buckets the shared state without disturbing sibling slots (their
+  streams stay bitwise solo-equal even though the shared width grew).
+"""
+
+import json
+import math
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp, tpe_jax
+from hyperopt_tpu.jax_trials import MIN_CAPACITY, ObsBuffer, host_key
+from hyperopt_tpu.ops.compile import compile_space
+from hyperopt_tpu.serve import SuggestService
+from hyperopt_tpu.serve.batched import slot_capacity
+from hyperopt_tpu.serve.scheduler import dense_to_vals
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "q": hp.quniform("q", 0, 10, 1),
+    "c": hp.choice("c", [0, 1, 2]),
+}
+
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+N_STARTUP = 3
+
+
+def loss_fn(vals):
+    return (
+        (vals["x"] - 1) ** 2 / 10
+        + abs(float(np.log(vals["lr"])) + 2) / 3
+        + abs(vals["q"] - 4) / 5
+        + 0.1 * vals["c"]
+    )
+
+
+_SOLO_FNS = {}
+
+
+def _solo_fns(ps):
+    """The solo fused-path programs at the serve algo parameters
+    (shared across all reference streams -- one compile)."""
+    key = id(ps)
+    if key not in _SOLO_FNS:
+        plain = tpe_jax.build_suggest_fn(
+            ps, ALGO_KW["n_cand"], 0.25, 25.0, 1.0,
+            n_cand_cat=ALGO_KW["n_cand_cat"],
+        )
+        fused = tpe_jax.build_suggest_fn(
+            ps, ALGO_KW["n_cand"], 0.25, 25.0, 1.0,
+            n_cand_cat=ALGO_KW["n_cand_cat"], state_io=True,
+        )
+        _SOLO_FNS[key] = (plain, fused)
+    return _SOLO_FNS[key]
+
+
+def solo_stream(ps, seed, n_asks, prefill=()):
+    """The SOLO fused-path reference for one study: per-ask seeds from
+    the study's own rstate stream, one tell per ask, resident mirror
+    with the fused tell+ask program -- exactly the PR-4 sequential
+    driver a lone tenant would run."""
+    import jax
+
+    plain, fused = _solo_fns(ps)
+    a_cap = tpe_jax._resolve_above_cap(None)
+    buf = ObsBuffer(ps, resident=True)
+    for vals, loss in prefill:
+        buf.add(dict(vals), float(loss))
+    rstate = np.random.default_rng(seed)
+    stream = []
+    for _ in range(n_asks):
+        s = int(rstate.integers(2**31 - 1))
+        key = host_key(s % (2**31 - 1))
+        if buf.count < N_STARTUP:
+            buf.dispatch_count += 1
+            out = ps.sample_prior(key, 1)
+        else:
+            out = tpe_jax._state_dispatch(buf, key, 1, a_cap, plain, fused)
+        v, a = jax.device_get(out)
+        vals = dense_to_vals(ps, np.asarray(v)[:, 0], np.asarray(a)[:, 0])
+        stream.append(vals)
+        buf.add(dict(vals), loss_fn(vals))
+    return stream
+
+
+def drive_rounds(svc, handles, streams, n_rounds):
+    """n_rounds of (ask every open handle, tell its loss)."""
+    for _ in range(n_rounds):
+        futs = [(h, h.ask_async()) for h in handles]
+        svc.pump()
+        for h, f in futs:
+            tid, vals = f.result(timeout=10)
+            streams.setdefault(h.name, []).append(vals)
+            h.tell(tid, loss_fn(vals))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pins
+# ---------------------------------------------------------------------------
+
+
+def test_64_study_parity_and_dispatch_bound():
+    """64 studies, 6 asks each, one slotted batch: every per-study
+    stream bitwise solo-equal, all 384 asks served in 6 dispatches
+    (``ceil(total_asks / batch) + joins`` with zero drain), occupancy
+    pinned at 1.0."""
+    svc = SuggestService(
+        SPACE, max_batch=64, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    ps = svc.ps
+    handles = [svc.create_study(f"s{i:02d}", seed=100 + i)
+               for i in range(64)]
+    streams = {}
+    n_rounds = 6
+    drive_rounds(svc, handles, streams, n_rounds)
+
+    for i, h in enumerate(handles):
+        assert streams[h.name] == solo_stream(
+            ps, 100 + i, n_rounds
+        ), f"study {h.name} diverged from its solo fused-path stream"
+
+    total_asks = 64 * n_rounds
+    c = svc.counters
+    assert c["dispatch_count"] <= math.ceil(total_asks / 64) + c["joins"]
+    assert c["dispatch_count"] == n_rounds  # tight: every round full
+    assert c["delta_drain_dispatches"] == 0
+    assert c["upload_events"] == 1  # one materialization at first round
+    assert svc.scheduler.occupancy == [1.0] * n_rounds
+
+
+@pytest.mark.parametrize("max_batch", [16, 64])
+def test_churn_parity_two_capacities(max_batch):
+    """Join/leave churn at two slot capacities: studies join mid-run,
+    leave mid-run, slots get reused -- and every study's stream stays
+    bitwise equal to its solo fused-path run (per-study rstate streams
+    make batching order irrelevant)."""
+    svc = SuggestService(
+        SPACE, max_batch=max_batch, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    ps = svc.ps
+    streams = {}
+    seeds = {}
+
+    def open_wave(tag, n, base_seed):
+        hs = []
+        for i in range(n):
+            name = f"{tag}{i:02d}"
+            seeds[name] = base_seed + i
+            hs.append(svc.create_study(name, seed=base_seed + i))
+        return hs
+
+    wave_a = open_wave("a", max_batch // 2, 500)
+    drive_rounds(svc, wave_a, streams, 2)
+    wave_b = open_wave("b", max_batch // 2, 700)  # join mid-run
+    drive_rounds(svc, wave_a + wave_b, streams, 2)
+    for h in wave_a[: max_batch // 4]:  # leave mid-run
+        h.close()
+    survivors = wave_a[max_batch // 4:] + wave_b
+    drive_rounds(svc, survivors, streams, 2)
+    wave_c = open_wave("c", max_batch // 4, 900)  # reuse freed slots
+    drive_rounds(svc, survivors + wave_c, streams, 2)
+
+    n_asks = {h.name: len(streams[h.name])
+              for h in wave_a + wave_b + wave_c}
+    for name, stream in streams.items():
+        assert stream == solo_stream(ps, seeds[name], n_asks[name]), (
+            f"study {name} diverged under churn (max_batch={max_batch})"
+        )
+    # the freed slots really were reused (join/leave exercised slots)
+    assert svc.counters["joins"] == max_batch + max_batch // 4
+
+
+def test_bucket_boundary_rebucket_keeps_siblings_bitwise():
+    """The satellite guard: a study crossing the pow2 obs bucket
+    (count 128 -> bucket 256) re-buckets the WHOLE stacked state; the
+    sibling -- still tiny, solo-bucketed at 128 -- must see a stream
+    bitwise identical to its solo run across the crossing."""
+    svc = SuggestService(
+        SPACE, max_batch=4, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    ps = svc.ps
+    big = svc.create_study("big", seed=11)
+    small = svc.create_study("small", seed=22)
+
+    # pre-fill `big` to just under the bucket boundary with explicit
+    # tells (no asks): deterministic synthetic history
+    rng = np.random.default_rng(5)
+    prefill = []
+    for _ in range(MIN_CAPACITY - 2):
+        vals = {
+            "x": float(rng.uniform(-5, 5)),
+            "lr": float(np.exp(rng.uniform(-5, 0))),
+            "q": float(rng.integers(0, 11)),
+            "c": int(rng.integers(0, 3)),
+        }
+        prefill.append((vals, loss_fn(vals)))
+    for tid, (vals, loss) in enumerate(prefill):
+        big.tell(tid, loss, vals=vals)
+    assert svc.scheduler.study("big").buf.count == MIN_CAPACITY - 2
+
+    streams = {}
+    drive_rounds(svc, [big, small], streams, 6)  # crosses 128 at ask 3
+
+    assert svc.scheduler.study("big").buf.count > MIN_CAPACITY
+    assert svc.counters["rebuckets"] >= 1  # the boundary really crossed
+    assert streams["small"] == solo_stream(ps, 22, 6), (
+        "sibling stream disturbed by a neighbor's bucket growth"
+    )
+    assert streams["big"] == solo_stream(ps, 11, 6, prefill=prefill)
+
+
+def test_multi_tell_backlog_drains_and_stays_bitwise():
+    """A study telling several times between asks: the backlog drains
+    through the batched masked-delta program (counted) and the next
+    ask still matches the solo stream (solo replays the same deltas
+    through its resident mirror)."""
+    svc = SuggestService(
+        SPACE, max_batch=4, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    ps = svc.ps
+    h = svc.create_study("m", seed=77)
+    streams = {}
+    drive_rounds(svc, [h], streams, 4)  # warm the study + mirror
+    extra = [
+        ({"x": 0.5, "lr": 0.1, "q": 2.0, "c": 1}, 0.9),
+        ({"x": -1.5, "lr": 0.05, "q": 7.0, "c": 0}, 1.7),
+        ({"x": 2.5, "lr": 0.3, "q": 1.0, "c": 2}, 0.4),
+    ]
+    base_tid = svc.scheduler.study("m").next_tid
+    for k, (vals, loss) in enumerate(extra):
+        h.tell(base_tid + k, loss, vals=vals)
+    svc.scheduler.study("m").next_tid = base_tid + len(extra)
+    drive_rounds(svc, [h], streams, 2)
+    # 4 staged at the next ask (round-4's own tell + the 3 extras):
+    # three drain dispatches, the last delta fuses into the ask
+    assert svc.counters["delta_drain_dispatches"] == 3
+
+    solo = solo_stream(ps, 77, 4)
+    # replay the same interleaving on the solo reference
+    import jax
+
+    plain, fused = _solo_fns(ps)
+    a_cap = tpe_jax._resolve_above_cap(None)
+    buf = ObsBuffer(ps, resident=True)
+    rstate = np.random.default_rng(77)
+    solo_all = []
+    for i in range(6):
+        if i == 4:
+            for vals, loss in extra:
+                buf.add(dict(vals), loss)
+        s = int(rstate.integers(2**31 - 1))
+        key = host_key(s % (2**31 - 1))
+        if buf.count < N_STARTUP:
+            out = ps.sample_prior(key, 1)
+        else:
+            out = tpe_jax._state_dispatch(buf, key, 1, a_cap, plain, fused)
+        v, a = jax.device_get(out)
+        vals = dense_to_vals(ps, np.asarray(v)[:, 0], np.asarray(a)[:, 0])
+        solo_all.append(vals)
+        buf.add(dict(vals), loss_fn(vals))
+    assert streams["m"] == solo_all
+    assert solo_all[:4] == solo  # sanity: the prefix is the plain run
+
+
+def test_anneal_serve_parity():
+    """The anneal batched body: per-study streams bitwise equal to the
+    solo anneal programs (prior below one observation, anneal after)."""
+    import jax
+
+    from hyperopt_tpu import anneal_jax
+
+    svc = SuggestService(
+        SPACE, algo="anneal", max_batch=4, background=False,
+    )
+    ps = svc.ps
+    handles = [svc.create_study(f"an{i}", seed=40 + i) for i in range(3)]
+    streams = {}
+    drive_rounds(svc, handles, streams, 5)
+
+    plain = anneal_jax.build_anneal_fn(ps, 2.0, 0.1)
+    fused = anneal_jax.build_anneal_fn(ps, 2.0, 0.1, state_io=True)
+    for i, h in enumerate(handles):
+        buf = ObsBuffer(ps, resident=True)
+        rstate = np.random.default_rng(40 + i)
+        for vals in streams[h.name]:
+            s = int(rstate.integers(2**31 - 1))
+            key = host_key(s % (2**31 - 1))
+            if buf.count == 0:
+                out = ps.sample_prior(key, 1)
+            else:
+                out = tpe_jax._state_dispatch(
+                    buf, key, 1, None, plain, fused
+                )
+            v, a = jax.device_get(out)
+            got = dense_to_vals(
+                ps, np.asarray(v)[:, 0], np.asarray(a)[:, 0]
+            )
+            assert got == vals
+            buf.add(dict(got), loss_fn(got))
+
+
+# ---------------------------------------------------------------------------
+# engine units
+# ---------------------------------------------------------------------------
+
+
+def test_slot_capacity_schedule():
+    assert slot_capacity(1, 64) == 4
+    assert slot_capacity(4, 64) == 4
+    assert slot_capacity(5, 64) == 8
+    assert slot_capacity(33, 64) == 64
+    assert slot_capacity(100, 64) == 64
+    assert slot_capacity(3, 2) == 2
+
+
+def test_dense_to_vals_types_match_cast_vals():
+    ps = compile_space(SPACE)
+    col_v = np.zeros(ps.n_dims, np.float32)
+    col_a = np.ones(ps.n_dims, bool)
+    for i, d in enumerate(ps.cont_idx):
+        col_v[d] = 1.25
+    for d in ps.cat_idx:
+        col_v[d] = 2.0
+    vals = dense_to_vals(ps, col_v, col_a)
+    for d in ps.cat_idx:
+        assert isinstance(vals[ps.labels[d]], int)
+    for d in ps.cont_idx:
+        assert isinstance(vals[ps.labels[d]], float)
+    # inactive dims are omitted (conditional-branch contract)
+    col_a[:] = False
+    assert dense_to_vals(ps, col_v, col_a) == {}
+
+
+def test_apply_delta_masked_is_apply_or_identity():
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.ops.kernels import apply_delta, apply_delta_masked
+
+    D, cap = 3, 8
+    rng = np.random.default_rng(0)
+    state = (
+        jnp.asarray(rng.normal(size=(D, cap)).astype(np.float32)),
+        jnp.asarray(rng.random((D, cap)) > 0.5),
+        jnp.asarray(rng.normal(size=cap).astype(np.float32)),
+        jnp.asarray(np.arange(cap) < 5),
+    )
+    vcol = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    acol = jnp.ones(D, bool)
+    loss, idx = jnp.float32(0.5), jnp.int32(5)
+
+    on = apply_delta_masked(*state, vcol, acol, loss, idx, True)
+    ref = apply_delta(*state, vcol, acol, loss, idx)
+    for a, b in zip(jax.device_get(on), jax.device_get(ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    off = apply_delta_masked(*state, vcol, acol, loss, idx, False)
+    for a, b in zip(jax.device_get(off), jax.device_get(state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tell_is_idempotent_by_tid():
+    svc = SuggestService(SPACE, max_batch=4, background=False, **ALGO_KW)
+    h = svc.create_study("idem", seed=1)
+    vals = {"x": 0.1, "lr": 0.2, "q": 3.0, "c": 0}
+    h.tell(0, 1.0, vals=vals)
+    h.tell(0, 1.0, vals=vals)  # re-told (lost ack); absorbed once
+    st = svc.scheduler.study("idem")
+    assert st.buf.count == 1
+    assert st.n_tells == 1
+
+
+def test_serve_package_lints_clean():
+    """The CI/tooling satellite: the serve subsystem is graftlint-clean
+    on its own (no baseline, no suppressions needed)."""
+    import os
+
+    from hyperopt_tpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = lint_paths(
+        [os.path.join(repo, "hyperopt_tpu", "serve")], root=repo
+    )
+    assert not result.findings, [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in result.findings
+    ]
+
+
+def test_serve_registered_in_ir_manifest():
+    """The CI/tooling satellite: the batched program families are
+    registered and pinned in the committed contracts manifest."""
+    import os
+
+    from hyperopt_tpu.analysis.ir import load_contracts
+    from hyperopt_tpu.ops.compile import registered_programs
+
+    specs = registered_programs()
+    for name in ("serve.batched_step", "serve.batched_anneal_step",
+                 "serve.batched_apply_delta"):
+        assert name in specs, name
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest = load_contracts(
+        os.path.join(repo, "program_contracts.json")
+    )["programs"]
+    assert manifest["serve.batched_step"]["donation"] == [1, 2, 3, 4]
+    assert manifest["serve.batched_anneal_step"]["donation"] == [1, 2, 3, 4]
+    assert manifest["serve.batched_apply_delta"]["donation"] == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# the socket transport
+# ---------------------------------------------------------------------------
+
+
+def test_socket_transport_roundtrip():
+    from hyperopt_tpu.serve.service import serve_forever
+
+    svc = SuggestService(
+        SPACE, background=True, max_wait_ms=1.0,
+        n_startup_jobs=2, **ALGO_KW,
+    )
+    server = serve_forever(svc, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=10) as sock:
+            f = sock.makefile("rw")
+
+            def rpc(**req):
+                f.write(json.dumps(req) + "\n")
+                f.flush()
+                return json.loads(f.readline())
+
+            assert rpc(op="ping")["pong"]
+            assert rpc(op="create_study", name="demo", seed=3)["ok"]
+            assert rpc(op="studies")["studies"] == ["demo"]
+            for _ in range(3):
+                r = rpc(op="ask", study="demo")
+                assert r["ok"], r
+                assert rpc(
+                    op="tell", study="demo", tid=r["tid"],
+                    loss=loss_fn(r["vals"]),
+                )["ok"]
+            best = rpc(op="best", study="demo")
+            assert best["ok"] and best["best"]["loss"] >= 0
+            assert not rpc(op="ask", study="nope")["ok"]
+            assert not rpc(op="frobnicate")["ok"]
+            assert rpc(op="close_study", study="demo")["ok"]
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.shutdown()
+
+
+def test_console_script_space_loader():
+    from hyperopt_tpu.serve.service import _load_space
+
+    space = _load_space("hyperopt_tpu.models.synthetic:mixed_space")
+    ps = compile_space(space)
+    assert ps.n_dims > 0
+    with pytest.raises(SystemExit):
+        _load_space("no_colon_here")

@@ -1,0 +1,226 @@
+"""Serve chaos: the batching loop under injected crashes (ISSUE 8).
+
+Same discipline as ``tests/test_chaos.py`` / ``test_resume_parity.py``:
+seeded :class:`FaultPlan`\\ s arm the SERVE crash points (tell durable
+but not applied, batch assembled but not dispatched, dispatched but not
+acked), the harness catches the simulated death, restarts the service
+over the same durability root, and finishes the workload.  Asserted
+invariants: ZERO lost and ZERO duplicate tells (exact per-study counts,
+unique tids, WAL totals), and the whole crash-and-restart scenario is
+bitwise repeatable under the same seeds.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.distributed.faults import (
+    SERVE_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import CheckpointError
+from hyperopt_tpu.serve import SuggestService
+
+pytestmark = pytest.mark.chaos
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+NAMES = ("a", "b", "c")
+R = 5  # tells per study the workload must end with, exactly
+
+
+def loss_fn(vals):
+    return (vals["x"]) ** 2 / 10 + abs(float(np.log(vals["lr"])) + 2) / 3
+
+
+def _make_service(root, fs, cadence=4):
+    return SuggestService(
+        SPACE, root=root, fs=fs, background=False, n_startup_jobs=2,
+        snapshot_cadence=cadence, max_batch=4, **ALGO_KW,
+    )
+
+
+def run_scenario(root, crash_point=None, crash_at=2, rate=0.0,
+                 partial_rate=0.0, seed=0, cadence=4):
+    """Drive every study to exactly ``R`` tells, crashing and
+    restarting as the armed plan dictates.  Returns the final
+    per-study state + counters."""
+    plan = FaultPlan(seed=seed, rate=rate, partial_rate=partial_rate)
+    if crash_point is not None:
+        plan.arm(crash_point, at=crash_at)
+    n_crashes = 0
+    svc = None
+    for _attempt in range(10):  # bounded: each crash point is one-shot
+        fs = plan.fs()
+        svc = _make_service(root, fs, cadence=cadence)
+        try:
+            handles = {
+                n: svc.create_study(n, seed=30 + i)
+                for i, n in enumerate(NAMES)
+            }
+            while True:
+                live = [
+                    (n, h) for n, h in handles.items()
+                    if svc.scheduler.study(n).buf.count < R
+                ]
+                if not live:
+                    break
+                futs = [(n, h, h.ask_async()) for n, h in live]
+                svc.pump()
+                for n, h, fut in futs:
+                    tid, vals = fut.result(timeout=10)
+                    h.tell(tid, loss_fn(vals))
+        except SimulatedCrash:
+            n_crashes += 1
+            continue  # a dead service publishes nothing else; restart
+        break
+    out = {}
+    for n in NAMES:
+        st = svc.scheduler.study(n)
+        buf = st.buf
+        out[n] = {
+            "count": buf.count,
+            "tids": buf.tids[: buf.count].tolist(),
+            "losses": buf.losses[: buf.count].tolist(),
+            "values": buf.values[:, : buf.count].copy(),
+            "wal_total_tells": st.persist.wal.total_tells,
+        }
+    svc.shutdown()
+    return out, n_crashes
+
+
+@pytest.mark.parametrize("point", SERVE_CRASH_POINTS)
+def test_crash_point_zero_lost_zero_duplicate(tmp_path, point):
+    """Each serve crash point: the workload completes after restart
+    with exactly R tells per study -- none lost, none duplicated --
+    and the same-seed replay of the whole crash-and-restart scenario
+    is bitwise identical."""
+    runs = []
+    for rep in range(2):
+        root = tmp_path / f"{point}-{rep}"
+        out, n_crashes = run_scenario(str(root), crash_point=point)
+        assert n_crashes == 1, f"{point} never fired"
+        for n, st in out.items():
+            assert st["count"] == R, (point, n, st["count"])
+            assert len(set(st["tids"])) == R, "duplicate tid absorbed"
+            assert st["wal_total_tells"] == R, (
+                f"{point}/{n}: WAL logged {st['wal_total_tells']} "
+                f"tells for {R} applied -- lost or duplicated"
+            )
+        runs.append(out)
+    for n in NAMES:
+        assert runs[0][n]["tids"] == runs[1][n]["tids"]
+        assert runs[0][n]["losses"] == runs[1][n]["losses"]
+        np.testing.assert_array_equal(
+            runs[0][n]["values"], runs[1][n]["values"]
+        )
+
+
+def test_crash_mid_batch_late_arm(tmp_path):
+    """The mid-batch point armed deeper into the run (after snapshots
+    have compacted the WAL): replay crosses a snapshot boundary."""
+    out, n_crashes = run_scenario(
+        str(tmp_path / "late"), crash_point="serve_mid_batch",
+        crash_at=4, cadence=3,
+    )
+    assert n_crashes == 1
+    for n, st in out.items():
+        assert st["count"] == R
+        assert st["wal_total_tells"] == R
+
+
+def test_transient_fault_storm_completes_exactly(tmp_path):
+    """A 10% transient-errno storm over every fs primitive (burst-
+    bounded): the retry scaffolding absorbs it and the workload still
+    lands at exactly R tells per study, twice, same-seed-identical."""
+    runs = []
+    for rep in range(2):
+        out, n_crashes = run_scenario(
+            str(tmp_path / f"storm-{rep}"), rate=0.10, seed=7,
+        )
+        assert n_crashes == 0
+        for st in out.values():
+            assert st["count"] == R
+            assert st["wal_total_tells"] == R
+        runs.append(out)
+    for n in NAMES:
+        np.testing.assert_array_equal(
+            runs[0][n]["values"], runs[1][n]["values"]
+        )
+
+
+def test_restore_from_wal_only(tmp_path):
+    """A crash before the first snapshot cadence: restore rebuilds the
+    studies purely from WAL replay."""
+    out, n_crashes = run_scenario(
+        str(tmp_path / "walonly"),
+        crash_point="serve_after_wal_before_dispatch", crash_at=3,
+        cadence=10_000,  # snapshots never fire mid-run
+    )
+    assert n_crashes == 1
+    for st in out.values():
+        assert st["count"] == R
+        assert st["wal_total_tells"] == R
+
+
+def test_restore_refuses_foreign_study_guard(tmp_path):
+    """A durability root written by a different space/algo family must
+    be REFUSED, never silently reinterpreted (PR-3/6 guard law)."""
+    root = str(tmp_path / "guard")
+    svc = _make_service(root, FaultPlan(seed=0).fs())
+    h = svc.create_study("a", seed=1)
+    h.tell(0, 1.0, vals={"x": 0.5, "lr": 0.1, "c": 0})
+    svc.shutdown()
+
+    other_space = {"x": hp.uniform("x", -1, 1)}
+    svc2 = SuggestService(
+        other_space, root=root, background=False, max_batch=4,
+    )
+    with pytest.raises(CheckpointError):
+        svc2.create_study("a", seed=1)
+    svc2.shutdown()
+
+
+def test_retell_after_lost_ack_not_duplicated(tmp_path):
+    """The client-side half of exactly-once: a tell whose ack the
+    crashed service lost is re-told with explicit vals after restart
+    and absorbed exactly once (WAL-replayed + idempotent-by-tid)."""
+    root = str(tmp_path / "retell")
+    plan = FaultPlan(seed=0).arm("serve_after_wal_before_dispatch", at=1)
+    svc = _make_service(root, plan.fs())
+    h = svc.create_study("a", seed=9)
+    fut = h.ask_async()
+    svc.pump()
+    tid, vals = fut.result(timeout=10)
+    with pytest.raises(SimulatedCrash):
+        h.tell(tid, loss_fn(vals))  # durable, applied only on restore
+    # restart; re-tell the un-acked work exactly as a real client would
+    svc2 = _make_service(root, FaultPlan(seed=1).fs())
+    h2 = svc2.create_study("a", seed=9)
+    st = svc2.scheduler.study("a")
+    assert st.buf.count == 1  # the WAL-replayed tell survived
+    h2.tell(tid, loss_fn(vals), vals=vals)  # lost ack -> client retries
+    assert st.buf.count == 1  # absorbed exactly once
+    assert st.persist.wal.total_tells == 1
+    svc2.shutdown()
+
+
+def test_serve_points_registered():
+    """A new serve crash point cannot be added without the chaos suite
+    exercising it (the CRASH_POINTS discipline)."""
+    from hyperopt_tpu.distributed.faults import ALL_CRASH_POINTS
+
+    assert set(SERVE_CRASH_POINTS) <= set(ALL_CRASH_POINTS)
+    assert set(SERVE_CRASH_POINTS) == {
+        "serve_after_wal_before_dispatch",
+        "serve_mid_batch",
+        "serve_after_dispatch_before_ack",
+    }
