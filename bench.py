@@ -470,8 +470,9 @@ def bench_serve(space, n_studies=64, rounds=6, n_cand=128,
         for _ in range(n_rounds):
             round_once()
         dt = time.perf_counter() - t0
-        lats = svc.scheduler.ask_latencies[lat0:]
-        occ = svc.scheduler.occupancy[-n_rounds:]
+        # the metrics are bounded deques: snapshot to lists to slice
+        lats = list(svc.scheduler.ask_latencies)[lat0:]
+        occ = list(svc.scheduler.occupancy)[-n_rounds:]
         svc.shutdown()
         return n * n_rounds / dt, lats, occ
 

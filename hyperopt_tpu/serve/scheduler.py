@@ -53,6 +53,11 @@ logger = logging.getLogger(__name__)
 
 __all__ = ["BatchScheduler", "ServeStudy", "dense_to_vals"]
 
+#: ring-buffer length for the timing metrics (``ask_latencies`` /
+#: ``occupancy``): plenty for any bench window, bounded for a
+#: long-running service
+METRICS_WINDOW = 65536
+
 
 def dense_to_vals(ps, col_v, col_a):
     """One dense suggestion column -> the {label: value} config dict at
@@ -174,8 +179,10 @@ class BatchScheduler:
         self.upload_bytes = 0
         self.joins = 0
         self.rebuckets = 0
-        self.ask_latencies = []
-        self.occupancy = []
+        # bounded: bench metrics on a long-running service must not
+        # grow one entry per ask forever (slow leak at scale)
+        self.ask_latencies = collections.deque(maxlen=METRICS_WINDOW)
+        self.occupancy = collections.deque(maxlen=METRICS_WINDOW)
 
     # -- tenancy -----------------------------------------------------------
     def open_study(self, name, seed=0, study=None):
@@ -270,6 +277,8 @@ class BatchScheduler:
         is drawn HERE, from the study's own stream -- the batching
         order downstream can no longer affect the suggestion."""
         with self._lock:
+            if self._stopping:
+                raise RuntimeError("suggestion service shutting down")
             if study.closed:
                 raise ValueError(f"study {study.name!r} is closed")
             seed = int(study.rstate.integers(2**31 - 1))
@@ -308,8 +317,13 @@ class BatchScheduler:
         all absorbed by ONE re-materialization; then drain any
         remaining multi-delta backlog down to one staged tell per slot
         (the fused dispatch absorbs the last one)."""
+        # size from the HIGHEST occupied slot, not the study count:
+        # churn can leave survivors on slots >= len(self._studies)
+        # (closed studies free their low slots, survivors keep high
+        # ones), and stack_states must cover every occupied index
+        top_slot = max(self._slots, default=-1)
         slot_cap = max(
-            slot_capacity(len(self._studies), self.max_batch),
+            slot_capacity(top_slot + 1, self.max_batch),
             self._slot_cap,  # capacities never shrink mid-flight
         )
         bucket = self._compute_bucket()
@@ -366,67 +380,81 @@ class BatchScheduler:
         """One dispatch round: returns the number of asks served.
         Synchronous entry point -- the background loop calls this, and
         tests/chaos harnesses call it directly so crashes propagate."""
-        import jax
-        import jax.numpy as jnp
-
-        from ..jax_trials import host_key
-
         with self._lock:
             picked = self._pick_round()
             if not picked:
                 # tells without asks stay staged (or dirty) until the
                 # next ask round -- a tell-only window never dispatches
                 return 0
-            self._maintain()
-            s = self._slot_cap
-            dummy = host_key(0)
-            keys = [dummy] * s
-            warm = np.zeros(s, dtype=bool)
-            vcol, acol, dloss, didx, dapply = _dummy_delta(self.ps, s)
-            for st in self._slots.values():
-                if st.pending:  # at most one left after _maintain
-                    n, vc, ac, lo = st.pending.popleft()
-                    vcol[st.slot] = vc
-                    acol[st.slot] = ac
-                    dloss[st.slot] = lo
-                    didx[st.slot] = n
-                    dapply[st.slot] = True
-                warm[st.slot] = (
-                    st.buf.count > 0
-                    if self.algo == "anneal"
-                    else st.buf.count >= self.n_startup_jobs
-                )
-            for req in picked:
-                keys[req.study.slot] = host_key(req.seed % (2**31 - 1))
-            self.fs.crashpoint("serve_mid_batch")
-            out = self._step_fn(
-                jnp.stack(keys), *self._state, vcol, acol, dloss, didx,
-                dapply, warm, batch=1,
+            try:
+                return self._dispatch_round(picked)
+            except BaseException as e:
+                # _pick_round already popped these off the queue: a
+                # failed dispatch must fail their futures too, or
+                # clients blocked in ask() hang out their full timeout
+                for req in picked:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                raise
+
+    def _dispatch_round(self, picked):
+        """Serve one picked round (lock held): maintain the stacked
+        state, run the batched program, ack every pick."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..jax_trials import host_key
+
+        self._maintain()
+        s = self._slot_cap
+        dummy = host_key(0)
+        keys = [dummy] * s
+        warm = np.zeros(s, dtype=bool)
+        vcol, acol, dloss, didx, dapply = _dummy_delta(self.ps, s)
+        for st in self._slots.values():
+            if st.pending:  # at most one left after _maintain
+                n, vc, ac, lo = st.pending.popleft()
+                vcol[st.slot] = vc
+                acol[st.slot] = ac
+                dloss[st.slot] = lo
+                didx[st.slot] = n
+                dapply[st.slot] = True
+            warm[st.slot] = (
+                st.buf.count > 0
+                if self.algo == "anneal"
+                else st.buf.count >= self.n_startup_jobs
             )
-            self._state = StudyBatchState(*out[:4])
-            self.dispatch_count += 1
-            new_v, new_a = jax.device_get((out[4], out[5]))
-            new_v = np.asarray(new_v)
-            new_a = np.asarray(new_a)
-            self.fs.crashpoint("serve_after_dispatch_before_ack")
-            now = time.perf_counter()
-            self.occupancy.append(len(picked) / s)
-            results = []
-            for req in picked:
-                st = req.study
-                vals = dense_to_vals(
-                    self.ps, new_v[st.slot, :, 0], new_a[st.slot, :, 0]
-                )
-                if st.persist is not None:
-                    st.persist.log_served(req.tid, vals)
-                st.outstanding[req.tid] = vals
-                self.ask_latencies.append(now - req.t_submit)
-                results.append((req, vals))
-            # acks last: a crash above leaves every pick un-acked and
-            # replayable, never half-acked
-            for req, vals in results:
-                req.future.set_result((req.tid, vals))
-            return len(picked)
+        for req in picked:
+            keys[req.study.slot] = host_key(req.seed % (2**31 - 1))
+        self.fs.crashpoint("serve_mid_batch")
+        out = self._step_fn(
+            jnp.stack(keys), *self._state, vcol, acol, dloss, didx,
+            dapply, warm, batch=1,
+        )
+        self._state = StudyBatchState(*out[:4])
+        self.dispatch_count += 1
+        new_v, new_a = jax.device_get((out[4], out[5]))
+        new_v = np.asarray(new_v)
+        new_a = np.asarray(new_a)
+        self.fs.crashpoint("serve_after_dispatch_before_ack")
+        now = time.perf_counter()
+        self.occupancy.append(len(picked) / s)
+        results = []
+        for req in picked:
+            st = req.study
+            vals = dense_to_vals(
+                self.ps, new_v[st.slot, :, 0], new_a[st.slot, :, 0]
+            )
+            if st.persist is not None:
+                st.persist.log_served(req.tid, vals)
+            st.outstanding[req.tid] = vals
+            self.ask_latencies.append(now - req.t_submit)
+            results.append((req, vals))
+        # acks last: a crash above leaves every pick un-acked and
+        # replayable, never half-acked
+        for req, vals in results:
+            req.future.set_result((req.tid, vals))
+        return len(picked)
 
     # -- background loop ---------------------------------------------------
     def start(self):
@@ -446,6 +474,15 @@ class BatchScheduler:
             self._cond.notify_all()
             t = self._thread
             self._thread = None
+            # a stopping batcher must not strand blocked clients:
+            # drain the queue and fail every pending ask promptly
+            # instead of letting ask() hang out its full timeout
+            while self._asks:
+                req = self._asks.popleft()
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("suggestion service shutting down")
+                    )
         if t is not None:
             t.join(timeout=5.0)
 

@@ -141,7 +141,7 @@ def test_64_study_parity_and_dispatch_bound():
     assert c["dispatch_count"] == n_rounds  # tight: every round full
     assert c["delta_drain_dispatches"] == 0
     assert c["upload_events"] == 1  # one materialization at first round
-    assert svc.scheduler.occupancy == [1.0] * n_rounds
+    assert list(svc.scheduler.occupancy) == [1.0] * n_rounds
 
 
 @pytest.mark.parametrize("max_batch", [16, 64])
@@ -185,6 +185,78 @@ def test_churn_parity_two_capacities(max_batch):
         )
     # the freed slots really were reused (join/leave exercised slots)
     assert svc.counters["joins"] == max_batch + max_batch // 4
+
+
+def test_churn_before_first_dispatch_keeps_high_slots():
+    """REGRESSION: closing a study BEFORE the first dispatch leaves a
+    survivor on a slot index >= len(studies) (the freed low slot sits
+    in the free list); the batch must be sized from the highest
+    OCCUPIED slot, not the study count, or stack_states under-
+    allocates and the high-slot ask indexes past the study axis."""
+    svc = SuggestService(
+        SPACE, max_batch=8, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    ps = svc.ps
+    handles = [svc.create_study(f"r{i}", seed=200 + i) for i in range(5)]
+    handles[0].close()  # frees slot 0; a survivor still holds slot 4
+    survivors = handles[1:]
+    assert max(st.slot for st in svc.scheduler._slots.values()) == 4
+    streams = {}
+    drive_rounds(svc, survivors, streams, 3)
+    for i, h in enumerate(survivors, start=1):
+        assert streams[h.name] == solo_stream(ps, 200 + i, 3), (
+            f"study {h.name} diverged after churn before first dispatch"
+        )
+
+
+def test_failed_dispatch_fails_picked_futures():
+    """REGRESSION: a dispatch that dies mid-batch must fail the
+    round's PICKED futures (already popped off the queue), not leave
+    their clients blocked in ask() until the full timeout."""
+    from hyperopt_tpu.distributed.faults import FaultPlan, SimulatedCrash
+
+    plan = FaultPlan(seed=0).arm("serve_mid_batch", at=1)
+    svc = SuggestService(
+        SPACE, max_batch=4, background=False, fs=plan.fs(),
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    h = svc.create_study("f", seed=1)
+    fut = h.ask_async()
+    with pytest.raises(SimulatedCrash):
+        svc.pump()
+    assert fut.done(), "picked future stranded by a dying dispatch"
+    with pytest.raises(SimulatedCrash):
+        fut.result(timeout=0)
+
+
+def test_stop_fails_queued_asks_promptly():
+    """REGRESSION: shutdown must promptly fail every queued ask future
+    and refuse later submits, not strand blocked clients until their
+    timeout."""
+    svc = SuggestService(
+        SPACE, max_batch=4, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    h = svc.create_study("z", seed=3)
+    fut = h.ask_async()
+    svc.shutdown()
+    assert fut.done(), "queued future stranded by shutdown"
+    with pytest.raises(RuntimeError, match="shutting down"):
+        fut.result(timeout=0)
+    with pytest.raises(RuntimeError, match="shutting down"):
+        h.ask_async()
+
+
+def test_bench_metrics_are_bounded():
+    """REGRESSION: the timing metrics are ring buffers -- a long-
+    running service must not leak one entry per ask forever."""
+    from hyperopt_tpu.serve.scheduler import METRICS_WINDOW
+
+    svc = SuggestService(SPACE, max_batch=4, background=False)
+    assert svc.scheduler.ask_latencies.maxlen == METRICS_WINDOW
+    assert svc.scheduler.occupancy.maxlen == METRICS_WINDOW
+    svc.shutdown()
 
 
 def test_bucket_boundary_rebucket_keeps_siblings_bitwise():
