@@ -497,6 +497,91 @@ def bench_serve(space, n_studies=64, rounds=6, n_cand=128,
     }
 
 
+def bench_guard(space, n_cand=128):
+    """graftguard rows (round 13): the runtime-protection layer's
+    three behaviors, measured on small deterministic scenarios.
+
+    ``serve_shed_rate``: fraction of a 4x-overcommitted submit storm
+    refused with typed ``Overloaded`` (deterministic: counted, the
+    queue bound decides it).  ``serve_quarantine_count``: finite-check
+    trips a NaN-telling tenant accrues before K-trip eviction
+    (deterministic: equals the eviction threshold).
+    ``serve_watchdog_recovery_ms``: wall-clock from an injected
+    dispatch hang's watchdog timeout to the retried round serving
+    (measured; the one timing row).
+    """
+    from hyperopt_tpu.distributed.faults import DeviceFaultPlan, FaultPlan
+    from hyperopt_tpu.exceptions import Overloaded, ServeError
+    from hyperopt_tpu.serve import SuggestService
+
+    def loss(vals):
+        return sum(
+            float(v) for v in vals.values() if isinstance(v, (int, float))
+        )
+
+    # -- shed rate under a 4x submit storm --------------------------------
+    svc = SuggestService(
+        space, max_batch=8, background=False, n_startup_jobs=3,
+        n_cand=n_cand, max_queue=8, study_queue_cap=2,
+    )
+    handles = [svc.create_study(f"ov{i}", seed=i) for i in range(8)]
+    futs = []
+    for _ in range(4):
+        for h in handles:
+            try:
+                futs.append(h.ask_async())
+            except Overloaded:
+                pass
+    while any(not f.done() for f in futs):
+        svc.pump()
+    sched = svc.scheduler
+    shed_rate = sched.shed_count / (sched.shed_count + sched.admitted_count)
+    svc.shutdown()
+
+    # -- quarantine trips to eviction for a NaN tenant --------------------
+    svc = SuggestService(
+        space, max_batch=4, background=False, n_startup_jobs=3,
+        n_cand=n_cand,
+    )
+    bad = svc.create_study("bad", seed=1)
+    first = dict(svc.create_study("probe", seed=2).ask(timeout=60)[1])
+    bad.tell(0, float("nan"), vals=first)
+    for _ in range(4):
+        if svc.scheduler.study("bad").quarantined:
+            break
+        try:
+            f = bad.ask_async()
+            svc.pump()
+            f.exception(timeout=60)
+        except ServeError:
+            break
+    quarantine_count = svc.scheduler.quarantine_count
+    assert svc.scheduler.evictions == 1
+    svc.shutdown()
+
+    # -- watchdog recovery from a hung dispatch ---------------------------
+    plan = FaultPlan(seed=0, device=DeviceFaultPlan(hang_at=2, hang_s=0.5))
+    svc = SuggestService(
+        space, max_batch=4, background=False, n_startup_jobs=3,
+        n_cand=n_cand, fs=plan.fs(),
+    )
+    h = svc.create_study("w", seed=3)
+    for rnd in range(2):
+        tid, vals = h.ask(timeout=60)
+        h.tell(tid, loss(vals))
+        if rnd == 0:  # arm after the compile round
+            svc.scheduler.dispatch_timeout = 0.1
+    assert svc.scheduler.watchdog_recoveries == 1
+    recovery_ms = float(svc.scheduler.watchdog_recovery_ms[0])
+    svc.shutdown()
+
+    return {
+        "serve_shed_rate": round(float(shed_rate), 4),
+        "serve_quarantine_count": int(quarantine_count),
+        "serve_watchdog_recovery_ms": round(recovery_ms, 3),
+    }
+
+
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
@@ -775,6 +860,9 @@ def main():
         rounds=int(os.environ.get("BENCH_SERVE_ROUNDS", "6")),
         n_cand=n_cand,
     )
+    # round-13 graftguard rows: overload shedding, poisoned-tenant
+    # quarantine, and watchdog recovery on deterministic scenarios
+    guard_rows = bench_guard(space, n_cand=n_cand)
     loop_rate = bench_device_loop() if platform != "cpu" else None
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
@@ -839,6 +927,10 @@ def main():
                 # round-12 serve rows (bench_serve): study-batched
                 # fused tell+ask with continuous batching
                 **serve_rows,
+                # round-13 graftguard rows (bench_guard): runtime
+                # protection -- shed rate, quarantine trips, watchdog
+                # recovery latency
+                **guard_rows,
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),

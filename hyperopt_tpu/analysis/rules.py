@@ -574,6 +574,109 @@ def check_state_dump_bypasses_durable_saver(ctx):
             )
 
 
+#: method names that mark a class as a LONG-LIVED service object (it
+#: runs/serves/pumps for the process lifetime, so per-event growth is a
+#: leak, not a working buffer)
+_SERVICE_METHODS = frozenset({
+    "start", "stop", "step", "serve_forever", "pump", "shutdown",
+    "drain", "_loop", "loop", "run_forever",
+})
+
+#: calls on the attribute that bound its growth
+_BOUNDING_CALLS = frozenset({"pop", "popleft", "clear", "remove"})
+
+
+def _self_attr(node, attrs):
+    """``node`` is ``self.<attr>`` for an attr in ``attrs``?"""
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and node.attr in attrs
+    )
+
+
+@register(
+    "GL306", "unbounded-append-on-service-object",
+    "a plain-list attribute of a long-lived service class grows by "
+    "append with no bounding operation anywhere in the class -- a slow "
+    "per-event leak; use a maxlen deque or trim it",
+)
+def check_unbounded_service_append(ctx):
+    # the PR-8 review leak class: BatchScheduler.ask_latencies grew one
+    # entry per ask forever until it became a maxlen ring buffer.  A
+    # heuristic single-class dataflow: list attrs born in __init__,
+    # appended to by the service's methods, never popped/cleared/
+    # trimmed/rebound anywhere in the class.
+    if _is_test_file(ctx):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        if not (_SERVICE_METHODS & set(methods)):
+            continue
+        init = methods.get("__init__")
+        if init is None:
+            continue
+        list_attrs = {
+            t.attr
+            for node in ast.walk(init)
+            if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.List)
+            for t in node.targets
+            if isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+        }
+        if not list_attrs:
+            continue
+        appends, bounded = {}, set()
+        for name, fn in methods.items():
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and _self_attr(node.func.value, list_attrs)
+                ):
+                    attr = node.func.value.attr
+                    if node.func.attr == "append" and name != "__init__":
+                        appends.setdefault(attr, []).append(node)
+                    elif node.func.attr in _BOUNDING_CALLS:
+                        bounded.add(attr)
+                elif isinstance(node, ast.Delete):
+                    for t in node.targets:
+                        tv = getattr(t, "value", None)
+                        if isinstance(t, ast.Subscript) and _self_attr(
+                            tv, list_attrs
+                        ):
+                            bounded.add(tv.attr)
+                elif isinstance(node, ast.Assign) and name != "__init__":
+                    for t in node.targets:
+                        if _self_attr(t, list_attrs):
+                            bounded.add(t.attr)  # rebound (swap/reset)
+                        tv = getattr(t, "value", None)
+                        if isinstance(t, ast.Subscript) and _self_attr(
+                            tv, list_attrs
+                        ):
+                            bounded.add(tv.attr)  # slice trim
+        for attr, nodes in appends.items():
+            if attr in bounded:
+                continue
+            for node in nodes:
+                yield ctx.finding(
+                    "GL306", node,
+                    f"self.{attr} grows by append on long-lived service "
+                    f"class {cls.name} with no pop/clear/trim/rebind in "
+                    "the class: a per-event leak on a process that "
+                    "serves forever -- use collections.deque(maxlen=...)"
+                    " or trim it",
+                )
+
+
 _NP_GLOBAL_STATE = frozenset({
     "seed", "rand", "randn", "randint", "random", "uniform", "normal",
     "choice", "shuffle", "permutation", "standard_normal", "beta",

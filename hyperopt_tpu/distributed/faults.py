@@ -49,6 +49,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
+    "DeviceFaultPlan",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
     "ALL_CRASH_POINTS",
 ]
@@ -172,6 +173,116 @@ class RealFS:
 REAL_FS = RealFS()
 
 
+class DeviceFaultPlan:
+    """A seeded, deterministic schedule of DEVICE faults for the serve
+    dispatch path -- the accelerator-side twin of the fs primitives
+    below, injected through the scheduler's ``fs=`` seam (``FaultPlan(
+    device=DeviceFaultPlan(...))``), never by monkeypatching.
+
+    Three fault classes, all keyed to the scheduler's own dispatch
+    ordinal so a same-seed replay injects identically:
+
+    * **NaN corruption** (``nan_study`` + ``nan_at`` / ``nan_count``):
+      from the ``nan_at``-th dispatch on, the named tenant's batched
+      step output columns are overwritten with NaN -- the poisoned-slot
+      signal graftguard's fused finite-check must catch without
+      disturbing sibling slots.  ``nan_count=None`` poisons every
+      dispatch (a deterministically bad tenant, driving K-trip
+      eviction); ``nan_count=n`` poisons only the first ``n`` hits (a
+      transient device fault the re-materialization path absorbs).
+    * **Hang** (``hang_at`` + ``hang_s``): the ``hang_at``-th dispatch
+      sleeps ``hang_s`` seconds inside the dispatch closure -- armed
+      past the scheduler's watchdog deadline it simulates a wedged
+      device; one-shot.
+    * **Raises** (``raise_rate`` + ``burst``): each dispatch raises
+      :class:`~hyperopt_tpu.exceptions.TransientBackendError` with the
+      given probability, burst-bounded to ``burst`` CONSECUTIVE raises
+      so the watchdog's retry-once always converges at ``burst=1``.
+      ``fatal_at`` instead raises a plain ``RuntimeError`` at that
+      ordinal -- the deterministic-program-bug case ``is_transient``
+      must classify as NOT worth retrying.
+    """
+
+    def __init__(self, seed=0, nan_study=None, nan_at=1, nan_count=None,
+                 hang_at=None, hang_s=0.2, raise_rate=0.0, burst=1,
+                 fatal_at=None):
+        self.seed = int(seed)
+        self.nan_study = nan_study
+        self.nan_at = int(nan_at)
+        self.nan_count = None if nan_count is None else int(nan_count)
+        self.hang_at = None if hang_at is None else int(hang_at)
+        self.hang_s = min(float(hang_s), 0.5)  # chaos-suite time budget
+        self.raise_rate = float(raise_rate)
+        self.burst = int(burst)
+        self.fatal_at = None if fatal_at is None else int(fatal_at)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.RLock()
+        self._ordinal = 0
+        self._raise_streak = 0
+        self._nan_hits = 0
+        self.stats = collections.Counter()
+        self.log = []
+
+    def on_dispatch(self):
+        """Called inside the dispatch closure, before the device
+        program runs: may sleep (hang) or raise (injected dispatch
+        fault).  One RNG draw per call when ``raise_rate`` is set, so
+        the schedule is a pure function of the dispatch sequence."""
+        from ..exceptions import TransientBackendError
+
+        with self._lock:
+            self._ordinal += 1
+            ordinal = self._ordinal
+            hang = self.hang_at is not None and ordinal == self.hang_at
+            fatal = self.fatal_at is not None and ordinal == self.fatal_at
+            raise_now = False
+            if self.raise_rate:
+                roll = self._rng.random() < self.raise_rate
+                if roll and self._raise_streak < self.burst:
+                    self._raise_streak += 1
+                    raise_now = True
+                else:
+                    self._raise_streak = 0
+            if hang:
+                self.stats["device:hang"] += 1
+                self.log.append(("dispatch", ordinal, "hang"))
+            elif fatal:
+                self.stats["device:fatal"] += 1
+                self.log.append(("dispatch", ordinal, "fatal"))
+            elif raise_now:
+                self.stats["device:raise"] += 1
+                self.log.append(("dispatch", ordinal, "raise"))
+            else:
+                self.log.append(("dispatch", ordinal, "ok"))
+        if hang:
+            time.sleep(self.hang_s)
+        if fatal:
+            raise RuntimeError(
+                f"injected deterministic program bug at dispatch {ordinal}"
+            )
+        if raise_now:
+            raise TransientBackendError(
+                f"injected transient device fault at dispatch {ordinal}"
+            )
+
+    def corrupt_outputs(self, new_v, slot_of):
+        """NaN-poison the named tenant's suggestion columns in the
+        fetched batched-step output (``new_v`` is the host ``[S, D,
+        batch]`` array, ``slot_of`` maps study name -> slot index).
+        Mutates in place; sibling slots are never touched."""
+        if self.nan_study is None or self.nan_study not in slot_of:
+            return
+        with self._lock:
+            if self._ordinal < self.nan_at:
+                return
+            if self.nan_count is not None and self._nan_hits >= self.nan_count:
+                return
+            self._nan_hits += 1
+            self.stats["device:nan"] += 1
+            self.log.append(("corrupt", self._ordinal, self.nan_study))
+        new_v[slot_of[self.nan_study]] = float("nan")
+
+
 class FaultPlan:
     """A seeded, deterministic schedule of faults.
 
@@ -196,10 +307,15 @@ class FaultPlan:
                bounds the adversary so a retry loop of ``burst + 1``
                attempts always converges.  ``None`` = unbounded.
       ops:     restrict error injection to these op names (None = all).
+      device:  an optional :class:`DeviceFaultPlan` riding along -- the
+               serve scheduler discovers it through its ``fs=`` seam
+               (``fs.plan.device``) and injects the device-side faults
+               at dispatch time.
     """
 
     def __init__(self, seed=0, rate=0.0, errors=DEFAULT_ERRORS,
-                 latency=0.0, partial_rate=0.0, burst=2, ops=None):
+                 latency=0.0, partial_rate=0.0, burst=2, ops=None,
+                 device=None):
         self.seed = seed
         self.rate = float(rate)
         self.errors = tuple(errors)
@@ -207,6 +323,7 @@ class FaultPlan:
         self.partial_rate = float(partial_rate)
         self.burst = burst
         self.ops = None if ops is None else frozenset(ops)
+        self.device = device
         self._rng = random.Random(seed)
         self._lock = threading.RLock()
         self._consecutive = {}
@@ -217,8 +334,9 @@ class FaultPlan:
     def split(self, name):
         """A derived plan with the same fault profile and a stably
         derived seed (crc32, not ``hash()`` -- PYTHONHASHSEED must not
-        leak into the schedule).  Crash points are NOT inherited: arm
-        them on exactly the plan whose actor should die."""
+        leak into the schedule).  Crash points and the device-fault
+        plan are NOT inherited: arm them on exactly the plan whose
+        actor should die (or whose dispatches should misbehave)."""
         child_seed = zlib.crc32(f"{self.seed}/{name}".encode())
         return FaultPlan(
             seed=child_seed, rate=self.rate, errors=self.errors,

@@ -81,3 +81,53 @@ class ClaimLost(BackendError):
     while it was still evaluating -- detected at completion time so the
     stale worker drops its result instead of racing the re-run into a
     duplicate DONE doc."""
+
+
+class ServeError(HyperoptTpuError):
+    """Base of the suggestion service's runtime-protection (graftguard)
+    errors.  Every one is a *structured refusal*: the service stays
+    healthy, the client gets a typed reason and (where it makes sense)
+    a hint about what to do next."""
+
+
+class Overloaded(ServeError):
+    """The service refused to admit an ask: the bounded queue is at its
+    high-water mark, the study hit its fairness cap, the batcher's
+    circuit breaker is open, or the service is draining for a rolling
+    restart.  ``retry_after`` (seconds, may be None while draining) is
+    computed from current queue occupancy and the p50 ask latency --
+    back off that long and resubmit.  ``reason`` is one of
+    ``queue_full`` / ``study_queue_cap`` / ``circuit_open`` /
+    ``draining``."""
+
+    def __init__(self, message, retry_after=None, reason="queue_full"):
+        super().__init__(message)
+        self.retry_after = retry_after
+        self.reason = reason
+
+
+class DeadlineExpired(ServeError):
+    """An ask's client deadline passed before the service could serve
+    it -- shed at submit (already expired) or dropped from the queue
+    (expired while waiting) instead of wasting a dispatch slot on an
+    answer nobody is waiting for."""
+
+
+class StudyPoisoned(ServeError):
+    """The fused finite-check caught non-finite values in this study's
+    slot (its resident history or this round's suggestion): the ask is
+    failed back to this client only, the slot re-materializes from
+    host truth, and sibling slots are untouched."""
+
+
+class StudyQuarantined(StudyPoisoned):
+    """The study tripped the finite-check K consecutive times and was
+    evicted from the slotted batch (its host truth itself is poisoned,
+    e.g. a told NaN loss).  Asks and tells are refused until the study
+    is closed; sibling studies are unaffected."""
+
+
+class DispatchTimeout(ServeError):
+    """A device dispatch exceeded the scheduler's watchdog deadline.
+    Treated as transient: the round retries once against a freshly
+    re-materialized stacked state before failing the picked asks."""

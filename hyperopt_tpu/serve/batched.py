@@ -36,6 +36,7 @@ __all__ = [
     "StudyBatchState",
     "build_batched_step_fn",
     "build_batched_delta_fn",
+    "build_finite_check_fn",
     "stack_states",
     "slot_capacity",
     "MIN_SLOTS",
@@ -218,6 +219,45 @@ def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
     return fn
 
 
+_FINITE_CHECK_FN = None  # lazily-built; shared by every scheduler
+
+
+def build_finite_check_fn():
+    """The graftguard poisoned-slot detector: ``fn(values, active,
+    losses, valid, new_v) -> poisoned [S] bool``.
+
+    One cheap fused reduction over the stacked state and the round's
+    suggestion columns: a slot is POISONED when any active history
+    value, any valid loss, or any of this round's suggestion columns
+    is non-finite -- the signature of a tenant telling NaN/Inf losses,
+    a corrupted resident slot, or a device fault scribbling NaN into
+    the batched step output.  Masked positions (inactive dims, empty
+    history slots) are exempt: a freed or short slot's garbage tail
+    must never trip a healthy tenant.
+
+    Read-only by design (NO donation): it runs between the batched
+    step and the acks, and the state it inspects is the state the next
+    round dispatches from.  Built once per process -- like the delta
+    drain, it has no space dependence."""
+    global _FINITE_CHECK_FN
+    if _FINITE_CHECK_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def finite_check(values, active, losses, valid, new_v):
+            v_ok = jnp.all(
+                jnp.isfinite(jnp.where(active, values, 0.0)), axis=(1, 2)
+            )
+            l_ok = jnp.all(
+                jnp.isfinite(jnp.where(valid, losses, 0.0)), axis=1
+            )
+            s_ok = jnp.all(jnp.isfinite(new_v), axis=(1, 2))
+            return ~(v_ok & l_ok & s_ok)
+
+        _FINITE_CHECK_FN = jax.jit(finite_check)
+    return _FINITE_CHECK_FN
+
+
 _BATCHED_DELTA_FN = None  # lazily-built; shared by every scheduler
 
 
@@ -302,4 +342,29 @@ def _registry_serve_delta(p):
         fn=fn,
         args=p.study_history_specs() + p.study_delta_specs(),
         donate_argnums=(0, 1, 2, 3),
+    )
+
+
+@register_program(
+    "serve.guard_finite_check",
+    families=(
+        "hyperopt_tpu.serve.batched:build_finite_check_fn",
+    ),
+)
+def _registry_guard_finite_check(p):
+    """graftguard's poisoned-slot detector: one fused masked
+    isfinite-reduction over the stacked state and the round's
+    suggestion columns, [S] bool out, NO donation (it inspects the
+    state the next round dispatches from)."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = build_finite_check_fn()
+    s, d = p.n_studies, p.space.n_dims
+    return ProgramCapture(
+        fn=fn,
+        args=p.study_history_specs() + (
+            jax.ShapeDtypeStruct((s, d, 1), jnp.float32),
+        ),
+        donate_argnums=(),
     )

@@ -32,19 +32,30 @@ from __future__ import annotations
 
 import collections
 import logging
+import math
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
 
 import numpy as np
 
+from ..distributed._common import is_transient
 from ..distributed.faults import REAL_FS
+from ..exceptions import (
+    DeadlineExpired,
+    DispatchTimeout,
+    Overloaded,
+    StudyPoisoned,
+    StudyQuarantined,
+)
 from ..jax_trials import MAX_PENDING_DELTAS, MIN_CAPACITY, ObsBuffer
 from .batched import (
     StudyBatchState,
     _dummy_delta,
     build_batched_delta_fn,
     build_batched_step_fn,
+    build_finite_check_fn,
     slot_capacity,
     stack_states,
 )
@@ -57,6 +68,44 @@ __all__ = ["BatchScheduler", "ServeStudy", "dense_to_vals"]
 #: ``occupancy``): plenty for any bench window, bounded for a
 #: long-running service
 METRICS_WINDOW = 65536
+
+#: consecutive finite-check trips before a poisoned study is EVICTED
+#: from the slotted batch (its host truth itself is bad -- e.g. a told
+#: NaN loss survives re-materialization, so retrying cannot heal it)
+QUARANTINE_TRIPS = 3
+
+#: consecutive failed dispatch rounds (after their retry) before the
+#: batcher circuit-breaks into reject-with-Overloaded mode
+CIRCUIT_THRESHOLD = 3
+
+
+def _cache_interlock():
+    """Refuse a known-poisoned configuration: jaxlib 0.4.36's CPU
+    runtime intermittently corrupts the heap when it DESERIALIZES
+    persistently-cached executables of the vmapped serve program
+    family -- warm-cache processes die later with SIGSEGV / glibc
+    abort inside unrelated traces or allocations, while cold-cache
+    runs are clean (reproduced bitwise-at-seed; FAILURES.md "Known
+    test debt").  A scheduler on the CPU backend therefore disables
+    the persistent compilation cache process-wide, loudly, before its
+    first program builds; accelerator backends keep the cache (the
+    fault is in the CPU executable deserializer, and compile seconds
+    actually matter there)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return
+    if not getattr(jax.config, "jax_enable_compilation_cache", False):
+        return
+    if not getattr(jax.config, "jax_compilation_cache_dir", None):
+        return
+    logger.warning(
+        "graftserve: disabling the persistent XLA compilation cache "
+        "on the CPU backend -- jaxlib 0.4.36 heap-corrupts when "
+        "deserializing cached serve-family executables (see "
+        "FAILURES.md); programs will compile fresh in this process"
+    )
+    jax.config.update("jax_enable_compilation_cache", False)
 
 
 def dense_to_vals(ps, col_v, col_a):
@@ -89,6 +138,8 @@ class ServeStudy:
         self.pending = collections.deque()  # staged (vcol, acol, loss, idx)
         self.dirty = True  # device slot needs re-materialization
         self.closed = False
+        self.quarantined = False  # evicted by the finite-check guard
+        self.poison_trips = 0  # CONSECUTIVE finite-check trips
         self.next_tid = 0
         self.n_asks = 0
         self.n_tells = 0
@@ -109,14 +160,15 @@ class ServeStudy:
 
 
 class _AskRequest:
-    __slots__ = ("study", "tid", "seed", "future", "t_submit")
+    __slots__ = ("study", "tid", "seed", "future", "t_submit", "deadline")
 
-    def __init__(self, study, tid, seed):
+    def __init__(self, study, tid, seed, deadline=None):
         self.study = study
         self.tid = tid
         self.seed = seed
         self.future = Future()
         self.t_submit = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter() instant
 
 
 class BatchScheduler:
@@ -135,17 +187,75 @@ class BatchScheduler:
     programs, included in ``dispatch_count``), ``upload_events`` /
     ``upload_bytes`` (stacked re-materializations), ``joins``,
     ``rebuckets``.  ``ask_latencies`` / ``occupancy`` feed the bench.
+
+    graftguard (the runtime-protection layer):
+
+    * **Admission control** -- the ask queue is bounded at ``max_queue``
+      (default ``4 * max_batch``) with a per-study fairness cap
+      (``study_queue_cap``); a submit past either is refused with a
+      typed :class:`~hyperopt_tpu.exceptions.Overloaded` carrying a
+      retry-after hint derived from queue occupancy and the p50 ask
+      latency.  An ask whose client deadline already passed is shed
+      (:class:`~hyperopt_tpu.exceptions.DeadlineExpired`) before it
+      wastes a dispatch slot; admission happens BEFORE the per-study
+      seed draw, so a shed submit never perturbs the study's stream.
+    * **Poisoned-tenant isolation** -- after every batched step a fused
+      finite-check (:func:`~hyperopt_tpu.serve.batched.
+      build_finite_check_fn`) scans the stacked state and the round's
+      suggestions; a tripping slot fails only ITS client
+      (:class:`~hyperopt_tpu.exceptions.StudyPoisoned`), re-materializes
+      from host truth, and is evicted after :data:`QUARANTINE_TRIPS`
+      consecutive trips (:class:`~hyperopt_tpu.exceptions.
+      StudyQuarantined`); sibling slots stay bitwise undisturbed.
+    * **Dispatch watchdog** -- with ``dispatch_timeout`` set, every
+      device dispatch runs under a deadline; a timeout or transiently
+      raising dispatch retries ONCE against a freshly re-materialized
+      stacked state (deterministic program bugs -- not
+      ``is_transient`` -- skip the pointless retry), and
+      :data:`CIRCUIT_THRESHOLD` consecutive failed rounds circuit-break
+      the batcher into reject-with-Overloaded mode instead of
+      crash-looping.
+    * **Device-fault injection** -- a :class:`~hyperopt_tpu.distributed.
+      faults.DeviceFaultPlan` riding the ``fs=`` seam (``fs.plan.
+      device``) injects NaN outputs, dispatch hangs, and dispatch
+      raises deterministically; the guard chaos suite
+      (``tests/test_serve_guard.py``) drives all of the above with it.
     """
 
     def __init__(self, ps, algo="tpe", max_batch=64, max_wait=0.002,
-                 n_startup_jobs=20, fs=REAL_FS, **algo_kw):
+                 n_startup_jobs=20, fs=REAL_FS, max_queue=None,
+                 study_queue_cap=None, dispatch_timeout=None,
+                 finite_check=True, quarantine_trips=QUARANTINE_TRIPS,
+                 circuit_threshold=CIRCUIT_THRESHOLD, **algo_kw):
         self.ps = ps
         self.algo = str(algo)
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait)
         self.n_startup_jobs = int(n_startup_jobs)
         self.fs = fs
+        self.max_queue = (
+            4 * self.max_batch if max_queue is None else int(max_queue)
+        )
+        # fairness: one tenant may hold at most this many queued asks,
+        # so a storm from one study cannot starve the others out of the
+        # bounded queue (default: an even share, floored at 2)
+        self.study_queue_cap = (
+            max(2, self.max_queue // self.max_batch)
+            if study_queue_cap is None else int(study_queue_cap)
+        )
+        self.dispatch_timeout = (
+            None if dispatch_timeout is None else float(dispatch_timeout)
+        )
+        self.finite_check = bool(finite_check)
+        self.quarantine_trips = int(quarantine_trips)
+        self.circuit_threshold = int(circuit_threshold)
+        # the device-fault seam: a DeviceFaultPlan riding the fs plan
+        # (REAL_FS has no plan -> None -> zero overhead in production)
+        self._device_faults = getattr(
+            getattr(fs, "plan", None), "device", None
+        )
         self.algo_kw = dict(algo_kw)
+        _cache_interlock()  # before any serve program builds/loads
         if self.algo == "tpe":
             from ..tpe_jax import _resolve_above_cap
 
@@ -158,6 +268,7 @@ class BatchScheduler:
             ps, algo=self.algo, **self.algo_kw
         )
         self._delta_fn = build_batched_delta_fn()
+        self._finite_fn = build_finite_check_fn()
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -172,6 +283,12 @@ class BatchScheduler:
         self._thread = None
         self._stopping = False
 
+        # graftguard state
+        self.draining = False
+        self.circuit_open = False
+        self._round_failures = 0  # CONSECUTIVE failed dispatch rounds
+        self._queued_per_study = collections.Counter()
+
         # deterministic accounting
         self.dispatch_count = 0
         self.delta_drain_dispatches = 0
@@ -179,6 +296,18 @@ class BatchScheduler:
         self.upload_bytes = 0
         self.joins = 0
         self.rebuckets = 0
+        # graftguard accounting (deterministic, except the _ms timings)
+        self.admitted_count = 0
+        self.shed_count = 0  # Overloaded + DeadlineExpired refusals
+        self.guard_checks = 0  # finite-check programs run
+        self.quarantine_count = 0  # finite-check trips (per slot-round)
+        self.evictions = 0  # studies evicted after K trips
+        self.watchdog_timeouts = 0
+        self.watchdog_retries = 0
+        self.watchdog_recoveries = 0
+        self.watchdog_recovery_ms = collections.deque(
+            maxlen=METRICS_WINDOW
+        )
         # bounded: bench metrics on a long-running service must not
         # grow one entry per ask forever (slow leak at scale)
         self.ask_latencies = collections.deque(maxlen=METRICS_WINDOW)
@@ -211,14 +340,17 @@ class BatchScheduler:
 
     def close_study(self, name):
         """Leave: free the slot (device data becomes garbage behind the
-        active-slot mask -- siblings are untouched, no re-upload)."""
+        active-slot mask -- siblings are untouched, no re-upload).  An
+        evicted (quarantined) study has no slot to free."""
         with self._lock:
             st = self._studies.pop(name)
             st.closed = True
-            self._slots.pop(st.slot, None)
-            self._free.append(st.slot)
-            self._free.sort(reverse=True)  # reuse lowest slots first
-            st.slot = None
+            if st.slot is not None:
+                self._slots.pop(st.slot, None)
+                self._free.append(st.slot)
+                self._free.sort(reverse=True)  # reuse lowest slots first
+                st.slot = None
+            self._queued_per_study.pop(name, None)
             return st
 
     def study(self, name):
@@ -235,6 +367,11 @@ class BatchScheduler:
         crashed service lost (the tell may already have been WAL-
         replayed on restore) is absorbed exactly once."""
         with self._lock:
+            if study.quarantined:
+                raise StudyQuarantined(
+                    f"study {study.name!r} was evicted by the finite-"
+                    "check guard; close it and open a fresh study"
+                )
             buf = study.buf
             if (buf.tids[: buf.count] == int(tid)).any():
                 study.outstanding.pop(tid, None)
@@ -272,25 +409,128 @@ class BatchScheduler:
             study.pending.clear()
 
     # -- ask ---------------------------------------------------------------
-    def submit_ask(self, study):
-        """Queue one ask; returns ``(tid, Future)``.  The per-ask seed
-        is drawn HERE, from the study's own stream -- the batching
-        order downstream can no longer affect the suggestion."""
+    def retry_after(self):
+        """The back-off hint an :class:`Overloaded` refusal carries:
+        how long until the queue has likely drained one slot -- rounds
+        pending at current occupancy x the p50 ask latency (a fresh
+        service with no latency history hints 10 ms)."""
+        with self._lock:
+            rounds = max(1, math.ceil(
+                (len(self._asks) + 1) / max(1, self.max_batch)
+            ))
+            lats = sorted(self.ask_latencies)
+        p50 = lats[len(lats) // 2] if lats else 0.010
+        return round(rounds * p50, 6)
+
+    def _dec_queue(self, req):
+        """A request left the queue for good (picked, shed, dropped,
+        or drained): release its per-study fairness budget."""
+        c = self._queued_per_study
+        name = req.study.name
+        if c.get(name, 0) <= 1:
+            c.pop(name, None)
+        else:
+            c[name] -= 1
+
+    def submit_ask(self, study, deadline=None):
+        """Queue one ask; returns the queued request (``.tid`` /
+        ``.future``).  The per-ask seed is drawn HERE, from the study's
+        own stream -- the batching order downstream can no longer
+        affect the suggestion.
+
+        Admission control runs BEFORE the seed draw: a refused submit
+        (:class:`Overloaded` / :class:`DeadlineExpired` /
+        :class:`StudyQuarantined`) consumes nothing from the study's
+        seed stream or tid space, so shedding never perturbs the
+        suggestion stream of the asks that are admitted.
+
+        ``deadline`` is an absolute ``time.perf_counter()`` instant;
+        an already-expired deadline is shed here, an expiry while
+        queued is shed at pick time (:meth:`_pick_round`) -- either
+        way the request never consumes a dispatch slot."""
         with self._lock:
             if self._stopping:
                 raise RuntimeError("suggestion service shutting down")
             if study.closed:
                 raise ValueError(f"study {study.name!r} is closed")
+            if study.quarantined:
+                raise StudyQuarantined(
+                    f"study {study.name!r} was evicted after "
+                    f"{self.quarantine_trips} consecutive finite-check "
+                    "trips (its history contains non-finite values); "
+                    "close it and open a fresh study"
+                )
+            if self.draining:
+                self.shed_count += 1
+                raise Overloaded(
+                    "service is draining for shutdown; retry against "
+                    "another replica", reason="draining",
+                )
+            if self.circuit_open:
+                self.shed_count += 1
+                raise Overloaded(
+                    "batcher circuit breaker is open after "
+                    f"{self.circuit_threshold} consecutive failed "
+                    "dispatch rounds; the service needs operator "
+                    "attention (reset_circuit)",
+                    retry_after=self.retry_after(), reason="circuit_open",
+                )
+            if deadline is not None and time.perf_counter() >= deadline:
+                self.shed_count += 1
+                raise DeadlineExpired(
+                    f"ask for study {study.name!r} submitted with an "
+                    "already-expired deadline; shed before queueing"
+                )
+            if len(self._asks) >= self.max_queue:
+                self.shed_count += 1
+                raise Overloaded(
+                    f"ask queue at high-water mark ({self.max_queue}); "
+                    "back off and resubmit",
+                    retry_after=self.retry_after(), reason="queue_full",
+                )
+            if self._queued_per_study.get(study.name, 0) >= \
+                    self.study_queue_cap:
+                self.shed_count += 1
+                raise Overloaded(
+                    f"study {study.name!r} already holds "
+                    f"{self.study_queue_cap} queued asks (per-study "
+                    "fairness cap); tell or await results first",
+                    retry_after=self.retry_after(),
+                    reason="study_queue_cap",
+                )
             seed = int(study.rstate.integers(2**31 - 1))
             tid = study.next_tid
             study.next_tid = tid + 1
             study.n_asks += 1
+            self.admitted_count += 1
             if study.persist is not None:
                 study.persist.log_ask(tid, seed, study.rstate)
-            req = _AskRequest(study, tid, seed)
+            req = _AskRequest(study, tid, seed, deadline=deadline)
             self._asks.append(req)
+            self._queued_per_study[study.name] += 1
             self._cond.notify_all()
-            return tid, req.future
+            return req
+
+    def drop_request(self, req):
+        """Drop a still-queued request (the slow-client path: its
+        ``ask(timeout=...)`` gave up).  Returns True when the request
+        was still queued -- its future is failed with
+        :class:`DeadlineExpired` and it will never consume a dispatch
+        slot; False when it was already picked (the in-flight dispatch
+        will resolve it)."""
+        with self._lock:
+            try:
+                self._asks.remove(req)
+            except ValueError:
+                return False
+            self._dec_queue(req)
+            self.shed_count += 1
+        if not req.future.done():
+            req.future.set_exception(DeadlineExpired(
+                f"ask tid={req.tid} for study {req.study.name!r} "
+                "dropped from the queue: its client stopped waiting"
+            ))
+        return True
 
     # -- the dispatch round ------------------------------------------------
     def _compute_bucket(self):
@@ -351,27 +591,48 @@ class BatchScheduler:
                     dloss[st.slot] = lo
                     didx[st.slot] = n
                     dapply[st.slot] = True
-            out = self._delta_fn(
+            out = self._run_dispatch(lambda: self._delta_fn(
                 *self._state, vcol, acol, dloss, didx, dapply
-            )
+            ))
             self._state = StudyBatchState(*out)
             self.dispatch_count += 1
             self.delta_drain_dispatches += 1
 
     def _pick_round(self):
-        """At most one queued ask per study this round, FIFO."""
+        """At most one queued ask per study this round, FIFO.  Expired
+        deadlines and closed/quarantined studies are shed here -- a
+        request nobody is waiting for must not consume a dispatch
+        slot."""
+        now = time.perf_counter()
         picked, leftover, seen = [], collections.deque(), set()
         while self._asks:
             req = self._asks.popleft()
             if req.study.closed:
+                self._dec_queue(req)
                 req.future.set_exception(
                     ValueError(f"study {req.study.name!r} closed")
                 )
+                continue
+            if req.study.quarantined:
+                self._dec_queue(req)
+                req.future.set_exception(StudyQuarantined(
+                    f"study {req.study.name!r} was evicted by the "
+                    "finite-check guard while this ask was queued"
+                ))
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._dec_queue(req)
+                self.shed_count += 1
+                req.future.set_exception(DeadlineExpired(
+                    f"ask tid={req.tid} for study {req.study.name!r} "
+                    "expired while queued; shed before dispatch"
+                ))
                 continue
             if id(req.study) in seen or len(picked) >= self.max_batch:
                 leftover.append(req)
                 continue
             seen.add(id(req.study))
+            self._dec_queue(req)
             picked.append(req)
         self._asks = leftover
         return picked
@@ -379,7 +640,16 @@ class BatchScheduler:
     def step(self):
         """One dispatch round: returns the number of asks served.
         Synchronous entry point -- the background loop calls this, and
-        tests/chaos harnesses call it directly so crashes propagate."""
+        tests/chaos harnesses call it directly so crashes propagate.
+
+        The watchdog contract: a dispatch that times out or raises a
+        TRANSIENT fault retries once against a freshly re-materialized
+        stacked state; a failure that survives the retry (or a
+        deterministic program bug, which skips the pointless retry)
+        fails ONLY the picked requests with the typed error and counts
+        toward the circuit breaker -- the batcher itself stays alive.
+        Simulated crashes (:class:`SimulatedCrash` is a BaseException)
+        keep propagating: a dead process serves nobody."""
         with self._lock:
             picked = self._pick_round()
             if not picked:
@@ -387,15 +657,105 @@ class BatchScheduler:
                 # next ask round -- a tell-only window never dispatches
                 return 0
             try:
-                return self._dispatch_round(picked)
+                served = self._dispatch_round(picked)
+                self._round_failures = 0
+                return served
+            except Exception as e:
+                return self._recover_round(picked, e)
             except BaseException as e:
-                # _pick_round already popped these off the queue: a
-                # failed dispatch must fail their futures too, or
+                # simulated process death (and real interpreter exits):
+                # _pick_round already popped these off the queue, so a
+                # dying dispatch must fail their futures too, or
                 # clients blocked in ask() hang out their full timeout
                 for req in picked:
                     if not req.future.done():
                         req.future.set_exception(e)
                 raise
+
+    def _force_rematerialize(self):
+        """Host truth is authoritative: after any failed dispatch the
+        stacked device state (possibly donated away, possibly half-
+        updated) is rebuilt from the per-study buffers on next use."""
+        self._materialize = True
+        for st in self._slots.values():
+            st.dirty = True
+
+    def _recover_round(self, picked, exc):
+        """The watchdog's failure path (lock held): retry once on
+        transient faults, contain the failure to the picked requests
+        otherwise, trip the circuit breaker on repeated failures."""
+        transient = isinstance(exc, DispatchTimeout) or is_transient(exc)
+        self._force_rematerialize()
+        if transient:
+            self.watchdog_retries += 1
+            t0 = time.perf_counter()
+            try:
+                served = self._dispatch_round(picked)
+            except Exception as retry_exc:
+                self._force_rematerialize()
+                exc = retry_exc
+            else:
+                self._round_failures = 0
+                self.watchdog_recoveries += 1
+                self.watchdog_recovery_ms.append(
+                    1000.0 * (time.perf_counter() - t0)
+                )
+                return served
+        logger.warning(
+            "serve dispatch round failed (%s: %s); failing %d picked "
+            "ask(s)", type(exc).__name__, exc, len(picked),
+        )
+        for req in picked:
+            if not req.future.done():
+                req.future.set_exception(exc)
+        self._round_failures += 1
+        if self._round_failures >= self.circuit_threshold:
+            if not self.circuit_open:
+                logger.error(
+                    "serve batcher circuit breaker OPEN after %d "
+                    "consecutive failed rounds; rejecting submits with "
+                    "Overloaded until reset_circuit()",
+                    self._round_failures,
+                )
+            self.circuit_open = True
+        return 0
+
+    def reset_circuit(self):
+        """Operator action: close the circuit breaker and accept
+        submits again (the next failed rounds re-open it)."""
+        with self._lock:
+            self.circuit_open = False
+            self._round_failures = 0
+
+    def _run_dispatch(self, fn):
+        """Run one device dispatch under the watchdog deadline.  With
+        no ``dispatch_timeout`` the call is inline (zero overhead); with
+        one, the dispatch runs on a disposable worker thread and a
+        deadline overrun raises :class:`DispatchTimeout` -- the wedged
+        thread is abandoned (its result, computed over donated buffers
+        the retry no longer uses, is discarded)."""
+        if self.dispatch_timeout is None:
+            return fn()
+        box = Future()
+
+        def work():
+            try:
+                box.set_result(fn())
+            except BaseException as e:  # ferried across the thread
+                box.set_exception(e)   # boundary, re-raised at result()
+
+        t = threading.Thread(
+            target=work, name="graftserve-dispatch", daemon=True
+        )
+        t.start()
+        try:
+            return box.result(timeout=self.dispatch_timeout)
+        except FutureTimeout:
+            self.watchdog_timeouts += 1
+            raise DispatchTimeout(
+                f"device dispatch exceeded the {self.dispatch_timeout}s "
+                "watchdog deadline"
+            ) from None
 
     def _dispatch_round(self, picked):
         """Serve one picked round (lock held): maintain the stacked
@@ -427,21 +787,65 @@ class BatchScheduler:
         for req in picked:
             keys[req.study.slot] = host_key(req.seed % (2**31 - 1))
         self.fs.crashpoint("serve_mid_batch")
-        out = self._step_fn(
-            jnp.stack(keys), *self._state, vcol, acol, dloss, didx,
-            dapply, warm, batch=1,
-        )
-        self._state = StudyBatchState(*out[:4])
+        slot_of = {st.name: st.slot for st in self._slots.values()}
+        device = self._device_faults
+        stacked_keys = jnp.stack(keys)
+        state = self._state
+
+        def run():
+            # everything the watchdog deadline must cover: the injected
+            # device faults, the batched step, and the blocking fetch
+            if device is not None:
+                device.on_dispatch()
+            out = self._step_fn(
+                stacked_keys, *state, vcol, acol, dloss, didx,
+                dapply, warm, batch=1,
+            )
+            new_state = StudyBatchState(*out[:4])
+            new_v, new_a = jax.device_get((out[4], out[5]))
+            # OWNED copies, not device_get's zero-copy views: the view
+            # aliases a device buffer that later rounds DONATE away
+            # (and the injector needs a writable buffer anyway) --
+            # feeding an aliased view back into the finite-check while
+            # its backing buffer gets recycled corrupts the heap
+            new_v = np.array(new_v)
+            new_a = np.array(new_a)
+            if device is not None:  # NaN scribbled into the outputs
+                device.corrupt_outputs(new_v, slot_of)
+            poisoned = None
+            if self.finite_check:
+                poisoned = np.array(jax.device_get(
+                    self._finite_fn(*new_state, new_v)
+                ))
+            return new_state, new_v, new_a, poisoned
+
+        new_state, new_v, new_a, poisoned = self._run_dispatch(run)
+        self._state = new_state
         self.dispatch_count += 1
-        new_v, new_a = jax.device_get((out[4], out[5]))
-        new_v = np.asarray(new_v)
-        new_a = np.asarray(new_a)
+        if self.finite_check:
+            self.guard_checks += 1
+        bad_slots = self._quarantine(poisoned)
         self.fs.crashpoint("serve_after_dispatch_before_ack")
         now = time.perf_counter()
         self.occupancy.append(len(picked) / s)
         results = []
         for req in picked:
             st = req.study
+            if st.slot is None or st.slot in bad_slots:
+                # the poisoned slot's failure is ITS OWN: the typed
+                # error rides this future, siblings ack normally
+                results.append((req, StudyQuarantined(
+                    f"study {st.name!r} was evicted by the finite-check "
+                    "guard (non-finite history); close it and open a "
+                    "fresh study"
+                ) if st.quarantined else StudyPoisoned(
+                    f"study {st.name!r} tripped the finite-check guard "
+                    f"({st.poison_trips}/{self.quarantine_trips} "
+                    "consecutive trips): non-finite values in its slot "
+                    "state or this round's suggestion; the slot is "
+                    "re-materializing from host truth"
+                )))
+                continue
             vals = dense_to_vals(
                 self.ps, new_v[st.slot, :, 0], new_a[st.slot, :, 0]
             )
@@ -452,9 +856,55 @@ class BatchScheduler:
             results.append((req, vals))
         # acks last: a crash above leaves every pick un-acked and
         # replayable, never half-acked
+        served = 0
         for req, vals in results:
-            req.future.set_result((req.tid, vals))
-        return len(picked)
+            if isinstance(vals, Exception):
+                req.future.set_exception(vals)
+            else:
+                req.future.set_result((req.tid, vals))
+                served += 1
+        return served
+
+    def _quarantine(self, poisoned):
+        """Apply one round's finite-check verdicts (lock held): trip
+        counters, dirty-slot re-materialization, and K-trip eviction.
+        Returns the set of slots that tripped this round."""
+        if poisoned is None:
+            return frozenset()
+        bad = {int(i) for i in np.nonzero(poisoned)[0]}
+        tripped = set()
+        for st in list(self._slots.values()):
+            if st.slot in bad:
+                tripped.add(st.slot)
+                st.poison_trips += 1
+                st.dirty = True  # re-materialize from host truth
+                self.quarantine_count += 1
+                logger.warning(
+                    "finite-check trip %d/%d for study %r (slot %d)",
+                    st.poison_trips, self.quarantine_trips, st.name,
+                    st.slot,
+                )
+                if st.poison_trips >= self.quarantine_trips:
+                    self._evict(st)
+            else:
+                st.poison_trips = 0  # trips must be CONSECUTIVE
+        return tripped
+
+    def _evict(self, st):
+        """Evict a poisoned study from the batch: its slot is freed
+        (garbage behind the mask, exactly like close), the study is
+        marked quarantined so asks/tells are refused, and every
+        sibling's device state is left untouched."""
+        logger.error(
+            "evicting study %r after %d consecutive finite-check "
+            "trips; siblings are unaffected", st.name, st.poison_trips,
+        )
+        st.quarantined = True
+        self._slots.pop(st.slot, None)
+        self._free.append(st.slot)
+        self._free.sort(reverse=True)
+        st.slot = None
+        self.evictions += 1
 
     # -- background loop ---------------------------------------------------
     def start(self):
@@ -468,6 +918,15 @@ class BatchScheduler:
             )
             self._thread.start()
 
+    def drain(self):
+        """Enter draining mode (rolling-restart protocol): new submits
+        are refused with ``Overloaded(reason="draining")`` while the
+        already-queued asks keep being served; call :meth:`stop` once
+        the queue is empty."""
+        with self._lock:
+            self.draining = True
+            self._cond.notify_all()
+
     def stop(self):
         with self._lock:
             self._stopping = True
@@ -479,6 +938,7 @@ class BatchScheduler:
             # instead of letting ask() hang out its full timeout
             while self._asks:
                 req = self._asks.popleft()
+                self._dec_queue(req)
                 if not req.future.done():
                     req.future.set_exception(
                         RuntimeError("suggestion service shutting down")
@@ -488,11 +948,13 @@ class BatchScheduler:
 
     def _ready(self):
         """Dispatch early once every open study has an ask queued (or
-        the queue already fills the batch)."""
-        distinct = {id(r.study) for r in self._asks}
-        return len(distinct) >= min(
-            max(len(self._studies), 1), self.max_batch
+        the queue already fills the batch).  Quarantined studies never
+        ask again, so they do not count toward 'every'."""
+        active = sum(
+            1 for st in self._studies.values() if not st.quarantined
         )
+        distinct = {id(r.study) for r in self._asks}
+        return len(distinct) >= min(max(active, 1), self.max_batch)
 
     def _loop(self):
         while True:
@@ -514,9 +976,13 @@ class BatchScheduler:
                 self.step()
             except BaseException:
                 # a dying batcher must not strand blocked clients
+                # (contained dispatch failures no longer land here --
+                # step() fails only the picked futures and survives;
+                # this is the SimulatedCrash / interpreter-exit path)
                 with self._lock:
                     while self._asks:
                         req = self._asks.popleft()
+                        self._dec_queue(req)
                         req.future.set_exception(
                             RuntimeError("serve batcher died")
                         )

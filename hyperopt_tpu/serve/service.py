@@ -31,6 +31,7 @@ import threading
 import numpy as np
 
 from ..distributed.faults import REAL_FS
+from ..exceptions import Overloaded, ServeError
 from ..ops.compile import compile_space
 from ..utils.wal import TellWAL
 from .scheduler import BatchScheduler, ServeStudy
@@ -255,14 +256,23 @@ class StudyHandle:
         return self._study.name
 
     def ask_async(self):
-        """Queue one ask; returns a Future of ``(tid, vals)``."""
+        """Queue one ask; returns a Future of ``(tid, vals)``.  Raises
+        :class:`~hyperopt_tpu.exceptions.Overloaded` (with a
+        ``retry_after`` hint) when admission control refuses the
+        submit."""
         return self._service._ask_async(self._study)
 
     def ask(self, timeout=60.0):
-        """One suggestion, blocking until its batch is served."""
-        fut = self.ask_async()
-        self._service._drive(fut, timeout)
-        return fut.result(timeout=timeout)
+        """One suggestion, blocking until its batch is served.
+
+        ``timeout`` doubles as the CLIENT DEADLINE the scheduler
+        sheds against: an ask still queued when it passes is dropped
+        from the queue (it will never consume a dispatch slot) and
+        raises :class:`~hyperopt_tpu.exceptions.DeadlineExpired`; one
+        already picked into an in-flight dispatch is awaited a short
+        grace period instead."""
+        req = self._service._submit(self._study, timeout=timeout)
+        return self._service._await(req, timeout)
 
     def tell(self, tid, loss, vals=None):
         """Report one evaluation.  ``vals`` defaults to what the
@@ -305,7 +315,9 @@ class SuggestService:
 
     def __init__(self, space, algo="tpe", root=None, max_batch=64,
                  max_wait_ms=2.0, n_startup_jobs=20, background=True,
-                 fs=REAL_FS, snapshot_cadence=256, **algo_kw):
+                 fs=REAL_FS, snapshot_cadence=256, max_queue=None,
+                 study_queue_cap=None, dispatch_timeout=None,
+                 finite_check=True, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -318,7 +330,10 @@ class SuggestService:
         self.scheduler = BatchScheduler(
             self.ps, algo=algo, max_batch=max_batch,
             max_wait=float(max_wait_ms) / 1000.0,
-            n_startup_jobs=n_startup_jobs, fs=fs, **algo_kw,
+            n_startup_jobs=n_startup_jobs, fs=fs, max_queue=max_queue,
+            study_queue_cap=study_queue_cap,
+            dispatch_timeout=dispatch_timeout,
+            finite_check=finite_check, **algo_kw,
         )
         if self._background:
             self.scheduler.start()
@@ -367,22 +382,48 @@ class SuggestService:
 
     # -- the handle's plumbing ---------------------------------------------
     def _ask_async(self, study):
-        _tid, fut = self.scheduler.submit_ask(study)
-        return fut
+        return self.scheduler.submit_ask(study).future
 
-    def _drive(self, fut, timeout):
-        if self._background:
-            return
-        # deterministic mode: serve rounds inline until this future
-        # resolves (each pump is one coalesced dispatch)
+    def _submit(self, study, timeout=None):
         import time as _time
 
-        deadline = _time.perf_counter() + timeout
-        while not fut.done():
-            if self.scheduler.step() == 0 and not fut.done():
-                if _time.perf_counter() > deadline:
-                    return
-                _time.sleep(0.001)
+        deadline = (
+            None if timeout is None
+            else _time.perf_counter() + float(timeout)
+        )
+        return self.scheduler.submit_ask(study, deadline=deadline)
+
+    def _await(self, req, timeout):
+        """Block on one admitted ask under its client deadline: pump
+        inline in deterministic mode, wait in background mode; on
+        expiry, drop the request from the queue (the slow-client
+        shed) or grace-wait an already-picked dispatch."""
+        import time as _time
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        fut = req.future
+        if not self._background:
+            # deterministic mode: serve rounds inline until this future
+            # resolves (each pump is one coalesced dispatch)
+            while not fut.done():
+                if self.scheduler.step() == 0 and not fut.done():
+                    if (req.deadline is not None
+                            and _time.perf_counter() > req.deadline):
+                        break
+                    _time.sleep(0.001)
+            if fut.done():
+                return fut.result(timeout=0)
+        else:
+            try:
+                return fut.result(timeout=timeout)
+            except _FutTimeout:
+                pass
+        if self.scheduler.drop_request(req):
+            return fut.result(timeout=0)  # raises DeadlineExpired
+        # already picked into an in-flight dispatch: give the round a
+        # short grace window to resolve it (served or typed failure)
+        grace = self.scheduler.dispatch_timeout or 5.0
+        return fut.result(timeout=2.0 * grace + 1.0)
 
     def _tell(self, study, tid, loss, vals=None):
         if vals is None:
@@ -412,7 +453,61 @@ class SuggestService:
             "upload_bytes": s.upload_bytes,
             "joins": s.joins,
             "rebuckets": s.rebuckets,
+            # graftguard accounting
+            "admitted_count": s.admitted_count,
+            "shed_count": s.shed_count,
+            "guard_checks": s.guard_checks,
+            "quarantine_count": s.quarantine_count,
+            "evictions": s.evictions,
+            "watchdog_timeouts": s.watchdog_timeouts,
+            "watchdog_retries": s.watchdog_retries,
+            "watchdog_recoveries": s.watchdog_recoveries,
         }
+
+    def ready(self):
+        """Readiness for traffic: False while draining, circuit-broken,
+        or stopped -- the load balancer's drain signal."""
+        s = self.scheduler
+        return not (s.draining or s.circuit_open or s._stopping)
+
+    def health(self):
+        """The health endpoint's structured snapshot: status, tenancy,
+        queue occupancy, and the full counter set."""
+        s = self.scheduler
+        if s._stopping:
+            status = "stopped"
+        elif s.circuit_open:
+            status = "circuit_open"
+        elif s.draining:
+            status = "draining"
+        else:
+            status = "ok"
+        with self._lock:
+            n_studies = len(self._handles)
+        return {
+            "status": status,
+            "ready": self.ready(),
+            "studies": n_studies,
+            "queue_depth": len(s._asks),
+            "max_queue": s.max_queue,
+            "max_batch": s.max_batch,
+            "counters": self.counters,
+        }
+
+    def drain(self, timeout=30.0):
+        """Rolling-restart protocol: refuse new asks with
+        ``Overloaded(reason="draining")``, serve what is already
+        queued, then shut down (snapshotting every durable study)."""
+        import time as _time
+
+        self.scheduler.drain()
+        deadline = _time.perf_counter() + float(timeout)
+        while self.scheduler._asks and _time.perf_counter() < deadline:
+            if not self._background:
+                self.scheduler.step()
+            else:
+                _time.sleep(0.01)
+        self.shutdown()
 
     def shutdown(self):
         self.scheduler.stop()
@@ -426,34 +521,61 @@ class SuggestService:
 # ---------------------------------------------------------------------------
 
 
+def _serve_error_reply(e):
+    """The structured refusal a typed :class:`ServeError` maps to on
+    the wire: ``error_type`` names the exception class (``Overloaded``
+    / ``DeadlineExpired`` / ``StudyPoisoned`` / ``StudyQuarantined`` /
+    ``DispatchTimeout``), and Overloaded's backpressure fields ride
+    along so a client can back off exactly as the in-process API
+    would."""
+    reply = {
+        "ok": False,
+        "error": str(e),
+        "error_type": type(e).__name__,
+    }
+    if isinstance(e, Overloaded):
+        reply["retry_after"] = e.retry_after
+        reply["reason"] = e.reason
+    return reply
+
+
 def _handle_request(service, req):
     op = req.get("op")
-    if op == "ping":
-        return {"ok": True, "pong": True}
-    if op == "create_study":
-        h = service.create_study(req["name"], seed=int(req.get("seed", 0)))
-        return {"ok": True, "study": h.name, "n_tells": h.n_tells}
-    if op == "studies":
-        return {"ok": True, "studies": service.studies()}
-    name = req.get("study")
-    with service._lock:
-        handle = service._handles.get(name)
-    if handle is None:
-        return {"ok": False, "error": f"unknown study {name!r}"}
-    if op == "ask":
-        tid, vals = handle.ask(timeout=float(req.get("timeout", 60.0)))
-        return {"ok": True, "tid": tid, "vals": vals}
-    if op == "tell":
-        handle.tell(
-            int(req["tid"]), float(req["loss"]), vals=req.get("vals")
-        )
-        return {"ok": True}
-    if op == "best":
-        return {"ok": True, "best": handle.best()}
-    if op == "close_study":
-        handle.close()
-        return {"ok": True}
-    return {"ok": False, "error": f"unknown op {op!r}"}
+    try:
+        if op == "ping":
+            return {"ok": True, "pong": True}
+        if op == "health":
+            return {"ok": True, **service.health()}
+        if op == "ready":
+            return {"ok": True, "ready": service.ready()}
+        if op == "create_study":
+            h = service.create_study(
+                req["name"], seed=int(req.get("seed", 0))
+            )
+            return {"ok": True, "study": h.name, "n_tells": h.n_tells}
+        if op == "studies":
+            return {"ok": True, "studies": service.studies()}
+        name = req.get("study")
+        with service._lock:
+            handle = service._handles.get(name)
+        if handle is None:
+            return {"ok": False, "error": f"unknown study {name!r}"}
+        if op == "ask":
+            tid, vals = handle.ask(timeout=float(req.get("timeout", 60.0)))
+            return {"ok": True, "tid": tid, "vals": vals}
+        if op == "tell":
+            handle.tell(
+                int(req["tid"]), float(req["loss"]), vals=req.get("vals")
+            )
+            return {"ok": True}
+        if op == "best":
+            return {"ok": True, "best": handle.best()}
+        if op == "close_study":
+            handle.close()
+            return {"ok": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+    except ServeError as e:
+        return _serve_error_reply(e)
 
 
 def serve_forever(service, host="127.0.0.1", port=0):
@@ -524,12 +646,23 @@ def main(argv=None):
     parser.add_argument("--max-batch", type=int, default=64)
     parser.add_argument("--max-wait-ms", type=float, default=2.0)
     parser.add_argument("--n-startup-jobs", type=int, default=20)
+    parser.add_argument(
+        "--max-queue", type=int, default=None,
+        help="ask-queue high-water mark (default 4 * max-batch); "
+        "submits past it get a typed Overloaded with retry-after",
+    )
+    parser.add_argument(
+        "--dispatch-timeout", type=float, default=30.0,
+        help="watchdog deadline (seconds) per device dispatch; "
+        "0 disables the watchdog",
+    )
     args = parser.parse_args(argv)
 
     service = SuggestService(
         _load_space(args.space), algo=args.algo, root=args.root,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        n_startup_jobs=args.n_startup_jobs,
+        n_startup_jobs=args.n_startup_jobs, max_queue=args.max_queue,
+        dispatch_timeout=args.dispatch_timeout or None,
     )
     server = serve_forever(service, host=args.host, port=args.port)
     host, port = server.server_address[:2]

@@ -20,7 +20,7 @@ __all__ = [
 ]
 
 
-def enable_compilation_cache(cache_dir=None):
+def enable_compilation_cache(cache_dir=None, force_cpu=False):
     """Turn on JAX's persistent compilation cache.
 
     Every (space, capacity-bucket, batch) combination costs an XLA
@@ -28,9 +28,26 @@ def enable_compilation_cache(cache_dir=None):
     compilations across processes and runs, which dominates wall-clock
     for short fmin experiments.  Defaults to
     ``$JAX_COMPILATION_CACHE_DIR`` or ``~/.cache/hyperopt_tpu_xla``.
+
+    On the CPU backend this is a NO-OP (returns None) unless
+    ``force_cpu=True``: jaxlib 0.4.36's CPU runtime intermittently
+    corrupts the heap while deserializing cached executables -- a
+    warm-cache process dies minutes later with SIGSEGV/glibc abort at
+    an unrelated allocation (see FAILURES.md "Known test debt").
+    Compile seconds only dominate on accelerators anyway; a CPU run
+    paying them keeps its heap.
     """
     import jax
 
+    if jax.default_backend() == "cpu" and not force_cpu:
+        import logging
+
+        logging.getLogger(__name__).info(
+            "persistent compilation cache left OFF on the CPU backend "
+            "(jaxlib 0.4.36 warm-cache deserialization heap-corrupts; "
+            "FAILURES.md); pass force_cpu=True to override"
+        )
+        return None
     if cache_dir is None:
         cache_dir = os.environ.get(
             "JAX_COMPILATION_CACHE_DIR",

@@ -1,0 +1,24 @@
+"""GL306 true positives: a long-lived service class whose plain-list
+attributes grow by append with no bound anywhere in the class -- the
+exact per-ask metrics leak the PR-8 review caught on the scheduler."""
+
+
+class RequestBatcher:
+    def __init__(self):
+        self.latencies = []
+        self.trace = []
+        self.queue = []
+
+    def submit(self, req):
+        self.queue.append(req)
+
+    def step(self):
+        batch = self.queue
+        self.queue = []                  # rebound: queue is fine
+        for req in batch:
+            self.latencies.append(req.age())     # GL306: never trimmed
+            self.trace.append(("served", req))   # GL306: never trimmed
+        return len(batch)
+
+    def stop(self):
+        return sum(self.latencies)

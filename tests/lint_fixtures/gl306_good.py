@@ -1,0 +1,35 @@
+"""GL306 near-misses: the bounded idioms -- a maxlen ring buffer, a
+popped work list, a slice-trimmed log -- and an append on a SHORT-lived
+(non-service) object, which is a working buffer, not a leak."""
+import collections
+
+
+class RequestBatcher:
+    def __init__(self):
+        self.latencies = collections.deque(maxlen=1024)  # ring buffer
+        self.trace = []
+        self.queue = []
+
+    def submit(self, req):
+        self.queue.append(req)
+
+    def step(self):
+        while self.queue:
+            req = self.queue.pop()               # bounded by pop
+            self.latencies.append(req.age())     # deque, not a list attr
+            self.trace.append(("served", req))
+        self.trace[:-256] = []                   # bounded by slice trim
+        return True
+
+    def stop(self):
+        return len(self.trace)
+
+
+class ResultCollector:
+    """No service-shaped method: a per-call accumulator is fine."""
+
+    def __init__(self):
+        self.results = []
+
+    def collect(self, x):
+        self.results.append(x)

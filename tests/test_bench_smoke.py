@@ -95,3 +95,9 @@ def test_bench_prints_one_json_line():
     assert 0 < d["serve_batch_occupancy"] <= 1.0
     assert d["serve_vs_solo_speedup_x"] > 0
     assert d["serve_batch"] == 8
+    # round-13: graftguard rows -- overload shedding really shed, the
+    # NaN tenant really accrued its K trips, the watchdog really timed
+    # a hung dispatch out and recovered
+    assert 0 < d["serve_shed_rate"] < 1
+    assert d["serve_quarantine_count"] == 3
+    assert d["serve_watchdog_recovery_ms"] > 0

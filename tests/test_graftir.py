@@ -120,6 +120,15 @@ def test_registry_covers_every_reachable_program_family(monkeypatch):
 
     recorded = set()
     _record_jits(monkeypatch, recorded)
+    # lazily-built process globals would skip their (recorded) jit wrap
+    # if an earlier test already built them -- reset so this test is
+    # order-independent
+    from hyperopt_tpu import jax_trials as _jt
+    from hyperopt_tpu.serve import batched as _sb
+
+    monkeypatch.setattr(_jt, "_APPLY_DELTA", None)
+    monkeypatch.setattr(_sb, "_BATCHED_DELTA_FN", None)
+    monkeypatch.setattr(_sb, "_FINITE_CHECK_FN", None)
 
     space = {"a": hp.uniform("a", -2.0, 2.0), "b": hp.choice("b", [0, 1])}
 

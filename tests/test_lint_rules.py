@@ -18,7 +18,7 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 PACK_RULES = [
     "GL101", "GL102", "GL103", "GL104",
     "GL201", "GL202", "GL203",
-    "GL301", "GL302", "GL303", "GL304", "GL305",
+    "GL301", "GL302", "GL303", "GL304", "GL305", "GL306",
 ]
 
 
@@ -63,6 +63,9 @@ def test_known_finding_counts():
     assert len(_lint(_fixture_path("GL202", "bad"))) == 2
     assert len(_lint(_fixture_path("GL304", "bad"))) == 2
     assert len(_lint(_fixture_path("GL305", "bad"))) == 2
+    # two leaking attrs (latencies + trace), one finding per append
+    # site; the rebound queue attr must contribute none
+    assert len(_lint(_fixture_path("GL306", "bad"))) == 2
 
 
 def test_partial_wrapped_functions_resolve_as_jitted():

@@ -124,7 +124,13 @@ def test_enable_compilation_cache(tmp_path):
         jax.config.jax_persistent_cache_min_compile_time_secs,
     )
     try:
-        d = enable_compilation_cache(str(tmp_path / "xla"))
+        if jax.default_backend() == "cpu":
+            # the CPU backend refuses by default: jaxlib 0.4.36's
+            # warm-cache executable deserializer corrupts the heap
+            # (FAILURES.md "Known test debt")
+            assert enable_compilation_cache(str(tmp_path / "xla")) is None
+            assert jax.config.jax_compilation_cache_dir == prev[0]
+        d = enable_compilation_cache(str(tmp_path / "xla"), force_cpu=True)
         assert d == str(tmp_path / "xla")
         assert os.path.isdir(d)
         assert jax.config.jax_compilation_cache_dir == d

@@ -1,0 +1,499 @@
+"""graftguard: overload protection, poisoned-tenant isolation, and the
+dispatch watchdog (ISSUE 9).
+
+The acceptance contract, pinned deterministically:
+
+* OVERLOAD: submits past the bounded queue (or the per-study fairness
+  cap) are refused PROMPTLY with a typed ``Overloaded`` carrying a
+  retry-after hint, admission happens before the seed draw (shedding
+  never perturbs an admitted stream), and every admitted ask still
+  resolves with bounded latency;
+* POISON: a tenant telling NaN (or a device fault scribbling NaN into
+  its batched output) trips the fused finite-check, fails ONLY its own
+  client with a typed error, re-materializes from host truth, and is
+  evicted after K consecutive trips -- sibling streams stay bitwise
+  equal to the same-seed no-fault run;
+* WATCHDOG: a hung dispatch times out against the deadline and a
+  transiently raising dispatch retries once against a re-materialized
+  stacked state -- bitwise invisibly; deterministic program bugs skip
+  the retry and circuit-break the batcher into reject-with-Overloaded;
+* ZERO LOSS: across the full chaos scenario every submitted ask
+  resolves with a suggestion or a typed error -- nothing is silently
+  dropped -- and the whole scenario replays bitwise under the same
+  seeds.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu.distributed.faults import DeviceFaultPlan, FaultPlan
+from hyperopt_tpu.exceptions import (
+    DeadlineExpired,
+    Overloaded,
+    ServeError,
+    StudyPoisoned,
+    StudyQuarantined,
+)
+from hyperopt_tpu.serve import SuggestService
+from test_serve import ALGO_KW, N_STARTUP, SPACE, loss_fn, solo_stream
+
+pytestmark = pytest.mark.chaos
+
+
+def _svc(**kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("background", False)
+    kw.setdefault("n_startup_jobs", N_STARTUP)
+    for k, v in ALGO_KW.items():
+        kw.setdefault(k, v)
+    return SuggestService(SPACE, **kw)
+
+
+# ---------------------------------------------------------------------------
+# admission control & load shedding
+# ---------------------------------------------------------------------------
+
+
+def test_overload_storm_sheds_typed_and_keeps_admitted_streams_pure():
+    """A submit storm past the high-water mark: typed ``Overloaded``
+    with a positive retry-after, every ADMITTED ask served with
+    bounded latency, and -- because admission precedes the seed draw --
+    the admitted suggestion stream is exactly the solo prefix."""
+    svc = _svc(max_queue=6, study_queue_cap=2)
+    sched = svc.scheduler
+    handles = [svc.create_study(f"ov{i}", seed=300 + i) for i in range(4)]
+    futs, n_shed = [], 0
+    for _ in range(5):  # 20 submits against queue 6 / per-study cap 2
+        for h in handles:
+            try:
+                futs.append((h, h.ask_async()))
+            except Overloaded as e:
+                n_shed += 1
+                assert e.retry_after is not None and e.retry_after > 0
+                assert e.reason in ("queue_full", "study_queue_cap")
+    assert n_shed > 0
+    assert sched.shed_count == n_shed
+    assert sched.admitted_count == len(futs)
+    streams = {}
+    while any(not f.done() for _, f in futs):
+        svc.pump()
+    for h, f in futs:
+        tid, vals = f.result(timeout=0)
+        streams.setdefault(h.name, []).append(vals)
+    # bounded latency for admitted requests (loose wall-clock pin: the
+    # claim is 'bounded', not a perf number)
+    lats = sorted(sched.ask_latencies)
+    assert lats[int(0.99 * (len(lats) - 1))] < 30.0
+    # seed-stream purity: sheds consumed nothing, so each study's
+    # admitted stream is its solo stream's prefix (no tells here, and
+    # asks between tells re-draw from the same posterior, so the solo
+    # reference must replay the same no-tell cadence)
+    for i, h in enumerate(handles):
+        n = len(streams[h.name])
+        ref = np.random.default_rng(300 + i)
+        admitted_seeds = [int(ref.integers(2**31 - 1)) for _ in range(n)]
+        st = svc.scheduler.study(h.name)
+        assert st.n_asks == n
+        # the NEXT draw continues the unperturbed stream
+        nxt = svc.scheduler.submit_ask(st)
+        assert nxt.seed == int(ref.integers(2**31 - 1))
+        assert admitted_seeds  # the storm admitted something per study
+    svc.shutdown()
+
+
+def test_submit_with_expired_deadline_is_shed_before_the_seed_draw():
+    svc = _svc()
+    h = svc.create_study("dead", seed=7)
+    st = svc.scheduler.study("dead")
+    with pytest.raises(DeadlineExpired):
+        svc.scheduler.submit_ask(st, deadline=time.perf_counter() - 1.0)
+    assert st.n_asks == 0 and st.next_tid == 0
+    assert svc.scheduler.shed_count == 1
+    # the stream was not perturbed: the next admitted seed is draw #0
+    req = svc.scheduler.submit_ask(st)
+    assert req.seed == int(np.random.default_rng(7).integers(2**31 - 1))
+    svc.shutdown()
+
+
+def test_queued_ask_expiring_is_dropped_not_dispatched():
+    """The slow-client path: an ask whose deadline passes while queued
+    is shed at pick time and never consumes a dispatch slot."""
+    svc = _svc()
+    h = svc.create_study("slow", seed=9)
+    st = svc.scheduler.study("slow")
+    expired = svc.scheduler.submit_ask(
+        st, deadline=time.perf_counter() + 0.005
+    )
+    time.sleep(0.02)
+    fresh = svc.scheduler.submit_ask(st)
+    served = svc.pump()
+    assert served == 1  # only the fresh ask reached the device
+    with pytest.raises(DeadlineExpired):
+        expired.future.result(timeout=0)
+    assert fresh.future.result(timeout=0)[0] == fresh.tid
+    assert not svc.scheduler._asks  # nothing stranded in the queue
+    assert svc.pump() == 0  # and no zombie slot consumed later
+    svc.shutdown()
+
+
+def test_ask_timeout_drops_the_queued_request():
+    """``ask(timeout=...)`` on the background service: expiry while
+    queued drops the request (typed DeadlineExpired), leaving no
+    stranded future to consume a later dispatch slot."""
+    svc = _svc(background=True, max_wait_ms=2000.0)
+    svc.create_study("t0", seed=1)  # a second tenant keeps _ready false
+    h = svc.create_study("t1", seed=2)
+    with pytest.raises(DeadlineExpired):
+        h.ask(timeout=0.05)
+    assert not svc.scheduler._asks
+    assert svc.scheduler.shed_count == 1
+    svc.shutdown()
+
+
+def test_scheduler_queue_is_bounded():
+    """REGRESSION (the PR-8 leak class): the ask queue itself is capped
+    -- ``max_queue`` defaults to ``4 * max_batch`` and the 4 *
+    max_batch + 1st un-served submit is refused, not queued."""
+    svc = _svc(max_batch=4, study_queue_cap=10**9)
+    sched = svc.scheduler
+    assert sched.max_queue == 16
+    h = svc.create_study("q", seed=1)
+    st = sched.study("q")
+    for _ in range(16):
+        sched.submit_ask(st)
+    assert len(sched._asks) == 16
+    with pytest.raises(Overloaded) as ei:
+        sched.submit_ask(st)
+    assert ei.value.reason == "queue_full"
+    assert len(sched._asks) == 16  # refused, not enqueued
+    svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# poisoned-tenant isolation
+# ---------------------------------------------------------------------------
+
+
+def test_nan_tell_quarantines_evicts_and_pins_siblings_bitwise():
+    """One tenant tells NaN: its own asks fail typed
+    (StudyPoisoned -> StudyQuarantined at K trips), it is evicted, and
+    the sibling's stream stays bitwise equal to its solo run."""
+    svc = _svc(max_batch=4)
+    ps = svc.ps
+    good = svc.create_study("good", seed=21)
+    bad = svc.create_study("bad", seed=22)
+    bad.tell(0, float("nan"), vals={"x": 0.5, "lr": 0.1, "q": 2.0, "c": 0})
+    sched = svc.scheduler
+    streams, bad_errors = {"good": []}, []
+    for _ in range(5):
+        fg = good.ask_async()
+        fb = None
+        if not sched.study("bad").quarantined:
+            fb = bad.ask_async()
+        svc.pump()
+        tid, vals = fg.result(timeout=10)
+        streams["good"].append(vals)
+        good.tell(tid, loss_fn(vals))
+        if fb is not None:
+            bad_errors.append(fb.exception(timeout=10))
+    assert streams["good"] == solo_stream(ps, 21, 5), (
+        "sibling stream disturbed by a poisoned tenant"
+    )
+    assert [type(e).__name__ for e in bad_errors] == [
+        "StudyPoisoned", "StudyPoisoned", "StudyQuarantined",
+    ]
+    assert sched.quarantine_count == 3 and sched.evictions == 1
+    with pytest.raises(StudyQuarantined):
+        bad.ask_async()
+    with pytest.raises(StudyQuarantined):
+        bad.tell(99, 1.0, vals={"x": 0.0, "lr": 0.1, "q": 1.0, "c": 0})
+    svc.shutdown()
+
+
+def test_transient_device_nan_heals_via_rematerialization():
+    """A ONE-SHOT device NaN (host truth clean): the victim's tripped
+    ask fails typed, the slot re-materializes from host truth, and the
+    very next ask serves -- no eviction, trips reset."""
+    dev = DeviceFaultPlan(nan_study="v", nan_at=2, nan_count=1)
+    plan = FaultPlan(seed=0, device=dev)
+    svc = _svc(max_batch=4, fs=plan.fs())
+    v = svc.create_study("v", seed=31)
+    outcomes = []
+    for _ in range(4):
+        f = v.ask_async()
+        svc.pump()
+        if f.exception(timeout=10) is not None:
+            outcomes.append(type(f.exception()).__name__)
+        else:
+            tid, vals = f.result()
+            outcomes.append("served")
+            v.tell(tid, loss_fn(vals))
+    assert outcomes == ["served", "StudyPoisoned", "served", "served"]
+    sched = svc.scheduler
+    assert sched.quarantine_count == 1 and sched.evictions == 0
+    assert sched.study("v").poison_trips == 0  # reset by the clean round
+    svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# dispatch watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_times_out_hung_dispatch_and_recovers_bitwise():
+    """An injected dispatch hang past the deadline: DispatchTimeout,
+    one retry against a re-materialized state, and the client stream
+    is bitwise what the no-fault run serves (the retry reuses the
+    already-drawn per-ask seeds)."""
+    dev = DeviceFaultPlan(hang_at=3, hang_s=0.5)
+    plan = FaultPlan(seed=0, device=dev)
+    svc = _svc(max_batch=4, fs=plan.fs())
+    ps = svc.ps
+    h = svc.create_study("w", seed=41)
+    stream = []
+    for rnd in range(4):
+        f = h.ask_async()
+        svc.pump()
+        tid, vals = f.result(timeout=10)
+        stream.append(vals)
+        h.tell(tid, loss_fn(vals))
+        if rnd == 0:
+            # arm the watchdog AFTER the compile round: the deadline
+            # bounds dispatch execution, not first-trace compilation
+            svc.scheduler.dispatch_timeout = 0.2
+    assert stream == solo_stream(ps, 41, 4), (
+        "watchdog recovery perturbed the suggestion stream"
+    )
+    sched = svc.scheduler
+    assert sched.watchdog_timeouts == 1
+    assert sched.watchdog_retries == 1
+    assert sched.watchdog_recoveries == 1
+    assert len(sched.watchdog_recovery_ms) == 1
+    svc.shutdown()
+
+
+def test_deterministic_program_bug_skips_retry_and_opens_circuit():
+    """A dispatch raising a NON-transient error: no pointless retry,
+    the picked asks fail typed, the circuit breaker opens into
+    reject-with-Overloaded, and reset_circuit() restores service."""
+    dev = DeviceFaultPlan(fatal_at=1)
+    plan = FaultPlan(seed=0, device=dev)
+    svc = _svc(max_batch=4, fs=plan.fs())
+    svc.scheduler.circuit_threshold = 1
+    h = svc.create_study("c", seed=51)
+    f = h.ask_async()
+    assert svc.pump() == 0
+    with pytest.raises(RuntimeError, match="injected deterministic"):
+        f.result(timeout=0)
+    sched = svc.scheduler
+    assert sched.watchdog_retries == 0  # deterministic bug: no retry
+    assert sched.circuit_open
+    with pytest.raises(Overloaded) as ei:
+        h.ask_async()
+    assert ei.value.reason == "circuit_open"
+    sched.reset_circuit()
+    f2 = h.ask_async()
+    svc.pump()
+    assert f2.result(timeout=10)[0] == f2.tid if hasattr(f2, "tid") else True
+    svc.shutdown()
+
+
+def test_transient_raise_storm_is_bitwise_invisible():
+    """10% transient dispatch raises (burst 1): every raise recovers
+    through the retry, and every study's stream is bitwise the
+    no-fault run's."""
+    streams_by_run = []
+    for dev in (None, DeviceFaultPlan(seed=2, raise_rate=0.4, burst=1)):
+        plan = FaultPlan(seed=0, device=dev)
+        svc = _svc(max_batch=4, fs=plan.fs())
+        handles = [svc.create_study(f"r{i}", seed=60 + i) for i in range(3)]
+        streams = {}
+        for _ in range(6):
+            futs = [(h, h.ask_async()) for h in handles]
+            svc.pump()
+            for h, f in futs:
+                tid, vals = f.result(timeout=10)
+                streams.setdefault(h.name, []).append(vals)
+                h.tell(tid, loss_fn(vals))
+        if dev is not None:
+            assert dev.stats["device:raise"] > 0, "storm never fired"
+            assert svc.scheduler.watchdog_recoveries == \
+                dev.stats["device:raise"]
+        streams_by_run.append(streams)
+        svc.shutdown()
+    assert streams_by_run[0] == streams_by_run[1], (
+        "transient dispatch raises perturbed a suggestion stream"
+    )
+
+
+# ---------------------------------------------------------------------------
+# health / ready / draining
+# ---------------------------------------------------------------------------
+
+
+def test_health_ready_and_draining_shutdown():
+    svc = _svc(max_batch=4)
+    h = svc.create_study("hl", seed=71)
+    assert svc.ready()
+    snap = svc.health()
+    assert snap["status"] == "ok" and snap["ready"]
+    assert snap["studies"] == 1 and snap["queue_depth"] == 0
+    assert snap["counters"]["shed_count"] == 0
+    # draining: queued work still served, new submits refused typed
+    f = h.ask_async()
+    svc.scheduler.drain()
+    assert not svc.ready()
+    assert svc.health()["status"] == "draining"
+    with pytest.raises(Overloaded) as ei:
+        h.ask_async()
+    assert ei.value.reason == "draining"
+    svc.pump()
+    assert f.result(timeout=10)  # the queued ask was not abandoned
+    svc.drain(timeout=5.0)
+    assert svc.health()["status"] == "stopped"
+
+
+def test_socket_transport_maps_guard_errors_and_health():
+    import json
+    import socket
+    import threading
+
+    from hyperopt_tpu.serve.service import serve_forever
+
+    svc = SuggestService(
+        SPACE, background=True, max_wait_ms=1.0, n_startup_jobs=2,
+        max_queue=0, **ALGO_KW,
+    )
+    server = serve_forever(svc, port=0)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = server.server_address[:2]
+        with socket.create_connection((host, port), timeout=10) as sock:
+            f = sock.makefile("rw")
+
+            def rpc(**req):
+                f.write(json.dumps(req) + "\n")
+                f.flush()
+                return json.loads(f.readline())
+
+            r = rpc(op="health")
+            assert r["ok"] and r["status"] == "ok" and r["ready"]
+            assert rpc(op="ready")["ready"]
+            assert rpc(op="create_study", name="g", seed=1)["ok"]
+            # max_queue=0: every ask is shed -> the structured refusal
+            r = rpc(op="ask", study="g", timeout=5)
+            assert not r["ok"]
+            assert r["error_type"] == "Overloaded"
+            assert r["reason"] == "queue_full"
+            assert r["retry_after"] > 0
+    finally:
+        server.shutdown()
+        server.server_close()
+        svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: 64-study churn under the full fault plan
+# ---------------------------------------------------------------------------
+
+VICTIM = "s07"
+
+
+def _run_churn(faulted, n_rounds=6):
+    """The 64-study churn workload: two closes waves, one join wave,
+    every open study asking+telling every round.  Returns per-study
+    outcome streams (vals dicts for served asks, typed error names for
+    refused/failed ones) plus the scheduler counters."""
+    dev = DeviceFaultPlan(
+        seed=13, nan_study=VICTIM, nan_at=3,  # persistent: drives eviction
+        hang_at=4, hang_s=0.5, raise_rate=0.10, burst=1,
+    ) if faulted else None
+    plan = FaultPlan(seed=13, device=dev)
+    svc = SuggestService(
+        SPACE, max_batch=64, background=False, n_startup_jobs=N_STARTUP,
+        fs=plan.fs(), dispatch_timeout=None if dev is None else 0.25,
+        **ALGO_KW,
+    )
+    handles = {}
+    for i in range(64):
+        name = f"s{i:02d}"
+        handles[name] = svc.create_study(name, seed=100 + i)
+    outcomes = {name: [] for name in handles}
+    submitted = resolved = 0
+    for rnd in range(n_rounds):
+        if rnd == 2:  # churn: a leave wave frees low slots
+            for name in ("s20", "s21", "s22", "s23"):
+                handles.pop(name).close()
+        if rnd == 4:  # churn: a join wave reuses them
+            for j in range(4):
+                name = f"j{j}"
+                handles[name] = svc.create_study(name, seed=900 + j)
+                outcomes[name] = []
+        futs = []
+        for name, h in handles.items():
+            try:
+                futs.append((name, h, h.ask_async()))
+                submitted += 1
+            except ServeError as e:  # refusal IS a typed resolution
+                outcomes[name].append(type(e).__name__)
+        svc.pump()
+        for name, h, f in futs:
+            exc = f.exception(timeout=30)
+            resolved += 1
+            if exc is not None:
+                assert isinstance(exc, (StudyPoisoned, StudyQuarantined)), (
+                    f"untyped failure for {name}: {exc!r}"
+                )
+                outcomes[name].append(type(exc).__name__)
+            else:
+                tid, vals = f.result()
+                outcomes[name].append(vals)
+                h.tell(tid, loss_fn(vals))
+    counters = dict(svc.counters)
+    svc.shutdown()
+    assert resolved == submitted  # zero asks silently lost
+    return outcomes, counters
+
+
+def test_chaos_64_study_churn_siblings_bitwise_and_victim_quarantined():
+    """The ISSUE-9 acceptance run: NaN injection on one tenant + one
+    dispatch hang + 10% transient dispatch raises over a 64-study
+    churn workload.  The victim is quarantined with typed errors and
+    evicted; EVERY other study's stream is bitwise the same-seed
+    no-fault run's; zero asks are lost; and the whole faulted scenario
+    replays bitwise under the same seeds."""
+    clean, _ = _run_churn(faulted=False)
+    faulted, counters = _run_churn(faulted=True)
+    replay, replay_counters = _run_churn(faulted=True)
+
+    # deterministic chaos: the faulted scenario replays bitwise
+    assert faulted == replay
+    for k in ("dispatch_count", "quarantine_count", "evictions",
+              "watchdog_timeouts", "watchdog_retries", "shed_count",
+              "admitted_count"):
+        assert counters[k] == replay_counters[k], k
+
+    # the victim was quarantined: typed errors only, then eviction
+    bad = [o for o in faulted[VICTIM] if isinstance(o, str)]
+    assert bad, "the NaN injection never tripped the finite-check"
+    assert set(bad) <= {"StudyPoisoned", "StudyQuarantined"}
+    assert counters["evictions"] == 1
+    assert counters["quarantine_count"] >= 3
+    served_prefix = [o for o in faulted[VICTIM] if not isinstance(o, str)]
+    assert served_prefix == clean[VICTIM][: len(served_prefix)]
+
+    # every sibling stream is bitwise the no-fault run's
+    for name, stream in faulted.items():
+        if name == VICTIM:
+            continue
+        assert stream == clean[name], (
+            f"study {name} diverged under the fault plan"
+        )
+
+    # the armed faults really fired and really recovered
+    assert counters["watchdog_timeouts"] == 1  # the hang
+    assert counters["watchdog_recoveries"] == counters["watchdog_retries"]
+    assert counters["watchdog_retries"] >= 1

