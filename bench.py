@@ -585,7 +585,10 @@ def bench_guard(space, n_cand=128):
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
-    quadratic (device_loop.compile_fmin)."""
+    quadratic (device_loop.compile_fmin).  Runs on EVERY backend --
+    CPU rounds get a CPU-sized config from main() and the JSON stamps
+    the config keyed by backend, so the trajectory is stamped every
+    round and rounds stay comparable within a backend."""
     import time
 
     try:
@@ -793,6 +796,131 @@ def bench_best_at_1k_device_loop(n_trials=1000, n_cand=128, seed=7,
         return None, None, 0
 
 
+def bench_compiled_at_1k(n_trials=1000, n_cand=128, seed=7):
+    """The RTT-floor headline: the SAME 1k-trial experiment as
+    ``bench_best_at_1k`` routed through ``fmin(compiled=True)`` -- the
+    whole ask-evaluate-tell loop as one device program, returning a
+    standard Trials store.  Sequential on-device mode (batch_size=1,
+    one posterior update per trial -- host-path quality).  The program
+    is compiled once and reused (a warm fmin call pays zero compile,
+    like a seed sweep); the timed call includes the Trials rebuild, so
+    the number is the full fmin-contract wall-clock.
+
+    Returns (seconds, best_loss)."""
+    try:
+        import numpy as np
+
+        from hyperopt_tpu import Trials, fmin
+        from hyperopt_tpu.device_loop import compile_fmin
+        from hyperopt_tpu.models.synthetic import (
+            mixed_space,
+            mixed_space_fn_jax,
+        )
+
+        runner = compile_fmin(
+            mixed_space_fn_jax, mixed_space(), max_evals=n_trials,
+            batch_size=1, n_EI_candidates=n_cand, n_EI_candidates_cat=24,
+        )
+        runner(seed=seed)  # compile (reused by every fmin call below)
+        trials = Trials()
+        t0 = time.perf_counter()
+        fmin(
+            mixed_space_fn_jax, mixed_space(), compiled=True,
+            max_evals=n_trials, trials=trials, return_argmin=False,
+            rstate=np.random.default_rng(seed),
+            compiled_options={"runner": runner, "seed": seed},
+        )
+        dt = time.perf_counter() - t0
+        return dt, float(min(trials.losses()))
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_compiled_at_1k failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None, None
+
+
+def bench_mlp_tune(n_evals=512, batch=32, n_epochs=8):
+    """End-to-end HPO *over actual training*: each trial initializes
+    and trains its own MLP (SGD+momentum, per-trial params/opt-state
+    carried through an inner fori_loop) INSIDE the experiment scan --
+    the ``TrainableObjective`` seam, a real vmapped training loop, not
+    a closed-form objective.  Returns trials/sec end-to-end."""
+    try:
+        from hyperopt_tpu.device_loop import compile_fmin
+        from hyperopt_tpu.models.synthetic import (
+            mlp_tune_objective,
+            mlp_tune_space,
+        )
+
+        runner = compile_fmin(
+            mlp_tune_objective(n_epochs=n_epochs),
+            mlp_tune_space(), max_evals=n_evals, batch_size=batch,
+        )
+        runner(seed=0)  # compile
+        t0 = time.perf_counter()
+        out = runner(seed=1)
+        dt = time.perf_counter() - t0
+        return out["n_evals"] / dt
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_mlp_tune failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None
+
+
+def bench_callback_overhead(n_evals=512, batch=32, n_chunks=8):
+    """What the io_callback observability seam costs: the chunked
+    device loop timed with the progress callback streaming a row EVERY
+    chunk vs the identical chunked program with no callback.  Stamped
+    as a fraction of the no-callback wall-clock (>= 0; the result
+    streams are bitwise identical either way, so this is pure
+    observability overhead)."""
+    try:
+        import jax.numpy as jnp
+
+        from hyperopt_tpu import hp
+        from hyperopt_tpu.device_loop import compile_fmin
+
+        space = {
+            "x": hp.uniform("x", -5.0, 5.0),
+            "y": hp.loguniform("y", -7.0, 2.3),
+        }
+
+        def obj(cfg):
+            return (cfg["x"] - 1.0) ** 2 + (jnp.log(cfg["y"]) + 2.3) ** 2
+
+        chunk = max(batch, n_evals // n_chunks)
+        rows = []
+        plain = compile_fmin(
+            obj, space, max_evals=n_evals, batch_size=batch,
+            chunk_size=chunk,
+        )
+        with_cb = compile_fmin(
+            obj, space, max_evals=n_evals, batch_size=batch,
+            chunk_size=chunk, progress_callback=rows.append,
+            progress_every=1,
+        )
+        plain(seed=0)  # compile
+        with_cb(seed=0)  # compile
+        t0 = time.perf_counter()
+        plain(seed=1)
+        t_plain = time.perf_counter() - t0
+        rows.clear()
+        t0 = time.perf_counter()
+        with_cb(seed=1)
+        t_cb = time.perf_counter() - t0
+        assert rows, "progress callback never fired"
+        return max(0.0, (t_cb - t_plain) / t_plain)
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_callback_overhead failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None
+
+
 def main():
     from hyperopt_tpu.models.synthetic import mixed_space
 
@@ -863,26 +991,43 @@ def main():
     # round-13 graftguard rows: overload shedding, poisoned-tenant
     # quarantine, and watchdog recovery on deterministic scenarios
     guard_rows = bench_guard(space, n_cand=n_cand)
-    loop_rate = bench_device_loop() if platform != "cpu" else None
+    # round-14: the device-loop family is stamped on EVERY backend --
+    # CPU rounds get CPU-sized configs, keyed by backend in the JSON so
+    # the per-backend trajectory stays comparable (the old CPU skip
+    # left device_loop_* unstamped on every CPU round)
+    dl_evals, dl_batch = (8192, 128) if on_accel else (1024, 32)
+    device_loop_config = {
+        "backend": platform, "n_evals": dl_evals, "batch": dl_batch,
+    }
+    loop_rate = bench_device_loop(n_evals=dl_evals, batch=dl_batch)
 
     sec_1k, best_1k, _ = bench_best_at_1k(n_trials=n_trials_1k)
     spec_sec_1k, spec_best_1k, _ = bench_best_at_1k(
         n_trials=n_trials_1k, speculative=8
     )
+    dl_sec_1k, dl_best_1k, dl_n = bench_best_at_1k_device_loop(
+        n_trials=n_trials_1k, n_cand=n_cand
+    )
+    # sequential on-device mode: one posterior update per trial --
+    # host-path quality at on-device wall-clock (round-3 study)
+    dls_sec_1k, dls_best_1k, dls_n = bench_best_at_1k_device_loop(
+        n_trials=n_trials_1k, n_cand=n_cand, batch_size=1
+    )
+    # round-14 compiled-objective rows: the RTT-floor close-out --
+    # fmin(compiled=True) wall-clock on the SAME experiment as the host
+    # sequential headline, HPO over a real vmapped training loop, and
+    # the cost of the io_callback observability seam
+    comp_sec_1k, comp_best_1k = bench_compiled_at_1k(
+        n_trials=n_trials_1k, n_cand=n_cand
+    )
+    mlp_evals, mlp_batch = (2048, 64) if on_accel else (128, 16)
+    mlp_rate = bench_mlp_tune(n_evals=mlp_evals, batch=mlp_batch)
+    cb_evals, cb_batch = (4096, 128) if on_accel else (256, 16)
+    cb_frac = bench_callback_overhead(n_evals=cb_evals, batch=cb_batch)
     if platform != "cpu":
-        dl_sec_1k, dl_best_1k, dl_n = bench_best_at_1k_device_loop(
-            n_trials=n_trials_1k, n_cand=n_cand
-        )
-        # sequential on-device mode: one posterior update per trial --
-        # host-path quality at on-device wall-clock (round-3 study)
-        dls_sec_1k, dls_best_1k, dls_n = bench_best_at_1k_device_loop(
-            n_trials=n_trials_1k, n_cand=n_cand, batch_size=1
-        )
         pbt_rate, pbt_median = bench_pbt()
         asha_s, sha_sync_s, asha_best, sha_sync_best = bench_asha_device()
     else:
-        dl_sec_1k, dl_best_1k, dl_n = None, None, 0
-        dls_sec_1k, dls_best_1k, dls_n = None, None, 0
         pbt_rate, pbt_median = None, None
         asha_s, sha_sync_s, asha_best, sha_sync_best = (None,) * 4
     # comparability contract: the stamped config IS the dict bench_pbt
@@ -933,6 +1078,35 @@ def main():
                 **guard_rows,
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
+                ),
+                # round-14: the device-loop family is stamped every
+                # round; this keys the numbers by backend + config so
+                # CPU and accelerator trajectories never get compared
+                # against each other
+                "device_loop_config": device_loop_config,
+                # round-14 compiled-objective rows (fmin(compiled=True)
+                # / TrainableObjective / io_callback cadence)
+                "seconds_to_best_at_1k_compiled": (
+                    round(comp_sec_1k, 3) if comp_sec_1k is not None
+                    else None
+                ),
+                "best_loss_at_1k_compiled": (
+                    round(comp_best_1k, 5) if comp_best_1k is not None
+                    else None
+                ),
+                "compiled_vs_host_speedup_x": (
+                    round(sec_1k / comp_sec_1k, 1)
+                    if comp_sec_1k else None
+                ),
+                "mlp_tune_trials_per_sec": (
+                    round(mlp_rate, 1) if mlp_rate else None
+                ),
+                "mlp_tune_config": {
+                    "backend": platform, "n_evals": mlp_evals,
+                    "batch": mlp_batch,
+                },
+                "device_loop_callback_overhead_frac": (
+                    round(cb_frac, 4) if cb_frac is not None else None
                 ),
                 "seconds_to_best_at_1k": round(sec_1k, 2),
                 "best_loss_at_1k": round(best_1k, 5),

@@ -12,7 +12,13 @@ one on the CPU backend -- ``jax.make_jaxpr``-level work, zero device
 execution -- then audits the IR:
 
 * **GL401** host callback (``io_callback``/``pure_callback``/
-  ``debug_callback``) inside a dispatch-critical program.
+  ``debug_callback``) inside a dispatch-critical program.  A program
+  may DECLARE a deliberate callback via its registration's
+  ``allowed_callbacks`` (the chunked device loop's progress
+  ``io_callback`` is the canonical case) -- the escape hatch is
+  explicit and per-program, never a lint hole: an undeclared callback
+  still fails, a stale declaration fails too, and the callback set is
+  pinned in the committed manifest (``callbacks`` field, GL406).
 * **GL402** f64/complex128 creep: the program is re-traced under
   ``enable_x64`` and any NON-weak wide-float intermediate is flagged --
   weak-typed Python-scalar promotions are exempt, so a finding means an
@@ -196,6 +202,13 @@ def build_contract(capture):
     contract = {
         "outputs": [_aval_str(v) for v in closed.out_avals],
         "donation": list(_donated_argnums(lowered.as_text())),
+        # the host-callback primitives the program actually contains:
+        # pinned so an allowlisted escape hatch cannot silently grow
+        "callbacks": sorted({
+            e.primitive.name
+            for e in _walk_eqns(closed.jaxpr, [])
+            if e.primitive.name in _CALLBACK_PRIMS
+        }),
         "flops": _cost_int("flops"),
         "bytes_accessed": _cost_int("bytes accessed"),
         "const_bytes": int(sum(
@@ -218,16 +231,39 @@ def check_capture(spec, capture, stored=None, const_bytes_max=None):
 
     eqns = _walk_eqns(traced.jaxpr.jaxpr, [])
 
-    # GL401: host callbacks have no place inside a hot program family
+    # GL401: host callbacks have no place inside a hot program family --
+    # unless the registration DECLARES them (allowed_callbacks, the
+    # explicit per-program escape hatch; declared set pinned in the
+    # manifest's `callbacks` field)
+    allowed = frozenset(getattr(capture, "allowed_callbacks", ()) or ())
+    unknown_allowed = sorted(allowed - _CALLBACK_PRIMS)
+    if unknown_allowed:
+        findings.append(_finding(
+            spec, "GL401",
+            f"allowed_callbacks declares unknown primitive(s) "
+            f"{unknown_allowed}: the allowlist names callback "
+            f"primitives from {sorted(_CALLBACK_PRIMS)}",
+        ))
     cb = sorted({
         e.primitive.name for e in eqns if e.primitive.name in _CALLBACK_PRIMS
     })
     for prim in cb:
+        if prim in allowed:
+            continue
         findings.append(_finding(
             spec, "GL401",
             f"host callback primitive {prim!r} inside a dispatch-critical "
             "program: every dispatch now blocks on a host round-trip; "
-            "hoist it out of the traced scope",
+            "hoist it out of the traced scope, or -- if the hop is "
+            "deliberate (progress/checkpoint cadence) -- declare it in "
+            "the registration's allowed_callbacks",
+        ))
+    for prim in sorted((allowed & _CALLBACK_PRIMS) - set(cb)):
+        findings.append(_finding(
+            spec, "GL401",
+            f"allowed_callbacks declares {prim!r} but the traced program "
+            "contains no such callback: remove the stale declaration "
+            "(the allowlist is a contract, not a mute button)",
         ))
 
     # GL405: a transfer inside the program serializes dispatch.  Only
@@ -307,8 +343,8 @@ def check_capture(spec, capture, stored=None, const_bytes_max=None):
 def _diff_contract(stored, fresh):
     """Field-level readable diff lines, empty when identical."""
     out = []
-    for key in ("outputs", "donation", "flops", "bytes_accessed",
-                "const_bytes"):
+    for key in ("outputs", "donation", "callbacks", "flops",
+                "bytes_accessed", "const_bytes"):
         a, b = stored.get(key), fresh.get(key)
         if a != b:
             out.append(

@@ -38,7 +38,50 @@ import numpy as np
 
 from .ops.compile import compile_space
 
-__all__ = ["fmin_on_device", "compile_fmin", "history_from_trials"]
+__all__ = [
+    "TrainableObjective",
+    "fmin_on_device",
+    "compile_fmin",
+    "history_from_trials",
+]
+
+
+class TrainableObjective:
+    """A *stateful* on-device objective: per-trial training inside the scan.
+
+    The plain-fn seam evaluates a stateless ``fn(cfg) -> [B] losses``;
+    real JAX workloads carry state -- params and optimizer moments
+    trained over device-resident data.  A ``TrainableObjective`` gives
+    the device loop that shape as three jit-traceable pieces, vmapped
+    across the trial batch by :func:`compile_fmin`:
+
+    * ``init_fn(key, cfg) -> state`` -- build one trial's carried state
+      (params/opt-state pytree) from a per-trial PRNG key and its
+      hyperparameter dict (scalars, natural space; categorical dims as
+      float indices).
+    * ``step_fn(state, cfg, epoch) -> state`` -- one training epoch,
+      run ``n_epochs`` times under an inner ``lax.fori_loop`` INSIDE
+      the experiment scan step.
+    * ``loss_fn(state, cfg) -> scalar`` -- the trial's reported loss.
+
+    Training data lives in closures (device-resident after the first
+    dispatch).  Per-trial keys derive from the experiment key stream
+    (fold + split), so results are seed-deterministic and independent
+    of batch size placement.  The suggest key stream is untouched --
+    a trainable objective sees the exact suggestion sequence a plain
+    objective with the same algo/seed would.
+    """
+
+    def __init__(self, init_fn, step_fn, loss_fn, n_epochs=1):
+        if int(n_epochs) < 1:
+            raise ValueError("n_epochs must be a positive integer")
+        self.init_fn = init_fn
+        self.step_fn = step_fn
+        self.loss_fn = loss_fn
+        self.n_epochs = int(n_epochs)
+
+    def __repr__(self):
+        return f"TrainableObjective(n_epochs={self.n_epochs})"
 
 
 def history_from_trials(space, trials):
@@ -99,6 +142,13 @@ def compile_fmin(
     loss_threshold=None,
     no_progress_steps=None,
     warm_capacity=0,
+    chunk_size=None,
+    progress_callback=None,
+    progress_every=1,
+    checkpoint_path=None,
+    checkpoint_every=1,
+    resume=False,
+    fs=None,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -160,6 +210,42 @@ def compile_fmin(
         (checkpoint/resume for the on-device path). Warm trials feed the
         posterior and count toward the startup threshold but not toward
         this run's ``max_evals``.
+      chunk_size: restructure the experiment scan into CHUNKED scans of
+        ``ceil(chunk_size / batch_size)`` steps each (trials per chunk,
+        rounded up to a batch multiple; the tail chunk is padded with
+        masked no-op steps).  One compiled chunk program is dispatched
+        ``n_chunks`` times by a host loop -- the per-step key stream
+        folds the GLOBAL step index, so the trial stream is identical
+        to the unchunked scan -- and each chunk boundary is a progress/
+        checkpoint/resume point.  Does not compose with the early-stop
+        ``while_loop`` path (``loss_threshold``/``no_progress_steps``)
+        or vectorized seed sweeps.
+      progress_callback: host callable receiving ``{"chunk", "trials_
+        done", "best_loss"}`` rows streamed out of the running chunk
+        program via ``jax.experimental.io_callback`` (ordered) -- live
+        observability without leaving the compiled regime.  Rows fire
+        on every ``progress_every``-th chunk plus the final one; the
+        callback variant is a separate compiled twin, so cadence-off
+        dispatches pay zero callback overhead, and the result stream
+        is bitwise identical either way.
+      checkpoint_path: publish the scan carry as a durable bundle
+        (tmp+fsync+rename; :func:`hyperopt_tpu.utils.checkpoint.
+        save_device_chunk`) every ``checkpoint_every`` chunks and after
+        the final one.  ``resume=True`` (or ``runner(resume=True)``)
+        loads the bundle and dispatches only the remaining chunks --
+        bitwise identical to the uninterrupted run; a bundle from a
+        different experiment (space/objective/algo/geometry guard) or
+        seed is refused with ``CheckpointError``.
+      fs: PR-3 fault-injection seam for the chunk loop (crash points
+        ``device_loop_after_chunk_before_ckpt`` /
+        ``device_loop_after_ckpt_before_next_chunk`` plus the durable
+        saver's torn-publish window).
+
+    ``fn`` may also be a :class:`TrainableObjective` -- a stateful
+    per-trial training loop (``init_fn``/``step_fn``/``loss_fn``,
+    ``n_epochs`` inner ``fori_loop`` epochs) vmapped across the trial
+    batch, so "optimize a JAX model end-to-end" runs ask-evaluate-tell
+    entirely on device.
 
     The result dict has ``best`` ({label: python value}, the same
     index-form encoding ``fmin`` returns -- ``space_eval(space, best)``
@@ -189,6 +275,31 @@ def compile_fmin(
         or no_progress_steps < 1
     ):
         raise ValueError("no_progress_steps must be a positive integer")
+    chunked = chunk_size is not None
+    if not chunked and (
+        progress_callback is not None
+        or checkpoint_path is not None
+        or resume
+    ):
+        raise ValueError(
+            "progress_callback/checkpoint_path/resume ride the chunked "
+            "scan path; pass chunk_size= to enable it"
+        )
+    if chunked:
+        if loss_threshold is not None or no_progress_steps is not None:
+            raise ValueError(
+                "chunk_size does not compose with loss_threshold/"
+                "no_progress_steps (the early-stop while_loop path is "
+                "unchunked); drop one"
+            )
+        if int(chunk_size) < 1:
+            raise ValueError("chunk_size must be a positive integer")
+        if int(progress_every) < 1 or int(checkpoint_every) < 1:
+            raise ValueError(
+                "progress_every/checkpoint_every must be positive"
+            )
+        if resume and checkpoint_path is None:
+            raise ValueError("resume=True needs checkpoint_path")
     ps = compile_space(space)
     _ = ps._consts  # materialize device constants outside the trace
     D = ps.n_dims
@@ -256,7 +367,10 @@ def compile_fmin(
                 "opt out of population sharding"
             )
 
-    accepts_active = "active" in inspect.signature(fn).parameters
+    trainable = isinstance(fn, TrainableObjective)
+    accepts_active = (
+        not trainable and "active" in inspect.signature(fn).parameters
+    )
 
     def eval_batch(values, active):
         """values/active [D, B] -> losses [B] via the user objective."""
@@ -266,6 +380,26 @@ def compile_fmin(
                 label: active[d] for d, label in enumerate(ps.labels)
             })
         return fn(cfg)
+
+    def eval_batch_trainable(key, values, active):
+        """The stateful seam: per-trial init -> n_epochs inner
+        ``fori_loop`` training -> loss, vmapped over the trial batch.
+        Keys fold a fixed tag off the step key, so the SUGGEST stream
+        is untouched and the training stream is seed-deterministic."""
+        ekeys = jax.random.split(jax.random.fold_in(key, 0x7EA1), B)
+
+        def one(vcol, acol, k):
+            del acol  # trainable cfgs are scalar dicts; inactive dims 0
+            cfg = {label: vcol[d] for d, label in enumerate(ps.labels)}
+            state = fn.init_fn(k, cfg)
+            state = jax.lax.fori_loop(
+                0, fn.n_epochs,
+                lambda e, s: fn.step_fn(s, cfg, e),
+                state,
+            )
+            return fn.loss_fn(state, cfg)
+
+        return jax.vmap(one, in_axes=(1, 1, 0))(values, active, ekeys)
 
     def suggest(key, values, active, losses, valid):
         if algo == "rand":
@@ -356,7 +490,12 @@ def compile_fmin(
         new_vals, new_act = suggest(key, values, active, losses, valid)
         new_vals = _shard_batch(new_vals, (None, trial_axis))
         new_act = _shard_batch(new_act, (None, trial_axis))
-        new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
+        if trainable:
+            new_losses = eval_batch_trainable(
+                key, new_vals, new_act
+            ).astype(jnp.float32)
+        else:
+            new_losses = eval_batch(new_vals, new_act).astype(jnp.float32)
         new_losses = _shard_batch(new_losses, (trial_axis,))
         idx = c0 + i * B + jnp.arange(B)
         values = values.at[:, idx].set(new_vals)
@@ -418,6 +557,168 @@ def compile_fmin(
         best_i = jnp.argmin(keyed)
         return values, active, losses, valid, best_i, n_done
 
+    # ---- chunked-scan machinery (chunk_size=) ----------------------------
+    # the flat scan above dispatches once; the chunked twin dispatches one
+    # compiled chunk program per chunk so every boundary is a progress /
+    # checkpoint / resume point.  The per-step key folds the GLOBAL step
+    # index, so the executed trial stream is bitwise the flat scan's.
+    chunk_steps = n_chunks = None
+    run_chunk = run_chunk_cb = None
+    ck_guard = None
+    resume_default = bool(resume)
+    if chunked:
+        from jax.experimental import io_callback
+
+        from .ops.kernels import history_summary
+
+        chunk_steps = -(-int(chunk_size) // B)
+        n_chunks = -(-n_steps // chunk_steps)
+
+        def _chunk_step(base_key, c0, carry, i):
+            # tail-chunk padding: steps past n_steps are masked no-ops
+            return jax.lax.cond(
+                i < n_steps,
+                lambda c: step(base_key, c0, c, i)[0],
+                lambda c: c,
+                carry,
+            )
+
+        def _chunk_impl(seed_arr, values, active, losses, valid, c0,
+                        chunk_idx):
+            base_key = jax.random.key(seed_arr)
+
+            def body(carry, j):
+                i = chunk_idx * chunk_steps + j
+                return _chunk_step(base_key, c0, carry, i), None
+
+            carry, _ = jax.lax.scan(
+                body, HistoryState(values, active, losses, valid),
+                jnp.arange(chunk_steps),
+            )
+            best, done = history_summary(carry)
+            return (*tuple(carry), best, done)
+
+        run_chunk = jax.jit(_chunk_impl)
+
+        if progress_callback is not None:
+            def _progress_sink(best, done, chunk_idx):
+                progress_callback({
+                    "chunk": int(chunk_idx),
+                    "trials_done": int(done),
+                    "best_loss": float(best),
+                })
+
+            def _chunk_cb_impl(seed_arr, values, active, losses, valid,
+                               c0, chunk_idx):
+                out = _chunk_impl(seed_arr, values, active, losses,
+                                  valid, c0, chunk_idx)
+                # the ONLY sanctioned host hop inside a compiled program
+                # family: declared in the graftir registration's
+                # allowed_callbacks (GL401's explicit escape hatch)
+                io_callback(
+                    _progress_sink, None, out[4], out[5], chunk_idx,
+                    ordered=True,
+                )
+                return out
+
+            run_chunk_cb = jax.jit(_chunk_cb_impl)
+
+        if checkpoint_path is not None:
+            from .hyperband import _algo_identity, _space_fingerprint
+            from .pyll.base import as_apply
+
+            ck_guard = [
+                "device-loop-chunk", 1, str(algo),
+                _space_fingerprint(as_apply(space)), _algo_identity(fn),
+                int(n_steps), int(B), int(chunk_steps), int(cap),
+            ]
+
+    def _runner_chunked(seed, return_trials, init, resume_now):
+        from .distributed.faults import REAL_FS
+
+        fs_ = REAL_FS if fs is None else fs
+        seed_u = int(seed) % (2**32)
+        state = None
+        c0 = 0
+        start_chunk = 0
+        init_state = init_c0 = None
+        if init is not None:
+            iv, ia, il, ivd, init_c0, _ = _unpack_init(init)
+            init_state = (iv, ia, il, ivd)
+        if resume_now:
+            if checkpoint_path is None:
+                raise ValueError("resume=True needs checkpoint_path")
+            from .exceptions import CheckpointError
+            from .utils.checkpoint import load_device_chunk
+
+            if fs_.exists(checkpoint_path):
+                bundle = load_device_chunk(
+                    checkpoint_path, guard=ck_guard, fs=fs_
+                )
+                if int(bundle["seed"]) != seed_u:
+                    raise CheckpointError(
+                        f"chunk checkpoint {checkpoint_path!r} was "
+                        f"written by seed {bundle['seed']}; this run "
+                        f"uses seed {seed_u} -- the resumed stream "
+                        "would diverge; refusing to resume"
+                    )
+                if init_c0 is not None and int(bundle["c0"]) != init_c0:
+                    raise CheckpointError(
+                        f"chunk checkpoint {checkpoint_path!r} records "
+                        f"a warm offset of {bundle['c0']} trials but "
+                        f"init= holds {init_c0}; refusing to resume"
+                    )
+                c0 = int(bundle["c0"])
+                start_chunk = int(bundle["chunk_next"])
+                state = (bundle["values"], bundle["active"],
+                         bundle["losses"], bundle["valid"])
+        if state is None:
+            if init_state is not None:
+                state, c0 = init_state, init_c0
+            else:
+                state = _zero_state()
+        out = None
+        for ci in range(start_chunk, n_chunks):
+            use_cb = run_chunk_cb is not None and (
+                (ci + 1) % int(progress_every) == 0
+                or ci == n_chunks - 1
+            )
+            prog = run_chunk_cb if use_cb else run_chunk
+            out = prog(
+                np.uint32(seed_u), *state, np.int32(c0), np.int32(ci)
+            )
+            state = out[:4]
+            fs_.crashpoint("device_loop_after_chunk_before_ckpt")
+            if checkpoint_path is not None and (
+                (ci + 1) % int(checkpoint_every) == 0
+                or ci == n_chunks - 1
+            ):
+                from .utils.checkpoint import save_device_chunk
+
+                host = jax.device_get(state)  # one batched fetch
+                save_device_chunk(checkpoint_path, {
+                    "guard": ck_guard, "seed": seed_u, "c0": int(c0),
+                    "chunk_next": ci + 1, "n_chunks": int(n_chunks),
+                    "values": np.asarray(host[0]),
+                    "active": np.asarray(host[1]),
+                    "losses": np.asarray(host[2]),
+                    "valid": np.asarray(host[3]),
+                }, fs=fs_)
+                fs_.crashpoint(
+                    "device_loop_after_ckpt_before_next_chunk"
+                )
+        values, active, losses, valid = (
+            np.asarray(a) for a in jax.device_get(state)
+        )
+        n_ran = n_steps * B
+        total = c0 + n_ran
+        keyed = np.where(valid & np.isfinite(losses), losses, np.inf)
+        best_i = int(np.argmin(keyed))
+        return _package_result(
+            values[:, :total], active[:, :total], losses[:total],
+            best_i, n_ran, total, return_trials,
+        )
+
     cat_dims = set(ps.cat_idx.tolist())
 
     zero_buffers = []  # device-resident, reused by every cold run
@@ -468,7 +769,71 @@ def compile_fmin(
             ))
         return outs
 
-    def runner(seed=0, return_trials=False, init=None):
+    def _zero_state():
+        if jax.process_count() > 1:
+            # multi-process (jax.distributed) runtime: inputs
+            # committed to one local device cannot feed a global-mesh
+            # computation; hand jit host numpy instead -- uncommitted
+            # inputs are placed by jit as fully-replicated over the
+            # global mesh (same contract as
+            # parallel.sharded._history_inputs)
+            return (
+                np.zeros((D, cap), dtype=np.float32),
+                np.zeros((D, cap), dtype=bool),
+                np.zeros(cap, dtype=np.float32),
+                np.zeros(cap, dtype=bool),
+            )
+        if not zero_buffers:  # non-donated, so safely reusable
+            zero_buffers.append(jax.device_put((
+                np.zeros((D, cap), dtype=np.float32),
+                np.zeros((D, cap), dtype=bool),
+                np.zeros(cap, dtype=np.float32),
+                np.zeros(cap, dtype=bool),
+            )))
+        return zero_buffers[0]
+
+    def _unpack_init(init):
+        iv = np.asarray(init["values"], dtype=np.float32)
+        ia = np.asarray(init["active"], dtype=bool)
+        il = np.asarray(init["losses"], dtype=np.float32)
+        c0 = il.shape[0]
+        if c0 > W:
+            raise ValueError(
+                f"init history has {c0} trials but warm_capacity={W}; "
+                "recompile with a larger warm_capacity"
+            )
+        values0 = np.zeros((D, cap), dtype=np.float32)
+        active0 = np.zeros((D, cap), dtype=bool)
+        losses0 = np.zeros(cap, dtype=np.float32)
+        valid0 = np.zeros(cap, dtype=bool)
+        values0[:, :c0] = iv
+        active0[:, :c0] = ia
+        losses0[:c0] = il
+        valid0[:c0] = True
+        best0 = np.float32(np.inf)
+        fin = il[np.isfinite(il)]
+        if fin.size:  # early-stop rules see the warm best
+            best0 = np.float32(fin.min())
+        return values0, active0, losses0, valid0, c0, best0
+
+    def runner(seed=0, return_trials=False, init=None, resume=None):
+        if chunked:
+            if isinstance(seed, (list, tuple)) or (
+                isinstance(seed, np.ndarray) and seed.ndim > 0
+            ):
+                raise ValueError(
+                    "chunk_size does not compose with vectorized seed "
+                    "sweeps; run seeds individually"
+                )
+            resume_now = bool(
+                resume_default if resume is None else resume
+            )
+            return _runner_chunked(seed, return_trials, init, resume_now)
+        if resume:
+            raise ValueError(
+                "resume rides the chunked path; pass chunk_size= (and "
+                "checkpoint_path=) to compile_fmin"
+            )
         if isinstance(seed, (list, tuple)) or (
             isinstance(seed, np.ndarray) and seed.ndim > 0
         ):
@@ -478,50 +843,14 @@ def compile_fmin(
                     "fresh or resume seeds individually"
                 )
             return _runner_seeds(list(seed), return_trials)
-        c0 = 0
-        best0 = np.float32(np.inf)
         if init is None:
-            if jax.process_count() > 1:
-                # multi-process (jax.distributed) runtime: inputs
-                # committed to one local device cannot feed a global-mesh
-                # computation; hand jit host numpy instead -- uncommitted
-                # inputs are placed by jit as fully-replicated over the
-                # global mesh (same contract as
-                # parallel.sharded._history_inputs)
-                values0 = np.zeros((D, cap), dtype=np.float32)
-                active0 = np.zeros((D, cap), dtype=bool)
-                losses0 = np.zeros(cap, dtype=np.float32)
-                valid0 = np.zeros(cap, dtype=bool)
-            else:
-                if not zero_buffers:  # non-donated, so safely reusable
-                    zero_buffers.append(jax.device_put((
-                        np.zeros((D, cap), dtype=np.float32),
-                        np.zeros((D, cap), dtype=bool),
-                        np.zeros(cap, dtype=np.float32),
-                        np.zeros(cap, dtype=bool),
-                    )))
-                values0, active0, losses0, valid0 = zero_buffers[0]
+            c0 = 0
+            best0 = np.float32(np.inf)
+            values0, active0, losses0, valid0 = _zero_state()
         else:
-            iv = np.asarray(init["values"], dtype=np.float32)
-            ia = np.asarray(init["active"], dtype=bool)
-            il = np.asarray(init["losses"], dtype=np.float32)
-            c0 = il.shape[0]
-            if c0 > W:
-                raise ValueError(
-                    f"init history has {c0} trials but warm_capacity={W}; "
-                    "recompile with a larger warm_capacity"
-                )
-            values0 = np.zeros((D, cap), dtype=np.float32)
-            active0 = np.zeros((D, cap), dtype=bool)
-            losses0 = np.zeros(cap, dtype=np.float32)
-            valid0 = np.zeros(cap, dtype=bool)
-            values0[:, :c0] = iv
-            active0[:, :c0] = ia
-            losses0[:c0] = il
-            valid0[:c0] = True
-            fin = il[np.isfinite(il)]
-            if fin.size:  # early-stop rules see the warm best
-                best0 = np.float32(fin.min())
+            values0, active0, losses0, valid0, c0, best0 = (
+                _unpack_init(init)
+            )
         # scalars as host numpy (uncommitted) for the same multi-process
         # placement reason as the zero buffers above
         out_dev = run(
@@ -583,6 +912,16 @@ def compile_fmin(
     # runner closure is the only other holder
     runner._compiled_run = run
     runner._history_capacity = cap
+    runner._packed_space = ps
+    runner._compiled_chunk = run_chunk
+    runner._compiled_chunk_cb = run_chunk_cb
+    if chunked:
+        runner._chunk_geometry = {
+            "chunk_steps": chunk_steps,
+            "n_chunks": n_chunks,
+            "n_steps": n_steps,
+            "batch_size": B,
+        }
     return runner
 
 
@@ -601,6 +940,47 @@ def fmin_on_device(fn, space, max_evals, seed=0, return_trials=False, **kw):
 from .ops.compile import ProgramCapture, register_program  # noqa: E402
 
 
+def _registry_quadratic(cfg):
+    """The registry's reference objective (sum of squared offsets)."""
+    import jax.numpy as jnp
+
+    t = jnp.zeros((), jnp.float32)
+    for label in sorted(cfg):
+        t = t + (cfg[label] - 1.0) ** 2
+    return t
+
+
+def _history_args(runner, tail_dtypes):
+    """Abstract input specs shared by every compile_fmin program: seed +
+    the four history-carry arrays + per-family scalar tail."""
+    import jax
+    import jax.numpy as jnp
+
+    cap = runner._history_capacity
+    D = runner._packed_space.n_dims
+    return (
+        jax.ShapeDtypeStruct((), np.uint32),           # seed
+        jax.ShapeDtypeStruct((D, cap), jnp.float32),   # values
+        jax.ShapeDtypeStruct((D, cap), jnp.bool_),     # active
+        jax.ShapeDtypeStruct((cap,), jnp.float32),     # losses
+        jax.ShapeDtypeStruct((cap,), jnp.bool_),       # valid
+    ) + tuple(jax.ShapeDtypeStruct((), dt) for dt in tail_dtypes)
+
+
+def _scan_args(runner):
+    """(..., c0, best0): the flat ``run`` program's tail."""
+    import jax.numpy as jnp
+
+    return _history_args(runner, (jnp.int32, jnp.float32))
+
+
+def _chunk_args(runner):
+    """(..., c0, chunk_idx): the chunk program's tail."""
+    import jax.numpy as jnp
+
+    return _history_args(runner, (jnp.int32, jnp.int32))
+
+
 @register_program(
     "device_loop.scan",
     families=("hyperopt_tpu.device_loop:compile_fmin",),
@@ -611,40 +991,89 @@ def _registry_device_loop(p):
     fused into one program.  Traced over abstract zero-history inputs
     at a small step count -- the IR shape is step-count-scaled but
     structurally identical to production runs."""
-    import jax
-    import jax.numpy as jnp
-
     from .ops.compile import reference_space
 
-    def _objective(cfg):
-        t = jnp.zeros((), jnp.float32)
-        for label in sorted(cfg):
-            t = t + (cfg[label] - 1.0) ** 2
-        return t
-
     runner = compile_fmin(
-        _objective, reference_space(), max_evals=4, batch_size=1,
+        _registry_quadratic, reference_space(), max_evals=4, batch_size=1,
         algo="tpe", n_startup_jobs=2, n_EI_candidates=24,
     )
-    cap = runner._history_capacity
-    D = p.space.n_dims
-    args = (
-        jax.ShapeDtypeStruct((), np.uint32),           # seed
-        jax.ShapeDtypeStruct((D, cap), jnp.float32),   # values
-        jax.ShapeDtypeStruct((D, cap), jnp.bool_),     # active
-        jax.ShapeDtypeStruct((cap,), jnp.float32),     # losses
-        jax.ShapeDtypeStruct((cap,), jnp.bool_),       # valid
-        jax.ShapeDtypeStruct((), jnp.int32),           # warm offset c0
-        jax.ShapeDtypeStruct((), jnp.float32),         # best0
+    return ProgramCapture(fn=runner._compiled_run, args=_scan_args(runner))
+
+
+@register_program(
+    "device_loop.chunked_scan",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_chunked_scan(p):
+    """One chunk of the chunked experiment scan (``chunk_size=``): the
+    same step math as ``device_loop.scan`` over ``chunk_steps`` global
+    step indices, plus the chunk-boundary summary reductions.  No host
+    callback -- the cadence-off dispatches must stay callback-free."""
+    from .ops.compile import reference_space
+
+    runner = compile_fmin(
+        _registry_quadratic, reference_space(), max_evals=8, batch_size=1,
+        algo="tpe", n_startup_jobs=2, n_EI_candidates=24, chunk_size=4,
     )
-    return ProgramCapture(fn=runner._compiled_run, args=args)
+    return ProgramCapture(
+        fn=runner._compiled_chunk, args=_chunk_args(runner)
+    )
 
 
-def _to_trials(ps, values, active, losses):
-    """Rebuild a host ``Trials`` store from the device history."""
+@register_program(
+    "device_loop.chunked_scan_cb",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_chunked_scan_cb(p):
+    """The progress-streaming twin of ``device_loop.chunked_scan``: the
+    identical chunk body plus ONE ordered ``io_callback`` emitting the
+    (trials done, best-so-far) row.  The callback is DECLARED via
+    ``allowed_callbacks`` -- GL401's explicit per-program escape hatch;
+    an undeclared callback anywhere else still fails the gate."""
+    from .ops.compile import reference_space
+
+    runner = compile_fmin(
+        _registry_quadratic, reference_space(), max_evals=8, batch_size=1,
+        algo="tpe", n_startup_jobs=2, n_EI_candidates=24, chunk_size=4,
+        progress_callback=lambda row: None,
+    )
+    return ProgramCapture(
+        fn=runner._compiled_chunk_cb, args=_chunk_args(runner),
+        allowed_callbacks=("io_callback",),
+        # shares the chunk closure with device_loop.chunked_scan (same
+        # build, callback appended): promotion behavior already pinned
+        x64_check=False,
+    )
+
+
+@register_program(
+    "device_loop.train_step",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_train_step(p):
+    """The stateful-objective experiment scan: a ``TrainableObjective``
+    (per-trial MLP training -- init, inner ``fori_loop`` epochs, loss)
+    vmapped across the trial batch inside the scan step.  Pins the
+    train-inside-the-scan IR: no callbacks, no f64 creep from the
+    grad/opt math, contract-stable cost."""
+    from .models.synthetic import mlp_tune_objective, mlp_tune_space
+
+    runner = compile_fmin(
+        mlp_tune_objective(n_epochs=2, n_train=32, in_dim=4, hidden=8),
+        mlp_tune_space(), max_evals=4, batch_size=2,
+        algo="tpe", n_startup_jobs=2, n_EI_candidates=8,
+    )
+    return ProgramCapture(fn=runner._compiled_run, args=_scan_args(runner))
+
+
+def _to_trials(ps, values, active, losses, trials=None):
+    """Rebuild a host ``Trials`` store from the device history (into
+    ``trials`` when given -- the ``fmin(compiled=True)`` route fills
+    the caller's store; a fresh one otherwise)."""
     from .base import JOB_STATE_DONE, STATUS_FAIL, STATUS_OK, Trials
 
-    trials = Trials()
+    if trials is None:
+        trials = Trials()
     n = values.shape[1]
     ids = trials.new_trial_ids(n)
     cat = set(ps.cat_idx.tolist())

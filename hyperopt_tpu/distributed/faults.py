@@ -51,7 +51,7 @@ __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
     "DeviceFaultPlan",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
-    "ALL_CRASH_POINTS",
+    "DEVICE_LOOP_CRASH_POINTS", "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -108,7 +108,32 @@ SERVE_CRASH_POINTS = (
     "serve_after_dispatch_before_ack",
 )
 
-ALL_CRASH_POINTS = CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
+#: crash points of the CHUNKED device loop's host loop
+#: (``device_loop.compile_fmin(chunk_size=..., checkpoint_path=...)``):
+#: the on-device experiment dispatches chunk by chunk and publishes a
+#: durable carry bundle at the checkpoint cadence, so its crash windows
+#: sit between a finished chunk and its bundle.  The device-loop resume
+#: suite (tests/test_device_loop_chunked.py) iterates this tuple at
+#: EVERY chunk boundary::
+#:
+#:     device_loop_after_chunk_before_ckpt   chunk dispatched, carry not
+#:                                           yet durable (resume replays
+#:                                           the chunk from the previous
+#:                                           bundle)
+#:     device_loop_after_ckpt_before_next_chunk  bundle published, next
+#:                                           chunk not yet dispatched
+#:
+#: (the bundle publish itself rides ``durable_pickle``'s existing
+#: ``after_ckpt_tmp_before_rename`` torn-publish window.)
+DEVICE_LOOP_CRASH_POINTS = (
+    "device_loop_after_chunk_before_ckpt",
+    "device_loop_after_ckpt_before_next_chunk",
+)
+
+ALL_CRASH_POINTS = (
+    CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
+    + DEVICE_LOOP_CRASH_POINTS
+)
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError
 #: (ENOENT) may be added to a plan's ``errors`` to simulate NFS

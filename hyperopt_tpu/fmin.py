@@ -10,6 +10,7 @@ loss_threshold / early_stop_fn), checkpoint trials to
 
 from __future__ import annotations
 
+import functools
 import logging
 import os
 import pickle
@@ -525,6 +526,91 @@ def _driver_guard(algo, fn, space):
     ]
 
 
+def _compiled_algo_name(algo):
+    """Map the plugin-seam ``algo`` onto a device-loop algo name for
+    ``fmin(compiled=True)``: strings pass through, the repo's suggest
+    callables (tpe/anneal/rand/atpe, host or _jax, partial-wrapped)
+    resolve by module."""
+    if algo is None:
+        return "tpe"
+    if isinstance(algo, str):
+        if algo not in ("tpe", "anneal", "rand", "atpe"):
+            raise ValueError(
+                f"unknown compiled algo {algo!r}: expected "
+                "tpe|anneal|rand|atpe"
+            )
+        return algo
+    a = algo
+    while isinstance(a, functools.partial):
+        a = a.func
+    mod = getattr(a, "__module__", "") or ""
+    short = mod.rsplit(".", 1)[-1]
+    base = short[:-4] if short.endswith("_jax") else short
+    if base in ("tpe", "anneal", "rand", "atpe"):
+        return base
+    raise ValueError(
+        f"compiled=True cannot map algo {algo!r} onto a device-loop "
+        "algo; pass algo='tpe'|'anneal'|'rand'|'atpe'"
+    )
+
+
+def _run_compiled(fn, space, algo, max_evals, loss_threshold, trials,
+                  rstate, return_argmin, options):
+    """The ``fmin(compiled=True)`` body: route the experiment through
+    ``device_loop.compile_fmin`` -- suggest, evaluate (plain fn or
+    :class:`~hyperopt_tpu.device_loop.TrainableObjective` training
+    loop), history append all inside the compiled scan -- and rebuild a
+    standard ``Trials`` store from the device history."""
+    from .device_loop import _to_trials, compile_fmin
+
+    opts = dict(options or {})
+    runner = opts.pop("runner", None)
+    seed = opts.pop("seed", None)
+    if seed is None:
+        # one draw from the caller's stream: deterministic under a
+        # seeded rstate, like every host-driver seed
+        if hasattr(rstate, "integers"):
+            seed = int(rstate.integers(2**31 - 1))
+        else:
+            seed = int(rstate.randint(2**31 - 1))
+    if trials is not None and len(trials):
+        raise ValueError(
+            "compiled=True starts a fresh experiment; warm-start via "
+            "device_loop.history_from_trials + compile_fmin("
+            "warm_capacity=...) instead"
+        )
+    if runner is None:
+        if not isinstance(max_evals, (int, np.integer)):
+            raise ValueError(
+                "compiled=True requires an integer max_evals (the scan "
+                "length is part of the compiled program)"
+            )
+        runner = compile_fmin(
+            fn, space, int(max_evals),
+            algo=_compiled_algo_name(algo),
+            loss_threshold=loss_threshold, **opts,
+        )
+    elif opts:
+        raise ValueError(
+            "compiled_options: pass either a prebuilt runner= (from "
+            "compile_fmin, for compile reuse across calls) or builder "
+            "options, not both"
+        )
+    out = runner(seed=seed)
+    if trials is None:
+        trials = Trials()
+    _to_trials(
+        runner._packed_space, out["values"], out["active"],
+        out["losses"], trials=trials,
+    )
+    if return_argmin:
+        return trials.argmin
+    try:
+        return trials.best_trial["result"]["loss"]
+    except AllTrialsFailed:
+        return None
+
+
 def fmin(
     fn,
     space,
@@ -547,6 +633,8 @@ def fmin(
     resume_from=None,
     trial_timeout=None,
     catch=(),
+    compiled=False,
+    compiled_options=None,
 ):
     """Minimize ``fn`` over ``space`` using ``algo``.
 
@@ -570,6 +658,19 @@ def fmin(
     ``catch`` (an exception class or tuple) does the same for raising
     objectives, with the traceback attached to the result -- both are
     WAL-logged, so a resumed run never re-runs a known-bad trial.
+
+    Compiled objectives: ``compiled=True`` routes a JAX-traceable ``fn``
+    (a jnp function over ``[batch]`` value dicts, or a
+    :class:`~hyperopt_tpu.device_loop.TrainableObjective` training
+    loop) through ``device_loop.compile_fmin`` -- the whole
+    ask-evaluate-tell loop as ONE device program, no per-trial RTT --
+    and returns the standard ``Trials``/argmin contract.  ``algo`` may
+    be a device-loop name ('tpe'|'anneal'|'rand'|'atpe') or one of this
+    repo's suggest callables (mapped by module); ``compiled_options``
+    passes builder knobs through (``batch_size``, ``chunk_size``,
+    ``progress_callback``, ``checkpoint_path``/``resume`` for
+    kill-and-resume, ``seed`` to pin the device seed, or a prebuilt
+    ``runner=`` for compile reuse across calls).
     """
     if algo is None:
         from . import tpe
@@ -592,6 +693,39 @@ def fmin(
     validate_timeout(timeout)
     validate_loss_threshold(loss_threshold)
     validate_timeout(trial_timeout)
+
+    if compiled:
+        # the RTT-floor bypass: the WHOLE ask-evaluate-tell loop runs
+        # on device (device_loop.compile_fmin) and comes back as a
+        # standard Trials store.  Host-driver-only features are
+        # rejected loudly rather than silently ignored.
+        if trials_save_file or resume_from is not None:
+            raise ValueError(
+                "compiled=True durability rides compiled_options "
+                "(chunk_size/checkpoint_path/resume -- the chunked "
+                "device loop), not trials_save_file/resume_from"
+            )
+        unsupported = [
+            name for name, v in (
+                ("timeout", timeout),
+                ("early_stop_fn", early_stop_fn),
+                ("points_to_evaluate", points_to_evaluate),
+                ("trial_timeout", trial_timeout),
+                ("catch", catch or None),
+            ) if v is not None
+        ]
+        if unsupported:
+            raise ValueError(
+                f"compiled=True runs the experiment as one device "
+                f"program; host-driver feature(s) {unsupported} do not "
+                "apply (loss_threshold compiles to the on-device "
+                "stopping rule; use compiled_options for chunked "
+                "progress/checkpointing)"
+            )
+        return _run_compiled(
+            fn, space, algo, max_evals, loss_threshold, trials, rstate,
+            return_argmin, compiled_options,
+        )
 
     from .utils.checkpoint import DriverRecovery
 

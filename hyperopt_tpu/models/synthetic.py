@@ -19,7 +19,7 @@ import numpy as np
 from .. import hp
 
 __all__ = ["SyntheticDomain", "DOMAINS", "battery", "mixed_space", "branin_fn",
-           "hartmann6_fn"]
+           "hartmann6_fn", "mlp_tune_objective", "mlp_tune_space"]
 
 
 class SyntheticDomain:
@@ -363,3 +363,81 @@ def mixed_space_fn_jax(cfg):
         elif k.startswith("ri") or k.startswith("ch"):
             t = t + 0.02 * (jnp.round(v).astype(jnp.int32) % 3)
     return t
+
+
+def mlp_tune_space():
+    """The MLP-tuning search space: optimizer hyperparameters of a
+    fixed-architecture regressor (shapes are static; the knobs are the
+    training dynamics -- lr, momentum, weight decay, init scale)."""
+    return {
+        "lr": hp.loguniform("lr", math.log(1e-3), math.log(1.0)),
+        "momentum": hp.uniform("momentum", 0.0, 0.99),
+        "wd": hp.loguniform("wd", math.log(1e-6), math.log(1e-2)),
+        "init_scale": hp.loguniform(
+            "init_scale", math.log(1e-2), math.log(1.0)
+        ),
+    }
+
+
+def mlp_tune_objective(n_epochs=8, n_train=256, in_dim=8, hidden=32,
+                       seed=0):
+    """End-to-end MLP tuning as a :class:`hyperopt_tpu.device_loop.
+    TrainableObjective`: each trial initializes its own 2-layer MLP
+    (tanh head) at its drawn ``init_scale``, trains ``n_epochs``
+    full-batch SGD+momentum epochs on a fixed synthetic regression set
+    (device-resident after the first dispatch), and reports final MSE.
+    A REAL vmapped training loop -- params and momentum are per-trial
+    carried state inside the experiment scan, not a closed-form
+    objective.  Pair with :func:`mlp_tune_space`."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..device_loop import TrainableObjective
+
+    key = jax.random.key(seed)
+    kx, kw, kn = jax.random.split(key, 3)
+    X = jax.random.normal(kx, (n_train, in_dim), jnp.float32)
+    w_true = jax.random.normal(kw, (in_dim,), jnp.float32)
+    y = jnp.tanh(X @ w_true) + 0.1 * jax.random.normal(
+        kn, (n_train,), jnp.float32
+    )
+
+    def _mse(params):
+        h = jnp.tanh(X @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        return jnp.mean((pred - y) ** 2)
+
+    def init_fn(k, cfg):
+        k1, k2 = jax.random.split(k)
+        scale = cfg["init_scale"]
+        params = {
+            "w1": scale * jax.random.normal(
+                k1, (in_dim, hidden), jnp.float32
+            ),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": scale * jax.random.normal(
+                k2, (hidden,), jnp.float32
+            ),
+            "b2": jnp.zeros((), jnp.float32),
+        }
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        return params, momentum
+
+    def step_fn(state, cfg, epoch):
+        del epoch  # constant-lr schedule
+        params, momentum = state
+        grads = jax.grad(_mse)(params)
+        momentum = jax.tree.map(
+            lambda m, g, p: cfg["momentum"] * m - cfg["lr"] * (
+                g + cfg["wd"] * p
+            ),
+            momentum, grads, params,
+        )
+        params = jax.tree.map(lambda p, m: p + m, params, momentum)
+        return params, momentum
+
+    def loss_fn(state, cfg):
+        params, _ = state
+        return _mse(params)
+
+    return TrainableObjective(init_fn, step_fn, loss_fn, n_epochs=n_epochs)

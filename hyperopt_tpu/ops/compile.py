@@ -431,6 +431,14 @@ class ProgramCapture:
     args: tuple
     kwargs: dict = dataclasses.field(default_factory=dict)
     donate_argnums: tuple = ()
+    #: GL401's explicit escape hatch: the host-callback primitives this
+    #: program DECLARES it contains (e.g. ``("io_callback",)`` for the
+    #: chunked device loop's progress row).  An undeclared callback in
+    #: the jaxpr is still a finding, and so is a stale declaration the
+    #: traced program no longer contains -- the allowlist is a contract,
+    #: not a mute button.  The callback set is also pinned in the
+    #: committed manifest (GL406 ``callbacks`` field).
+    allowed_callbacks: tuple = ()
     #: run the enable_x64 re-trace (GL402)?  A program that shares its
     #: closure with another registered program (same build, different
     #: static batch) may skip the duplicate re-trace -- the family's

@@ -28,6 +28,7 @@ __all__ = [
     "HistoryState",
     "apply_delta",
     "apply_delta_masked",
+    "history_summary",
     "check_prior_weight",
     "compact_gmm",
     "forgetting_weights",
@@ -68,6 +69,21 @@ class HistoryState(NamedTuple):
     active: jax.Array  # [D, cap] per-dim activity mask
     losses: jax.Array  # [cap]
     valid: jax.Array  # [cap] slot occupancy
+
+
+def history_summary(state):
+    """(best finite loss, occupied slots) of a :class:`HistoryState`.
+
+    The chunk-boundary progress row of the chunked device loop
+    (:func:`hyperopt_tpu.device_loop.compile_fmin` with ``chunk_size``):
+    computed inside the chunk program so the ``io_callback`` row costs
+    two reductions, not a history fetch.  ``best`` is ``inf`` while no
+    finite loss exists (all-failed startup chunks).
+    """
+    ok = state.valid & jnp.isfinite(state.losses)
+    best = jnp.min(jnp.where(ok, state.losses, jnp.inf))
+    done = jnp.sum(state.valid.astype(jnp.int32))
+    return best, done
 
 
 def apply_delta(values, active, losses, valid, vcol, acol, loss, idx):

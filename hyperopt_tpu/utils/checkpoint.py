@@ -27,6 +27,8 @@ __all__ = [
     "load_guarded",
     "save_pytree",
     "load_pytree",
+    "save_device_chunk",
+    "load_device_chunk",
 ]
 
 
@@ -352,6 +354,57 @@ def load_pickle_guarded(path, fs=None, what="checkpoint"):
             f"{what} {path!r} is truncated or corrupt "
             f"({type(e).__name__}: {e}){hint}"
         ) from e
+
+
+# ---------------------------------------------------------------------------
+# chunked device-loop carry bundles (device_loop.compile_fmin chunk_size=)
+# ---------------------------------------------------------------------------
+
+
+DEVICE_CHUNK_FORMAT = 1
+
+
+def save_device_chunk(path, bundle, fs=None):
+    """Durably publish one chunk-boundary carry bundle of the chunked
+    device loop: the full scan carry (values/active/losses/valid as
+    host numpy), the seed, the warm offset, and ``chunk_next`` -- the
+    first chunk a resumed run must dispatch.  Rides
+    :func:`durable_pickle` (tmp + fsync + atomic rename through the
+    PR-3 ``fs=`` seam), with the shared ``after_ckpt_tmp_before_rename``
+    torn-publish crash window armed for the chaos tests."""
+    bundle = dict(bundle, format=DEVICE_CHUNK_FORMAT)
+    return durable_pickle(
+        bundle, path, fs=fs, crash_between="after_ckpt_tmp_before_rename"
+    )
+
+
+def load_device_chunk(path, guard=None, fs=None):
+    """Load a chunk bundle, refusing (CheckpointError) corruption and
+    -- when ``guard`` is given -- a bundle written by a different
+    experiment (space/objective/algo/geometry fingerprint): resuming a
+    foreign chunk stream would silently change the experiment."""
+    from ..exceptions import CheckpointError
+
+    bundle = load_pickle_guarded(
+        path, fs=fs, what="device-loop chunk checkpoint"
+    )
+    if bundle.get("format") != DEVICE_CHUNK_FORMAT:
+        raise CheckpointError(
+            f"device-loop chunk checkpoint {path!r} has format "
+            f"{bundle.get('format')!r}; this loader reads format "
+            f"{DEVICE_CHUNK_FORMAT}"
+        )
+    if (
+        guard is not None
+        and bundle.get("guard") is not None
+        and list(bundle["guard"]) != list(guard)
+    ):
+        raise CheckpointError(
+            f"device-loop chunk checkpoint {path!r} was written by a "
+            f"different experiment (guard {bundle['guard']!r} != "
+            f"{list(guard)!r}); refusing to resume"
+        )
+    return bundle
 
 
 # ---------------------------------------------------------------------------

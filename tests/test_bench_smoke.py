@@ -24,7 +24,7 @@ def test_bench_prints_one_json_line():
     env["BENCH_SERVE_ROUNDS"] = "3"
     out = subprocess.run(
         [sys.executable, "bench.py"],
-        capture_output=True, text=True, timeout=900, env=env,
+        capture_output=True, text=True, timeout=1200, env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
     assert out.returncode == 0, out.stderr[-2000:]
@@ -43,8 +43,22 @@ def test_bench_prints_one_json_line():
     assert d["n_trials_1k"] == 40
     assert d["speculative_suggest_per_sec"] > 0
     assert d["single_suggest_sync_per_sec"] > 0
-    # device-loop variant is accelerator-only; key must exist either way
-    assert "device_loop_seconds_at_1k" in d
+    # round-14: the device-loop family is stamped on EVERY backend,
+    # keyed by backend so rounds stay comparable within one
+    assert d["device_loop_trials_per_sec"] > 0
+    assert d["device_loop_config"]["backend"] == "cpu"
+    assert d["device_loop_seconds_at_1k"] > 0
+    assert d["device_loop_seq_seconds_at_1k"] > 0
+    # round-14 compiled-objective rows: fmin(compiled=True) wall-clock
+    # on the same experiment as the host sequential headline, HPO over
+    # a real vmapped training loop (TrainableObjective), and the
+    # io_callback observability cost
+    assert d["seconds_to_best_at_1k_compiled"] > 0
+    assert d["best_loss_at_1k_compiled"] >= 0
+    assert d["compiled_vs_host_speedup_x"] > 0
+    assert d["mlp_tune_trials_per_sec"] > 0
+    assert d["mlp_tune_config"]["backend"] == "cpu"
+    assert d["device_loop_callback_overhead_frac"] >= 0
     # round-5 fields: cache stamp always present; asha-on-device keys
     # exist (None off-accelerator)
     assert d["compilation_cache"] in (True, False)

@@ -1,0 +1,309 @@
+"""Chunked checkpointed device loops + stateful train-inside-the-scan.
+
+The round-14 acceptance contracts:
+
+* the chunked scan (``compile_fmin(chunk_size=)``) produces a result
+  stream BITWISE identical to the flat scan -- including a padded tail
+  chunk -- because the per-step key folds the global step index;
+* the ``io_callback`` progress cadence changes NOTHING but
+  observability: callback-on vs callback-off result streams are
+  bitwise equal, and the rows themselves are consistent with the run;
+* kill-and-resume at EVERY chunk boundary (both device-loop crash
+  points, riding the PR-3/PR-6 fault-injection seam) is bitwise equal
+  to the uninterrupted run, with foreign-experiment / foreign-seed
+  bundles refused;
+* ``TrainableObjective`` (per-trial params/opt-state trained by an
+  inner ``fori_loop`` INSIDE the scan step) runs end to end,
+  deterministically, and composes with chunking + resume;
+* ``fmin(fn, compiled=True)`` routes through the device loop and
+  returns the standard Trials/argmin contract.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin, hp
+from hyperopt_tpu.device_loop import TrainableObjective, compile_fmin
+from hyperopt_tpu.distributed.faults import (
+    DEVICE_LOOP_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import CheckpointError
+
+SPACE = {"x": hp.uniform("x", -5.0, 5.0), "u": hp.choice("u", [0, 1, 2])}
+
+N_EVALS = 24
+BATCH = 2  # 12 steps; chunk_size=8 -> 4-step chunks, 3 chunks
+KW = dict(
+    max_evals=N_EVALS, batch_size=BATCH, n_startup_jobs=4,
+    n_EI_candidates=8, n_EI_candidates_cat=4,
+)
+SEED = 5
+
+
+def _objective(cfg):
+    return (cfg["x"] - 1.0) ** 2 + 0.1 * cfg["u"]
+
+
+_RESULTS = {}
+
+
+def _flat_result():
+    if "flat" not in _RESULTS:
+        _RESULTS["flat"] = compile_fmin(_objective, SPACE, **KW)(seed=SEED)
+    return _RESULTS["flat"]
+
+
+def _assert_stream_equal(a, b):
+    """The FULL result stream, bitwise: every loss, every drawn value,
+    every activity bit, and the derived best."""
+    for f in ("losses", "values", "active"):
+        np.testing.assert_array_equal(a[f], b[f], err_msg=f)
+    assert a["best_loss"] == b["best_loss"]
+    assert a["best_index"] == b["best_index"]
+    assert a["best"] == b["best"]
+
+
+def test_chunked_bitwise_parity_with_flat():
+    out = compile_fmin(_objective, SPACE, chunk_size=8, **KW)(seed=SEED)
+    assert out["n_evals"] == N_EVALS
+    _assert_stream_equal(_flat_result(), out)
+
+
+def test_padded_tail_chunk_bitwise_parity():
+    # chunk_size=5 -> 3-step chunks, 4 chunks covering 12 steps: the
+    # tail chunk runs masked no-op steps past n_steps
+    out = compile_fmin(_objective, SPACE, chunk_size=5, **KW)(seed=SEED)
+    assert out["n_evals"] == N_EVALS
+    _assert_stream_equal(_flat_result(), out)
+
+
+def test_callback_cadence_on_off_bitwise_parity_and_rows():
+    rows = []
+    runner = compile_fmin(
+        _objective, SPACE, chunk_size=8,
+        progress_callback=rows.append, progress_every=2, **KW,
+    )
+    out = runner(seed=SEED)
+    # ON vs OFF: bitwise the same stream (the flat run IS the
+    # callback-off stream, proven equal to chunked-off above)
+    _assert_stream_equal(_flat_result(), out)
+    # cadence: every 2nd chunk plus the final one -> chunks 1 and 2
+    assert [r["chunk"] for r in rows] == [1, 2]
+    assert [r["trials_done"] for r in rows] == [16, 24]
+    # best-so-far is monotone and lands on the run's best
+    bests = [r["best_loss"] for r in rows]
+    assert bests == sorted(bests, reverse=True)
+    assert bests[-1] == out["best_loss"]
+    # a second run re-fires the cadence (no one-shot callback state)
+    rows.clear()
+    runner(seed=SEED)
+    assert [r["chunk"] for r in rows] == [1, 2]
+
+
+def test_kill_and_resume_every_chunk_boundary_bitwise(tmp_path):
+    """THE resume acceptance: arm each device-loop crash point at each
+    chunk boundary, kill, resume -- the completed stream must be
+    bitwise the uninterrupted run's, for every (point, boundary)."""
+    path = str(tmp_path / "chunk.ckpt")
+    plan = FaultPlan(seed=0)
+    runner = compile_fmin(
+        _objective, SPACE, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, fs=plan.fs(), **KW,
+    )
+    ref = runner(seed=SEED)
+    _assert_stream_equal(_flat_result(), ref)  # durability changes nothing
+    n_chunks = runner._chunk_geometry["n_chunks"]
+    assert n_chunks == 3
+    for point in DEVICE_LOOP_CRASH_POINTS:
+        for at in range(1, n_chunks + 1):
+            if os.path.exists(path):
+                os.remove(path)
+            plan.arm(point, at=at)
+            with pytest.raises(SimulatedCrash):
+                runner(seed=SEED)
+            out = runner(seed=SEED, resume=True)
+            _assert_stream_equal(ref, out)
+    # resume of a COMPLETED run packages straight from the bundle
+    # (no dispatch, same stream)
+    out = runner(seed=SEED, resume=True)
+    _assert_stream_equal(ref, out)
+
+
+def test_resume_refuses_foreign_seed_and_foreign_experiment(tmp_path):
+    path = str(tmp_path / "chunk.ckpt")
+    runner = compile_fmin(
+        _objective, SPACE, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, **KW,
+    )
+    runner(seed=SEED)
+    with pytest.raises(CheckpointError, match="seed"):
+        runner(seed=SEED + 1, resume=True)
+    # a different experiment geometry writes a different guard
+    foreign = compile_fmin(
+        _objective, SPACE, max_evals=2 * N_EVALS, batch_size=BATCH,
+        n_startup_jobs=4, n_EI_candidates=8, n_EI_candidates_cat=4,
+        chunk_size=8, checkpoint_path=path, checkpoint_every=1,
+    )
+    with pytest.raises(CheckpointError, match="refusing to resume"):
+        foreign(seed=SEED, resume=True)
+
+
+def test_chunk_option_validation():
+    with pytest.raises(ValueError, match="chunk_size"):
+        compile_fmin(
+            _objective, SPACE, progress_callback=print, **KW
+        )
+    with pytest.raises(ValueError, match="loss_threshold"):
+        compile_fmin(
+            _objective, SPACE, chunk_size=8, loss_threshold=0.1, **KW
+        )
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        compile_fmin(_objective, SPACE, chunk_size=8, resume=True, **KW)
+    runner = compile_fmin(_objective, SPACE, chunk_size=8, **KW)
+    with pytest.raises(ValueError, match="seed sweep"):
+        runner(seed=[0, 1])
+    with pytest.raises(ValueError, match="checkpoint_path"):
+        runner(seed=0, resume=True)
+    flat = compile_fmin(_objective, SPACE, **KW)
+    with pytest.raises(ValueError, match="chunked"):
+        flat(seed=0, resume=True)
+
+
+# ---------------------------------------------------------------------------
+# TrainableObjective: stateful training inside the scan
+# ---------------------------------------------------------------------------
+
+
+def _tiny_mlp():
+    from hyperopt_tpu.models.synthetic import (
+        mlp_tune_objective,
+        mlp_tune_space,
+    )
+
+    return (
+        mlp_tune_objective(n_epochs=4, n_train=64, in_dim=4, hidden=8),
+        mlp_tune_space(),
+    )
+
+
+def test_trainable_objective_trains_deterministically():
+    obj, space = _tiny_mlp()
+    assert isinstance(obj, TrainableObjective)
+    runner = compile_fmin(
+        obj, space, max_evals=8, batch_size=4, n_startup_jobs=4,
+        n_EI_candidates=4,
+    )
+    a = runner(seed=0)
+    assert np.isfinite(a["losses"]).all()
+    # a REAL training loop: different hyperparameters train to
+    # different losses (a constant stream would mean the state never
+    # actually trained)
+    assert np.unique(a["losses"]).size > 1
+    b = runner(seed=0)
+    _assert_stream_equal(a, b)  # seed-deterministic
+    c = runner(seed=1)
+    assert not np.array_equal(a["losses"], c["losses"])
+
+
+def test_trainable_objective_chunked_kill_resume_bitwise(tmp_path):
+    """The tentpole combination: per-trial training INSIDE the scan,
+    chunk boundaries streaming progress, a kill mid-experiment, and a
+    bitwise-identical resume."""
+    obj, space = _tiny_mlp()
+    path = str(tmp_path / "mlp.ckpt")
+    plan = FaultPlan(seed=0)
+    rows = []
+    runner = compile_fmin(
+        obj, space, max_evals=16, batch_size=4, n_startup_jobs=4,
+        n_EI_candidates=4, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, progress_callback=rows.append, fs=plan.fs(),
+    )
+    ref = runner(seed=3)
+    rows.clear()
+    plan.arm("device_loop_after_ckpt_before_next_chunk", at=1)
+    with pytest.raises(SimulatedCrash):
+        runner(seed=3)
+    out = runner(seed=3, resume=True)
+    _assert_stream_equal(ref, out)
+    assert rows and rows[-1]["trials_done"] == 16
+
+
+def test_trainable_objective_validation():
+    with pytest.raises(ValueError, match="n_epochs"):
+        TrainableObjective(lambda k, c: 0, lambda s, c, e: s,
+                           lambda s, c: 0.0, n_epochs=0)
+
+
+# ---------------------------------------------------------------------------
+# fmin(compiled=True): the routed front
+# ---------------------------------------------------------------------------
+
+
+def test_fmin_compiled_returns_standard_trials_and_argmin():
+    trials = Trials()
+    best = fmin(
+        _objective, SPACE, compiled=True, max_evals=16,
+        rstate=np.random.default_rng(3), trials=trials,
+        compiled_options=dict(
+            batch_size=2, n_startup_jobs=4, n_EI_candidates=8,
+        ),
+    )
+    assert len(trials) == 16
+    assert set(best) <= {"x", "u"} and "x" in best
+    losses = trials.losses()
+    assert len(losses) == 16 and all(np.isfinite(losses))
+    # argmin really is the best trial's config
+    assert trials.argmin == best
+    # return_argmin=False follows the fmin contract (best loss), and a
+    # same-rstate rerun is deterministic
+    loss = fmin(
+        _objective, SPACE, compiled=True, max_evals=16,
+        rstate=np.random.default_rng(3), return_argmin=False,
+        compiled_options=dict(
+            batch_size=2, n_startup_jobs=4, n_EI_candidates=8,
+        ),
+    )
+    assert loss == min(losses)
+
+
+def test_fmin_compiled_algo_mapping():
+    import functools
+
+    from hyperopt_tpu import anneal_jax, tpe, tpe_jax
+    from hyperopt_tpu.fmin import _compiled_algo_name
+
+    assert _compiled_algo_name(None) == "tpe"
+    assert _compiled_algo_name("anneal") == "anneal"
+    assert _compiled_algo_name(tpe.suggest) == "tpe"
+    assert _compiled_algo_name(tpe_jax.suggest) == "tpe"
+    assert _compiled_algo_name(
+        functools.partial(anneal_jax.suggest, batch=4)
+    ) == "anneal"
+    with pytest.raises(ValueError, match="compiled"):
+        _compiled_algo_name(lambda *a: None)
+    with pytest.raises(ValueError, match="unknown compiled algo"):
+        _compiled_algo_name("grid")
+
+
+def test_fmin_compiled_rejects_host_driver_features():
+    with pytest.raises(ValueError, match="trials_save_file"):
+        fmin(_objective, SPACE, compiled=True, max_evals=8,
+             trials_save_file="/tmp/x.ckpt")
+    with pytest.raises(ValueError, match="trial_timeout"):
+        fmin(_objective, SPACE, compiled=True, max_evals=8,
+             trial_timeout=1.0)
+    filled = Trials()
+    fmin(
+        _objective, SPACE, compiled=True, max_evals=4, trials=filled,
+        rstate=np.random.default_rng(0),
+        compiled_options=dict(
+            batch_size=2, n_startup_jobs=2, n_EI_candidates=4,
+        ),
+    )
+    with pytest.raises(ValueError, match="fresh experiment"):
+        fmin(_objective, SPACE, compiled=True, max_evals=8,
+             trials=filled)

@@ -73,3 +73,32 @@ def test_sequential_1k_compiles_on_log_schedule():
     # startup prior draws share one trace (B=1, one shape)
     ps = domain._packed_space
     assert _cache_size(ps.sample_prior) == 1
+
+
+def test_chunked_scan_compiles_once_across_runs_and_resume(tmp_path):
+    """The round-14 chunked-scan program family: ONE trace per compiled
+    chunk program (plain + callback twin) no matter how many chunks,
+    runs, or resumes dispatch it -- chunk_idx/c0 are traced scalars, so
+    neither the host chunk loop nor a mid-experiment resume may
+    retrace.  A per-chunk regression puts these at n_chunks; a
+    per-run regression at the run count."""
+    import jax.numpy as jnp
+
+    from hyperopt_tpu import hp
+    from hyperopt_tpu.device_loop import compile_fmin
+
+    space = {"x": hp.uniform("x", -5.0, 5.0)}
+    rows = []
+    runner = compile_fmin(
+        lambda cfg: (cfg["x"] - 1.0) ** 2, space,
+        max_evals=16, batch_size=2, n_startup_jobs=2, n_EI_candidates=4,
+        chunk_size=4, progress_callback=rows.append, progress_every=2,
+        checkpoint_path=str(tmp_path / "chunk.ckpt"), checkpoint_every=1,
+    )
+    assert runner._chunk_geometry["n_chunks"] == 4
+    runner(seed=0)
+    runner(seed=1)
+    # resume of the completed seed-1 run replays from the bundle
+    runner(seed=1, resume=True)
+    assert _cache_size(runner._compiled_chunk) == 1
+    assert _cache_size(runner._compiled_chunk_cb) == 1
