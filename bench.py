@@ -56,6 +56,49 @@ def bench_lint():
     return len(result.findings), result.baseline_size
 
 
+def bench_trace():
+    """graftrace (hyperopt-tpu-lint --trace) over the package, plus a
+    LIVE lockdep probe: the GL5xx concurrency totals are stamped so a
+    new unguarded access or lock-order cycle is visible in the round
+    JSON even when nobody ran the fast tier, and the probe proves the
+    runtime sanitizer is armed and detecting (it wraps two locks,
+    establishes an order, then deliberately inverts it under try/
+    except -- exactly one caught inversion is the healthy stamp).
+
+    Returns (trace_findings_total, trace_rules_checked,
+    lockdep_inversions_observed); zero lint work executes any code
+    under test -- pure AST."""
+    import threading
+
+    from hyperopt_tpu.analysis import lint_paths, load_baseline
+    from hyperopt_tpu.analysis.lockdep import LockDep, LockOrderError
+    from hyperopt_tpu.analysis.rules import RULES
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    baseline_path = os.path.join(repo, "lint_baseline.json")
+    baseline = (
+        load_baseline(baseline_path)
+        if os.path.exists(baseline_path) else None
+    )
+    result = lint_paths([os.path.join(repo, "hyperopt_tpu")],
+                        baseline=baseline, root=repo, pack="trace")
+    rules_checked = sum(1 for r in RULES if r.startswith("GL5"))
+
+    dep = LockDep()
+    a = dep.wrap(threading.Lock(), "bench.probe.a")
+    b = dep.wrap(threading.Lock(), "bench.probe.b")
+    with a:
+        with b:
+            pass
+    try:
+        with b:
+            with a:  # the deliberate inversion the sanitizer must catch
+                pass
+    except LockOrderError:
+        pass
+    return len(result.findings), rules_checked, dep.inversions
+
+
 def bench_ir():
     """graftir (hyperopt-tpu-lint --ir) over the program registry: the
     count of dispatch-critical families whose jaxpr/lowering checked
@@ -1041,6 +1084,8 @@ def main():
     rtt_ms = bench_rtt()
     lint_findings_total, lint_baseline_size = bench_lint()
     ir_programs_checked, ir_contract_drift = bench_ir()
+    (trace_findings_total, trace_rules_checked,
+     lockdep_inversions_observed) = bench_trace()
 
     print(
         json.dumps(
@@ -1164,6 +1209,13 @@ def main():
                 # tree -- drift is accepted only via --update-contracts)
                 "ir_programs_checked": ir_programs_checked,
                 "ir_contract_drift": ir_contract_drift,
+                # round-16 graftrace rows: GL5xx concurrency findings
+                # over the package (0 on a healthy tree), how many
+                # rules checked, and the lockdep probe (exactly 1 =
+                # the runtime sanitizer is armed and detecting)
+                "trace_findings_total": trace_findings_total,
+                "trace_rules_checked": trace_rules_checked,
+                "lockdep_inversions_observed": lockdep_inversions_observed,
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,

@@ -20,6 +20,14 @@ table mapping each rule to the PR that motivated it):
   ``hyperopt-tpu-lint --ir``) -- host callbacks, f64 creep, declined
   donation, oversized baked constants, mid-program transfers, and
   shape/cost drift against the committed ``program_contracts.json``
+* GL5xx -- graftrace: static lock-discipline & race analysis over the
+  serve/distributed threaded surface (:mod:`.trace`,
+  ``hyperopt-tpu-lint --trace``) -- per-class lock-domain inference,
+  unguarded shared-attribute access, lock-order cycles, blocking and
+  jitted-dispatch calls under a lock, if-then-``Condition.wait``,
+  futures resolved under a lock, threads started mid-``__init__``,
+  daemon threads tearing durable state; paired with a runtime lockdep
+  sanitizer (:mod:`.lockdep`) the serve suites arm at test time
 
 Inline suppression::
 

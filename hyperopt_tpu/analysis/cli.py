@@ -21,6 +21,11 @@ findings no matter where it is invoked from.
 arguments, against the committed ``program_contracts.json`` (resolved
 next to the package by default -- cwd-independent).  Accept deliberate
 contract changes with ``--ir --update-contracts``.
+
+``--trace`` switches to the graftrace concurrency pack (GL5xx, see
+:mod:`.trace`): lock-domain inference and lock-discipline checks over
+the same path arguments, with the identical exit-code contract,
+``--format json``, pragma, and baseline workflow as the default pack.
 """
 
 from __future__ import annotations
@@ -78,6 +83,13 @@ def _build_parser():
         "--ir", action="store_true",
         help="run the graftir jaxpr-level pack (GL4xx) over the "
         "registered dispatch-critical program families",
+    )
+    p.add_argument(
+        "--trace", action="store_true",
+        help="run the graftrace concurrency pack (GL5xx: lock-domain "
+        "inference, lock-order cycles, blocking/dispatch under lock) "
+        "instead of the default AST pack; same exit contract, formats, "
+        "and baseline workflow",
     )
     p.add_argument(
         "--contracts", default=None, metavar="FILE",
@@ -144,6 +156,13 @@ def main(argv=None):
             file=sys.stderr,
         )
         return 2
+    if args.ir and args.trace:
+        print(
+            "hyperopt-tpu-lint: error: --ir and --trace are separate "
+            "packs; run them as two invocations",
+            file=sys.stderr,
+        )
+        return 2
     if args.ir:
         return _main_ir(args)
 
@@ -161,11 +180,14 @@ def main(argv=None):
     if root is None and baseline_path is not None:
         root = os.path.dirname(os.path.abspath(baseline_path))
 
+    pack = "trace" if args.trace else "ast"
     try:
         counter = None
         if baseline_path is not None and not args.write_baseline:
             counter = baseline_mod.load_baseline(baseline_path)
-        result = lint_paths(args.paths, baseline=counter, root=root)
+        result = lint_paths(
+            args.paths, baseline=counter, root=root, pack=pack
+        )
     except (FileNotFoundError, ValueError, OSError) as e:
         print(f"hyperopt-tpu-lint: error: {e}", file=sys.stderr)
         return 2

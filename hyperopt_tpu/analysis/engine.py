@@ -38,7 +38,14 @@ import os
 import re
 import tokenize
 
-__all__ = ["Finding", "LintResult", "FileContext", "lint_source", "lint_paths"]
+__all__ = [
+    "Finding",
+    "LintResult",
+    "FileContext",
+    "lint_source",
+    "lint_paths",
+    "unwrap_partial",
+]
 
 # wrapper terminals that open a traced scope
 JIT_WRAPPERS = frozenset({"jit", "pmap", "shard_map", "pallas_call"})
@@ -113,6 +120,20 @@ def dotted_name(node):
     return None
 
 
+def unwrap_partial(node):
+    """``partial(f, ...)`` / ``functools.partial(f, ...)`` -> ``f``;
+    anything else passes through.  A partial binds arguments -- it does
+    not change which body runs, so scope resolution (jitted scopes AND
+    thread-entry targets) must see through it."""
+    if (
+        isinstance(node, ast.Call)
+        and terminal_name(node.func) == "partial"
+        and node.args
+    ):
+        return node.args[0]
+    return node
+
+
 def wrapper_call_name(call):
     """If ``call`` invokes a trace wrapper (directly or via partial),
     return the wrapper terminal, else None."""
@@ -162,6 +183,7 @@ class FileContext:
         self.functions = [
             n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)
         ]
+        self.thread_targets = self._resolve_thread_targets()
 
     # -- scope helpers -----------------------------------------------------
 
@@ -233,14 +255,7 @@ class FileContext:
         # fn = partial(a, ...) -- a partial binds arguments, it does not
         # change which function body traces, so scoped rules must see
         # through it (the fn = functools.partial(f, cfg); jit(fn) gap)
-        def _unwrap_partial(node):
-            if (
-                isinstance(node, ast.Call)
-                and terminal_name(node.func) == "partial"
-                and node.args
-            ):
-                return node.args[0]
-            return node
+        _unwrap_partial = unwrap_partial
 
         alias = {}
         for node in ast.walk(self.tree):
@@ -289,6 +304,80 @@ class FileContext:
                 jitted |= resolve(target.id)
         return jitted
 
+    # -- thread-entry-target resolution ------------------------------------
+
+    def _resolve_thread_targets(self):
+        """Map function/method defs that are THREAD ENTRY POINTS to
+        ``{"daemon": bool}``.
+
+        Resolves ``threading.Thread(target=...)`` and
+        ``executor.submit(fn, ...)`` callables through a ``partial``
+        wrapper, covering the three shapes the codebase uses:
+
+        * ``Thread(target=self._loop)`` -- a BOUND METHOD of the
+          enclosing class (by-name def lookup alone misses these);
+        * ``Thread(target=functools.partial(self._method, arg))``;
+        * ``Thread(target=local_fn)`` -- a plain (possibly nested) def.
+
+        Rules treat these as concurrency ROOTS: a thread target enters
+        with no lock held, whatever its in-class callers hold."""
+        targets = {}
+        defs_by_name = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        def enclosing_class(node):
+            for a in self.ancestors(node):
+                if isinstance(a, ast.ClassDef):
+                    return a
+            return None
+
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            t = terminal_name(node.func)
+            target = None
+            if t == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        target = kw.value
+            elif t == "submit" and node.args:
+                # pool.submit(fn, ...): the executor's worker threads
+                target = node.args[0]
+            if target is None:
+                continue
+            target = unwrap_partial(target)
+            daemon = any(
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and bool(kw.value.value)
+                for kw in node.keywords
+            )
+            resolved = []
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                cls = enclosing_class(node)
+                if cls is not None:
+                    for m in cls.body:
+                        if (
+                            isinstance(m, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                            and m.name == target.attr
+                        ):
+                            resolved.append(m)
+            elif isinstance(target, ast.Name):
+                resolved.extend(defs_by_name.get(target.id, ()))
+            elif isinstance(target, ast.Lambda):
+                resolved.append(target)
+            for fn in resolved:
+                info = targets.setdefault(fn, {"daemon": False})
+                info["daemon"] = info["daemon"] or daemon
+        return targets
+
 
 def parse_pragmas(source):
     """Map line -> set of rule IDs disabled there (via tokenize, so
@@ -308,14 +397,26 @@ def parse_pragmas(source):
     return pragmas
 
 
-def lint_source(source, path="<string>"):
+def lint_source(source, path="<string>", pack="ast"):
     """Lint one file's source; returns (findings, n_pragma_suppressed).
+
+    ``pack`` selects the checker pack: ``"ast"`` (the default GL1xx-3xx
+    invariants) or ``"trace"`` (the GL5xx graftrace concurrency pack,
+    ``hyperopt-tpu-lint --trace``).  Both share the engine, the pragma
+    machinery, and the baseline format.
 
     Unparsable source is itself a finding (GL002) rather than an engine
     crash -- a syntax error in a diff must fail the lint test, not
     crash the harness with a traceback.
     """
     from .rules import CHECKERS, RULES
+
+    if pack == "trace":
+        from .trace import TRACE_CHECKERS as checkers
+    elif pack == "ast":
+        checkers = CHECKERS
+    else:
+        raise ValueError(f"unknown checker pack {pack!r}")
 
     try:
         tree = ast.parse(source)
@@ -335,20 +436,22 @@ def lint_source(source, path="<string>"):
     pragmas = parse_pragmas(source)
 
     raw = []
-    for rule_id, checker in CHECKERS:
+    for rule_id, checker in checkers:
         raw.extend(checker(ctx))
 
-    # GL001: a pragma naming a rule the pack does not define is dead
-    # weight that silently stops protecting when the real ID differs
-    for lineno, ids in pragmas.items():
-        for rid in sorted(ids):
-            if rid not in RULES:
-                f = ctx.finding(
-                    "GL001",
-                    ast.Pass(lineno=lineno, col_offset=0),
-                    f"suppression names unknown rule ID {rid!r}",
-                )
-                raw.append(f)
+    # GL001: a pragma naming a rule NO pack defines is dead weight that
+    # silently stops protecting when the real ID differs (ast pack
+    # only, so the two packs never double-report the same pragma)
+    if pack == "ast":
+        for lineno, ids in pragmas.items():
+            for rid in sorted(ids):
+                if rid not in RULES:
+                    f = ctx.finding(
+                        "GL001",
+                        ast.Pass(lineno=lineno, col_offset=0),
+                        f"suppression names unknown rule ID {rid!r}",
+                    )
+                    raw.append(f)
 
     kept, n_suppressed = [], 0
     for f in raw:
@@ -383,13 +486,14 @@ def iter_python_files(paths):
     return out
 
 
-def lint_paths(paths, baseline=None, root=None):
+def lint_paths(paths, baseline=None, root=None, pack="ast"):
     """Lint files/directories; apply ``baseline`` (a loaded baseline
     multiset, see :mod:`.baseline`) to filter grandfathered findings.
 
     ``root`` anchors finding paths (default: the process cwd) -- pass
     the repo root when calling from elsewhere so paths keep matching
-    the committed baseline's repo-relative keys.
+    the committed baseline's repo-relative keys.  ``pack`` selects the
+    checker pack (see :func:`lint_source`).
     """
     from .baseline import apply_baseline
 
@@ -405,7 +509,7 @@ def lint_paths(paths, baseline=None, root=None):
             os.path.relpath(fp, start=root)
             if root is not None or os.path.isabs(fp) else fp
         )
-        fs, ns = lint_source(source, path=rel)
+        fs, ns = lint_source(source, path=rel, pack=pack)
         findings.extend(fs)
         n_suppressed += ns
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -83,6 +83,46 @@ for _id, _name, _summary in (
 ):
     RULES[_id] = Rule(_id, _name, _summary)
 
+# GL5xx -- the graftrace concurrency pack (hyperopt-tpu-lint --trace):
+# static lock-discipline and race analysis over the serve/distributed
+# threaded surface.  The checkers live in analysis/trace.py (their own
+# pack, selected by lint_source(pack="trace")); the metadata lives in
+# this table so --list-rules documents them and pragmas naming them
+# validate under the default pack (GL001 must not flag a GL5xx
+# suppression on a scheduler line as unknown).
+for _id, _name, _summary in (
+    ("GL501", "unguarded-shared-attribute",
+     "a shared instance attribute written under the class's inferred "
+     "lock domain (majority `with self._lock:` usage) is read or "
+     "mutated lock-free elsewhere -- a data race across methods or "
+     "thread-entry targets"),
+    ("GL502", "lock-order-inversion",
+     "two locks of one class are acquired in both orders across its "
+     "methods (inter-procedural acquisition graph over the class and "
+     "its self-callees has a cycle) -- the classic ABBA deadlock"),
+    ("GL503", "blocking-call-under-lock",
+     "a blocking call (socket accept/recv/sendall, fsync/durable "
+     "writes, Future.result/Thread.join, time.sleep, or a jitted "
+     "dispatch callable) runs while a lock is held -- every contending "
+     "thread stalls for the call's full latency"),
+    ("GL504", "condition-wait-without-predicate-loop",
+     "Condition.wait called outside an enclosing while loop -- spurious "
+     "wakeups and stolen predicates make if-then-wait lose signals"),
+    ("GL505", "future-resolved-under-lock",
+     "Future.set_result/set_exception while holding a lock -- done-"
+     "callbacks run inline in the resolving thread and can re-enter "
+     "the lock (the callback-under-lock deadlock shape)"),
+    ("GL506", "thread-started-before-init-complete",
+     "a thread is started inside __init__ before later instance "
+     "attributes are assigned -- the target can observe a partially "
+     "constructed object"),
+    ("GL507", "daemon-thread-durable-mutation",
+     "WAL/checkpoint durable state is mutated from a daemon-thread "
+     "entry point (directly or via same-class callees) -- daemon "
+     "threads die mid-write at interpreter exit, tearing the artifact"),
+):
+    RULES[_id] = Rule(_id, _name, _summary)
+
 
 def _is_test_file(ctx):
     base = ctx.parts[-1] if ctx.parts else ""

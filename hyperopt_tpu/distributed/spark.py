@@ -185,8 +185,11 @@ class SparkTrials(Trials):
         timeout = kwargs.pop("timeout", None)
         if timeout is not None:
             self.timeout = timeout
-        self._start_time = timeit.default_timer()
-        self._fmin_cancelled = False
+        # under the lock (GL501): the dispatcher threads' lock domain
+        # owns the cancellation flag (same fix as ThreadTrials.fmin)
+        with self._lock:
+            self._start_time = timeit.default_timer()
+            self._fmin_cancelled = False
         pass_expr_memo_ctrl = kwargs.pop("pass_expr_memo_ctrl", None)
         self._domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
         kwargs.setdefault("max_queue_len", self.parallelism)

@@ -126,8 +126,12 @@ class ThreadTrials(Trials):
         timeout = kwargs.pop("timeout", None)
         if timeout is not None:
             self.timeout = timeout
-        self._start_time = timeit.default_timer()
-        self._fmin_cancelled = False
+        # under the lock (GL501): _fmin_cancelled is read/written by
+        # the worker threads' lock domain, and a racing re-entrant
+        # fmin must not tear the previous run's cancellation state
+        with self._lock:
+            self._start_time = timeit.default_timer()
+            self._fmin_cancelled = False
 
         pass_expr_memo_ctrl = kwargs.pop("pass_expr_memo_ctrl", None)
         domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)

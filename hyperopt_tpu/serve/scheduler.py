@@ -358,7 +358,7 @@ class BatchScheduler:
             return self._studies[name]
 
     # -- tell --------------------------------------------------------------
-    def tell(self, study, tid, vals, loss):
+    def tell(self, study, tid, vals, loss):  # graftlint: disable=GL503 the WAL append IS the tell's durability barrier and must be ordered inside the study's tell linearization (write-ahead-then-apply, PR-6/PR-8); moving it outside the lock reorders tells against dedup and delta staging
         """Absorb one completed trial: WAL first, host buffer second,
         device delta staged third.  Synchronous -- the durability
         barrier is the WAL append, and the host add is O(D).
@@ -432,7 +432,7 @@ class BatchScheduler:
         else:
             c[name] -= 1
 
-    def submit_ask(self, study, deadline=None):
+    def submit_ask(self, study, deadline=None):  # graftlint: disable=GL503 the flush-only (no-fsync) ask record must stay ordered with the seed draw and tid allocation it snapshots -- the restored-cursor bitwise contract; the next tell's fsync is its barrier
         """Queue one ask; returns the queued request (``.tid`` /
         ``.future``).  The per-ask seed is drawn HERE, from the study's
         own stream -- the batching order downstream can no longer
@@ -598,7 +598,7 @@ class BatchScheduler:
             self.dispatch_count += 1
             self.delta_drain_dispatches += 1
 
-    def _pick_round(self):
+    def _pick_round(self):  # graftlint: disable=GL505 shed futures resolve under the round lock by design: the service API attaches no done-callbacks to ask futures (clients block in Future.result, which waits on the future's own condition, never this lock)
         """At most one queued ask per study this round, FIFO.  Expired
         deadlines and closed/quarantined studies are shed here -- a
         request nobody is waiting for must not consume a dispatch
@@ -637,7 +637,7 @@ class BatchScheduler:
         self._asks = leftover
         return picked
 
-    def step(self):
+    def step(self):  # graftlint: disable=GL505 the BaseException path fails picked futures before re-raising a simulated/real process death -- reordering outside the lock would let a racing submit observe a dying batcher; no done-callbacks exist (see _pick_round)
         """One dispatch round: returns the number of asks served.
         Synchronous entry point -- the background loop calls this, and
         tests/chaos harnesses call it directly so crashes propagate.
@@ -680,7 +680,7 @@ class BatchScheduler:
         for st in self._slots.values():
             st.dirty = True
 
-    def _recover_round(self, picked, exc):
+    def _recover_round(self, picked, exc):  # graftlint: disable=GL505 failure futures resolve under the round lock: the retry/circuit decision and the picked set must stay atomic wrt racing submits; no done-callbacks exist (see _pick_round)
         """The watchdog's failure path (lock held): retry once on
         transient faults, contain the failure to the picked requests
         otherwise, trip the circuit breaker on repeated failures."""
@@ -727,7 +727,7 @@ class BatchScheduler:
             self.circuit_open = False
             self._round_failures = 0
 
-    def _run_dispatch(self, fn):
+    def _run_dispatch(self, fn):  # graftlint: disable=GL503 serializing dispatch rounds under the scheduler lock IS the continuous-batching design (one round in flight, ever); the watchdog deadline bounds the blocking result() wait
         """Run one device dispatch under the watchdog deadline.  With
         no ``dispatch_timeout`` the call is inline (zero overhead); with
         one, the dispatch runs on a disposable worker thread and a
@@ -757,7 +757,7 @@ class BatchScheduler:
                 "watchdog deadline"
             ) from None
 
-    def _dispatch_round(self, picked):
+    def _dispatch_round(self, picked):  # graftlint: disable=GL503,GL505,GL507 the round (flush-only served record, acks) is atomic under the lock by design -- acks-last keeps crashes replayable, no done-callbacks exist (see _pick_round), and a daemon-torn served record is flush-only: replay re-derives it from the ask cursor (PR-6/PR-8 recovery contract)
         """Serve one picked round (lock held): maintain the stacked
         state, run the batched program, ack every pick."""
         import jax
@@ -934,15 +934,20 @@ class BatchScheduler:
             t = self._thread
             self._thread = None
             # a stopping batcher must not strand blocked clients:
-            # drain the queue and fail every pending ask promptly
-            # instead of letting ask() hang out its full timeout
+            # drain the queue promptly instead of letting ask() hang
+            # out its full timeout -- but resolve the futures AFTER
+            # release (GL505: a done-callback re-entering the
+            # scheduler would deadlock on the held lock)
+            stranded = []
             while self._asks:
                 req = self._asks.popleft()
                 self._dec_queue(req)
-                if not req.future.done():
-                    req.future.set_exception(
-                        RuntimeError("suggestion service shutting down")
-                    )
+                stranded.append(req)
+        for req in stranded:
+            if not req.future.done():
+                req.future.set_exception(
+                    RuntimeError("suggestion service shutting down")
+                )
         if t is not None:
             t.join(timeout=5.0)
 
@@ -978,11 +983,17 @@ class BatchScheduler:
                 # a dying batcher must not strand blocked clients
                 # (contained dispatch failures no longer land here --
                 # step() fails only the picked futures and survives;
-                # this is the SimulatedCrash / interpreter-exit path)
+                # this is the SimulatedCrash / interpreter-exit path).
+                # Queue drained under the lock, futures failed after
+                # release (GL505)
                 with self._lock:
+                    stranded = []
                     while self._asks:
                         req = self._asks.popleft()
                         self._dec_queue(req)
+                        stranded.append(req)
+                for req in stranded:
+                    if not req.future.done():
                         req.future.set_exception(
                             RuntimeError("serve batcher died")
                         )

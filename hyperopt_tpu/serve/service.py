@@ -339,7 +339,7 @@ class SuggestService:
             self.scheduler.start()
 
     # -- tenancy -----------------------------------------------------------
-    def create_study(self, name, seed=0):
+    def create_study(self, name, seed=0):  # graftlint: disable=GL503 the durable open record must be atomic with the registry insert -- two racing creates of one name must serialize restore-or-create, and an unrecorded-but-registered study would lose its seed on crash
         """Open (or re-attach to, or restore) a study by name."""
         if not _NAME_RE.fullmatch(name):
             raise ValueError(
@@ -372,9 +372,14 @@ class SuggestService:
             if handle is None:
                 return
             study = self.scheduler.close_study(name)
-            if study.persist is not None:
-                study.persist.maybe_snapshot(study, force=True)
-                study.persist.close()
+        # the durable close runs OUTSIDE the registry lock (GL503: the
+        # snapshot fsyncs, and unrelated create/close calls must not
+        # stall behind it); the study is already unregistered, and the
+        # WAL it compacts holds every tell, so a racing re-create of
+        # the same name restores losslessly either way
+        if study.persist is not None:
+            study.persist.maybe_snapshot(study, force=True)
+            study.persist.close()
 
     def studies(self):
         with self._lock:

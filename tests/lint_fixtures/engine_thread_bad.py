@@ -1,0 +1,44 @@
+"""Engine fixture (thread-entry resolution): bound-method and
+functools.partial thread targets must resolve as analyzable ROOT
+scopes.  ``_drain`` / ``_bump`` are also called from locked contexts,
+so WITHOUT target resolution the entry fixpoint would conclude they
+always run under the lock and GL501 would stay silent -- the findings
+below exist only because ``Thread(target=self._drain)`` and
+``Thread(target=functools.partial(self._bump, 2))`` force them to be
+lock-free roots."""
+import functools
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._t1 = threading.Thread(target=self._drain)
+        self._t2 = threading.Thread(target=functools.partial(self._bump, 2))
+
+    def add(self, k):
+        with self._lock:
+            self.total += k
+
+    def read(self):
+        with self._lock:
+            return self.total
+
+    def snapshot(self):
+        with self._lock:
+            return {"total": self.total}
+
+    def reset(self):
+        with self._lock:
+            self._drain()
+
+    def kick(self):
+        with self._lock:
+            self._bump(1)
+
+    def _drain(self):
+        self.total = 0
+
+    def _bump(self, k):
+        self.total += k

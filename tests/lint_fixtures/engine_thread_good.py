@@ -1,0 +1,32 @@
+"""Engine near miss: the same thread-target shapes, but the entry
+methods take the lock themselves -- resolution must NOT over-flag."""
+import functools
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._t1 = threading.Thread(target=self._drain)
+        self._t2 = threading.Thread(target=functools.partial(self._bump, 2))
+
+    def add(self, k):
+        with self._lock:
+            self.total += k
+
+    def read(self):
+        with self._lock:
+            return self.total
+
+    def snapshot(self):
+        with self._lock:
+            return {"total": self.total}
+
+    def _drain(self):
+        with self._lock:
+            self.total = 0
+
+    def _bump(self, k):
+        with self._lock:
+            self.total += k

@@ -1,0 +1,30 @@
+"""GL501 true positive: queue + counter written under the inferred
+lock domain in the serve path, then touched lock-free elsewhere."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.served = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._queue.append(req)
+
+    def pick(self):
+        with self._lock:
+            if self._queue:
+                self._queue.pop()
+                self.served += 1
+
+    def stats(self):
+        with self._lock:
+            return {"served": self.served, "depth": len(self._queue)}
+
+    def reset_stats(self):
+        self.served = 0  # lock-free write to a guarded counter
+
+    def requeue(self, req):
+        self._queue.append(req)  # lock-free mutation of the queue

@@ -1,0 +1,31 @@
+"""GL501 near miss: the same shape, every access under the guard."""
+import threading
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.served = 0
+
+    def submit(self, req):
+        with self._lock:
+            self._queue.append(req)
+
+    def pick(self):
+        with self._lock:
+            if self._queue:
+                self._queue.pop()
+                self.served += 1
+
+    def stats(self):
+        with self._lock:
+            return {"served": self.served, "depth": len(self._queue)}
+
+    def reset_stats(self):
+        with self._lock:
+            self.served = 0
+
+    def requeue(self, req):
+        with self._lock:
+            self._queue.append(req)

@@ -1,0 +1,19 @@
+"""GL502 true positive: two locks acquired in both orders (ABBA)."""
+import threading
+
+
+class Mover:
+    def __init__(self):
+        self._src = threading.Lock()
+        self._dst = threading.Lock()
+        self.moved = 0
+
+    def push(self):
+        with self._src:
+            with self._dst:
+                self.moved += 1
+
+    def pull(self):
+        with self._dst:
+            with self._src:  # inverts push's src-then-dst order
+                self.moved -= 1

@@ -1,0 +1,21 @@
+"""GL503 true positive: sleep and a Future fetch inside the guarded
+region -- every contending thread stalls for the call's latency."""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        self.results = []
+
+    def tick(self, fut):
+        with self._lock:
+            self.ticks += 1
+            time.sleep(0.01)
+            self.results.append(fut.result())
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.results)

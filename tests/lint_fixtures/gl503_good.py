@@ -1,0 +1,21 @@
+"""GL503 near miss: block first, take the lock only to record."""
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ticks = 0
+        self.results = []
+
+    def tick(self, fut):
+        time.sleep(0.01)
+        out = fut.result()
+        with self._lock:
+            self.ticks += 1
+            self.results.append(out)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.results)

@@ -1,0 +1,21 @@
+"""GL504 true positive: if-then-wait loses the signal on a spurious
+wakeup or a stolen predicate."""
+import threading
+
+
+class Mailbox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items = []
+
+    def put(self, item):
+        with self._cond:
+            self._items.append(item)
+            self._cond.notify()
+
+    def get(self):
+        with self._cond:
+            if not self._items:
+                self._cond.wait(timeout=1.0)
+            return self._items.pop()

@@ -1,0 +1,24 @@
+"""GL505 true positive: futures resolved while the scheduler lock is
+held -- a done-callback that re-enters the class deadlocks."""
+import threading
+
+
+class Acker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def submit(self, fut):
+        with self._lock:
+            self.pending.append(fut)
+
+    def fail_all(self, exc):
+        with self._lock:
+            while self.pending:
+                fut = self.pending.pop()
+                fut.set_exception(exc)
+
+    def ack(self, fut, value):
+        with self._lock:
+            self.pending.remove(fut)
+            fut.set_result(value)

@@ -1,0 +1,25 @@
+"""GL505 near miss: collect under the lock, resolve after release --
+the drop_request idiom."""
+import threading
+
+
+class Acker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.pending = []
+
+    def submit(self, fut):
+        with self._lock:
+            self.pending.append(fut)
+
+    def fail_all(self, exc):
+        with self._lock:
+            stranded = list(self.pending)
+            self.pending.clear()
+        for fut in stranded:
+            fut.set_exception(exc)
+
+    def ack(self, fut, value):
+        with self._lock:
+            self.pending.remove(fut)
+        fut.set_result(value)

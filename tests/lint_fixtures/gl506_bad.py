@@ -1,0 +1,15 @@
+"""GL506 true positive: the pump thread starts while __init__ is still
+assigning -- the loop can observe a half-built object."""
+import threading
+
+
+class Pump:
+    def __init__(self, sink):
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self.sink = sink
+
+    def _loop(self):
+        while not self._stop:
+            self.sink.put(1)

@@ -1,0 +1,14 @@
+"""GL506 near miss: every attribute is assigned before the start."""
+import threading
+
+
+class Pump:
+    def __init__(self, sink):
+        self._stop = False
+        self.sink = sink
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop:
+            self.sink.put(1)

@@ -1,0 +1,20 @@
+"""GL507 true positive: a daemon thread reaches the durable WAL writer
+through a same-class helper -- interpreter exit tears the log."""
+import threading
+
+
+class Snapshotter:
+    def __init__(self, persist):
+        self.persist = persist
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            self._flush()
+
+    def _flush(self):
+        self.persist.log_tell(0, {}, 0.0)

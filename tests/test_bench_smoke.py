@@ -96,6 +96,13 @@ def test_bench_prints_one_json_line():
     # the committed program_contracts.json
     assert d["ir_programs_checked"] >= 10
     assert d["ir_contract_drift"] == 0
+    # round-16: graftrace concurrency rows -- the GL5xx pack over the
+    # whole package reports zero unbaselined findings, all seven rules
+    # ran, and the lockdep probe caught exactly its one deliberate
+    # inversion (proof the runtime sanitizer is armed and detecting)
+    assert d["trace_findings_total"] == 0
+    assert d["trace_rules_checked"] == 7
+    assert d["lockdep_inversions_observed"] == 1
     # round-10: crash-recovery cost rows -- the per-trial durability
     # overhead is measured (WAL append + amortized bundle publish) and
     # stamped both raw and relative to the fused dispatch time

@@ -49,6 +49,22 @@ def test_package_lints_clean_against_baseline(repo_cwd):
     assert result.n_files > 50  # the whole package, not a subset
 
 
+def test_package_trace_clean_against_baseline(repo_cwd):
+    # the graftrace concurrency gate (hyperopt-tpu-lint --trace): the
+    # whole package must be GL5xx-clean against the committed baseline
+    # -- every deliberate pattern carries an inline reasoned pragma,
+    # and the baseline holds zero grandfathered concurrency findings
+    baseline = load_baseline(BASELINE)
+    t0 = time.perf_counter()
+    result = lint_paths(["hyperopt_tpu"], baseline=baseline, pack="trace")
+    elapsed = time.perf_counter() - t0
+    assert result.clean, "\n" + format_text(result)
+    # fast-tier budget: the concurrency pass must stay cheap noise
+    # inside the 9-minute session pin
+    assert elapsed < 10.0, f"trace lint took {elapsed:.2f}s (budget 10s)"
+    assert result.n_files > 50  # the whole package, not a subset
+
+
 def test_baseline_is_small_and_shrinking(repo_cwd):
     baseline = load_baseline(BASELINE)
     assert sum(baseline.values()) <= MAX_BASELINE_ENTRIES, (

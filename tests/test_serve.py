@@ -29,6 +29,20 @@ from hyperopt_tpu.serve import SuggestService
 from hyperopt_tpu.serve.batched import slot_capacity
 from hyperopt_tpu.serve.scheduler import dense_to_vals
 
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # graftrace's runtime half: every BatchScheduler this suite builds
+    # runs with its lock/condition wrapped by the lockdep sanitizer --
+    # an observed acquisition-order inversion raises at the point of
+    # acquisition, and the teardown assert catches the non-raising
+    # (Condition re-acquire) path
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
 SPACE = {
     "x": hp.uniform("x", -5, 5),
     "lr": hp.loguniform("lr", -5, 0),

@@ -26,6 +26,19 @@ from hyperopt_tpu.serve import SuggestService
 
 pytestmark = pytest.mark.chaos
 
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # the lockdep sanitizer rides every chaos scenario: crash-restart
+    # loops build many schedulers, each instrumented, and any observed
+    # lock-order inversion fails the test at acquisition time
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
 SPACE = {
     "x": hp.uniform("x", -5, 5),
     "lr": hp.loguniform("lr", -5, 0),

@@ -42,6 +42,18 @@ from test_serve import ALGO_KW, N_STARTUP, SPACE, loss_fn, solo_stream
 pytestmark = pytest.mark.chaos
 
 
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # the guard scenarios exercise the watchdog/circuit paths where a
+    # second lock would be easiest to smuggle in -- lockdep watches
+    # every acquisition the whole suite long
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
 def _svc(**kw):
     kw.setdefault("max_batch", 8)
     kw.setdefault("background", False)

@@ -1,0 +1,268 @@
+"""graftrace (GL5xx) fixture corpus + mutation kill-checks + the
+runtime lockdep sanitizer's own contract.
+
+Same discipline as test_lint_rules.py: every rule's true-positive and
+near-miss behavior is pinned by a bad/good fixture pair, with exact
+finding counts for the multi-site fixtures.  The mutation kill-checks
+prove -- with ZERO test execution, pure lint_source -- that the three
+canonical concurrency mutations on a scheduler-shaped class are each
+caught: a deleted ``with self._lock:`` guard (GL501), two swapped
+acquisition sites (GL502), a dispatch moved under the lock (GL503).
+"""
+
+import os
+import textwrap
+import threading
+
+import pytest
+
+from hyperopt_tpu.analysis.engine import lint_source
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+TRACE_RULES = [
+    "GL501", "GL502", "GL503", "GL504", "GL505", "GL506", "GL507",
+]
+
+#: exact finding counts for every bad fixture -- a rule that silently
+#: stops seeing one of the sites regresses here
+EXPECTED_COUNTS = {
+    "GL501": 2, "GL502": 2, "GL503": 2, "GL504": 1,
+    "GL505": 2, "GL506": 1, "GL507": 1,
+}
+
+
+def _lint_file(path):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    findings, _ = lint_source(
+        source, path=os.path.relpath(path), pack="trace"
+    )
+    return findings
+
+
+def _trace(source, path="pkg/mod.py"):
+    findings, _ = lint_source(source, path=path, pack="trace")
+    return findings
+
+
+@pytest.mark.parametrize("rule_id", TRACE_RULES)
+def test_bad_fixture_trips_exactly_its_rule(rule_id):
+    path = os.path.join(FIXTURES, f"{rule_id.lower()}_bad.py")
+    findings = _lint_file(path)
+    assert findings, f"{rule_id}: bad fixture produced no findings"
+    assert {f.rule for f in findings} == {rule_id}, (
+        f"{rule_id}: bad fixture tripped "
+        f"{sorted({f.rule for f in findings})}"
+    )
+    assert len(findings) == EXPECTED_COUNTS[rule_id], (
+        f"{rule_id}: expected {EXPECTED_COUNTS[rule_id]} finding(s), "
+        f"got {[(f.line, f.message) for f in findings]}"
+    )
+
+
+@pytest.mark.parametrize("rule_id", TRACE_RULES)
+def test_good_fixture_is_clean(rule_id):
+    path = os.path.join(FIXTURES, f"{rule_id.lower()}_good.py")
+    findings = _lint_file(path)
+    assert not findings, (
+        f"{rule_id}: near-miss fixture produced "
+        f"{[(f.rule, f.line, f.message) for f in findings]}"
+    )
+
+
+# -- engine satellite: bound-method / partial thread-target resolution ------
+
+
+def test_bound_method_thread_targets_resolve_as_roots():
+    # engine regression (this PR): Thread(target=self._drain) and
+    # Thread(target=functools.partial(self._bump, 2)) must resolve the
+    # BOUND METHOD as an analyzable root scope; without it the entry
+    # fixpoint concludes both always run under the lock (their only
+    # in-class callers hold it) and GL501 stays silent
+    findings = _lint_file(os.path.join(FIXTURES, "engine_thread_bad.py"))
+    assert {f.rule for f in findings} == {"GL501"}
+    assert len(findings) == 2  # _drain's store + _bump's aug-store
+    assert not _lint_file(os.path.join(FIXTURES, "engine_thread_good.py"))
+
+
+def test_pragma_suppresses_trace_findings():
+    src = textwrap.dedent(
+        """\
+        import threading
+        import time
+
+
+        class P:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def tick(self):
+                with self._lock:
+                    self.n += 1
+                    time.sleep(0.01)  # graftlint: disable=GL503 deliberate
+        """
+    )
+    assert _trace(src) == []
+    _, n = lint_source(src, path="pkg/mod.py", pack="trace")
+    assert n == 1  # counted as suppressed, not silently dropped
+
+
+# -- mutation kill-checks ----------------------------------------------------
+# A scheduler-shaped class that lints CLEAN; each mutation below is the
+# exact concurrency bug class the rollout protects against, proven
+# caught statically (lint_source only -- nothing executes).
+
+SCHED = textwrap.dedent(
+    """\
+    import threading
+    from jax import jit
+
+
+    class MiniScheduler:
+        def __init__(self, step_fn):
+            self._lock = threading.Lock()
+            self._gate = threading.Lock()
+            self._step_fn = jit(step_fn)
+            self._asks = []
+            self.dispatch_count = 0
+
+        def submit(self, req):
+            with self._lock:
+                with self._gate:
+                    self._asks.append(req)
+
+        def counters(self):
+            with self._lock:
+                return {"dispatched": self.dispatch_count}
+
+        def step(self):
+            with self._lock:
+                with self._gate:
+                    picked = list(self._asks)
+                    self._asks.clear()
+                self.dispatch_count += 1
+            out = self._step_fn(picked)
+            return out
+    """
+)
+
+
+def test_mutation_base_is_clean():
+    assert _trace(SCHED) == []
+
+
+def test_mutation_deleted_lock_guard_trips_gl501():
+    mutant = SCHED.replace(
+        "    def submit(self, req):\n"
+        "        with self._lock:\n"
+        "            with self._gate:\n"
+        "                self._asks.append(req)",
+        "    def submit(self, req):\n"
+        "        self._asks.append(req)",
+    )
+    assert mutant != SCHED
+    findings = _trace(mutant)
+    assert "GL501" in {f.rule for f in findings}
+    assert any("_asks" in f.message for f in findings)
+
+
+def test_mutation_swapped_acquisition_sites_trips_gl502():
+    mutant = SCHED.replace(
+        "    def step(self):\n"
+        "        with self._lock:\n"
+        "            with self._gate:",
+        "    def step(self):\n"
+        "        with self._gate:\n"
+        "            with self._lock:",
+    )
+    assert mutant != SCHED
+    findings = _trace(mutant)
+    assert "GL502" in {f.rule for f in findings}
+
+
+def test_mutation_dispatch_moved_under_lock_trips_gl503():
+    mutant = SCHED.replace(
+        "            self.dispatch_count += 1\n"
+        "        out = self._step_fn(picked)\n",
+        "            self.dispatch_count += 1\n"
+        "            out = self._step_fn(picked)\n",
+    )
+    assert mutant != SCHED
+    findings = _trace(mutant)
+    assert {f.rule for f in findings} == {"GL503"}
+    assert "jitted dispatch" in findings[0].message
+
+
+# -- the runtime lockdep sanitizer ------------------------------------------
+
+
+def test_lockdep_consistent_order_is_silent():
+    from hyperopt_tpu.analysis.lockdep import LockDep
+
+    dep = LockDep()
+    a = dep.wrap(threading.Lock(), "a")
+    b = dep.wrap(threading.Lock(), "b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert dep.inversions == 0 and not dep.errors
+
+
+def test_lockdep_inversion_raises_and_releases():
+    from hyperopt_tpu.analysis.lockdep import LockDep, LockOrderError
+
+    dep = LockDep()
+    a = dep.wrap(threading.Lock(), "a")
+    b = dep.wrap(threading.Lock(), "b")
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderError):
+        with b:
+            with a:
+                pass
+    assert dep.inversions == 1
+    # the failed acquisition must NOT leak the lock: both reacquire
+    with a:
+        pass
+    with b:
+        pass
+
+
+def test_lockdep_rlock_reentrancy_records_once():
+    from hyperopt_tpu.analysis.lockdep import LockDep
+
+    dep = LockDep()
+    r = dep.wrap(threading.RLock(), "r")
+    with r:
+        with r:  # re-entrant: no self-edge, no double bookkeeping
+            pass
+        assert dep._stack() == ["r"]
+    assert dep._stack() == []
+
+
+def test_lockdep_condition_wait_keeps_stack_exact():
+    from hyperopt_tpu.analysis.lockdep import LockDep
+
+    dep = LockDep()
+    traced = dep.wrap(threading.RLock(), "sched")
+    cond = threading.Condition(traced)
+    done = []
+
+    def waiter():
+        with cond:
+            while not done:
+                cond.wait(timeout=5.0)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cond:
+        done.append(True)
+        cond.notify_all()
+    t.join(timeout=5.0)
+    assert not t.is_alive()
+    assert dep.inversions == 0 and not dep.errors
