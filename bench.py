@@ -16,6 +16,14 @@ import time
 
 import numpy as np
 
+# graftmesh: the mesh-sharded serve/PBT rows (and the mesh-sharded
+# program contracts bench_ir re-traces) run over virtual CPU devices
+# when no real multi-chip mesh is attached -- the flag must be armed
+# before jax initializes its backends, i.e. before any bench work
+from hyperopt_tpu.parallel.mesh import force_host_cpu_devices
+
+force_host_cpu_devices(8)
+
 
 def build_history(n_obs, space, seed=0):
     """A Trials store with n_obs completed synthetic trials."""
@@ -540,6 +548,127 @@ def bench_serve(space, n_studies=64, rounds=6, n_cand=128,
     }
 
 
+def bench_serve_mesh(space, mesh_devices=(1, 2, 4), n_studies=64,
+                     rounds=6, n_cand=128, n_startup_jobs=3):
+    """graftmesh serve rows (round 17): the study-batched fused
+    tell+ask with its slot axis sharded over a ``study`` mesh, per
+    mesh shape.  Keys are ``"study=N"``; values are asks served per
+    second across studies (same protocol as :func:`bench_serve`'s
+    timed window).  On virtual CPU devices the absolute numbers share
+    the host's cores -- the per-shape trajectory is the comparable
+    signal, and real multi-chip hardware fills in the scaling claim
+    via the MULTICHIP dryrun's serve stage.
+
+    Returns ``(rates, efficiency)`` -- ``efficiency["study=N"]`` is
+    ``rate_N / (N * rate_1)``, the near-linear-scaling diagnostic.
+    """
+    import jax
+
+    from hyperopt_tpu.parallel.mesh import study_mesh
+    from hyperopt_tpu.serve import SuggestService
+
+    avail = len(jax.devices())
+
+    def loss(vals):
+        return sum(
+            float(v) for v in vals.values() if isinstance(v, (int, float))
+        )
+
+    rates = {}
+    for n_dev in mesh_devices:
+        if n_dev > avail:
+            continue
+        svc = SuggestService(
+            space, max_batch=max(n_studies, 4), background=False,
+            n_startup_jobs=n_startup_jobs, n_cand=n_cand,
+            mesh=study_mesh(n_dev),
+        )
+        handles = [
+            svc.create_study(f"mesh{n_dev}_{i:03d}", seed=i)
+            for i in range(n_studies)
+        ]
+
+        def round_once():
+            futs = [h.ask_async() for h in handles]
+            svc.pump()
+            for h, f in zip(handles, futs):
+                tid, vals = f.result(timeout=120)
+                h.tell(tid, loss(vals))
+
+        round_once()  # compile + first materialization
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            round_once()
+        dt = time.perf_counter() - t0
+        svc.shutdown()
+        rates[f"study={n_dev}"] = round(n_studies * rounds / dt, 1)
+
+    base = rates.get("study=1")
+    efficiency = {
+        k: round(v / (int(k.split("=")[1]) * base), 4)
+        for k, v in rates.items()
+        if base and k != "study=1"
+    }
+    return rates, efficiency
+
+
+def bench_pbt_mesh(mesh_devices=(1, 2, 4), pop=64, exploit_every=5,
+                   n_rounds=8):
+    """graftmesh PBT rows (round 17): the shard_map population
+    schedule (member blocks training collective-free, all-gathers only
+    at exploit boundaries) at each ``trial`` mesh shape, on the
+    synthetic quadratic member (CPU-sized; the transformer family
+    rides the same ``compile_pbt`` seam on accelerators).  Keys are
+    ``"trial=N"``; values member-steps/s.  Returns
+    ``(rates, efficiency)`` like :func:`bench_serve_mesh`."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.parallel.mesh import mesh_from_spec
+    from hyperopt_tpu.pbt import compile_pbt
+
+    avail = len(jax.devices())
+
+    def train_fn(state, hypers, key):
+        theta = state["theta"] - hypers["lr"] * 2.0 * (
+            state["theta"] - 0.7
+        )
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    init = {"theta": jnp.zeros((pop,), jnp.float32)}
+    rates = {}
+    for n_dev in mesh_devices:
+        if n_dev > avail or pop % n_dev:
+            continue
+        if n_dev == 1:
+            runner = compile_pbt(
+                train_fn, init, {"lr": (1e-3, 1.0)}, pop_size=pop,
+                exploit_every=exploit_every, n_rounds=n_rounds,
+            )
+        else:
+            runner = compile_pbt(
+                train_fn, init, {"lr": (1e-3, 1.0)}, pop_size=pop,
+                exploit_every=exploit_every, n_rounds=n_rounds,
+                mesh=mesh_from_spec((n_dev,), ("trial",)),
+                trial_axis="trial", shard_mode="shard_map",
+            )
+        runner(seed=99)  # compile
+        t0 = time.perf_counter()
+        runner(seed=0)
+        dt = time.perf_counter() - t0
+        rates[f"trial={n_dev}"] = round(
+            pop * exploit_every * n_rounds / dt, 1
+        )
+
+    base = rates.get("trial=1")
+    efficiency = {
+        k: round(v / (int(k.split("=")[1]) * base), 4)
+        for k, v in rates.items()
+        if base and k != "trial=1"
+    }
+    return rates, efficiency
+
+
 def bench_guard(space, n_cand=128):
     """graftguard rows (round 13): the runtime-protection layer's
     three behaviors, measured on small deterministic scenarios.
@@ -1034,6 +1163,23 @@ def main():
     # round-13 graftguard rows: overload shedding, poisoned-tenant
     # quarantine, and watchdog recovery on deterministic scenarios
     guard_rows = bench_guard(space, n_cand=n_cand)
+    # round-17 graftmesh rows: the study-sharded serve engine and the
+    # shard_map PBT schedule per mesh shape (virtual CPU devices here;
+    # the MULTICHIP dryrun runs the same programs on real meshes)
+    mesh_devices = tuple(
+        int(s) for s in os.environ.get(
+            "BENCH_MESH_DEVICES", "1,2,4"
+        ).split(",") if s.strip()
+    )
+    serve_mesh_rates, serve_mesh_eff = bench_serve_mesh(
+        space, mesh_devices=mesh_devices,
+        n_studies=int(os.environ.get("BENCH_SERVE_STUDIES", "64")),
+        rounds=int(os.environ.get("BENCH_SERVE_ROUNDS", "6")),
+        n_cand=n_cand,
+    )
+    pbt_mesh_rates, pbt_mesh_eff = bench_pbt_mesh(
+        mesh_devices=mesh_devices
+    )
     # round-14: the device-loop family is stamped on EVERY backend --
     # CPU rounds get CPU-sized configs, keyed by backend in the JSON so
     # the per-backend trajectory stays comparable (the old CPU skip
@@ -1121,6 +1267,16 @@ def main():
                 # protection -- shed rate, quarantine trips, watchdog
                 # recovery latency
                 **guard_rows,
+                # round-17 graftmesh rows: per-mesh-shape throughput
+                # of the study-sharded serve engine and the shard_map
+                # PBT schedule, plus the near-linear-scaling
+                # diagnostic rate_N / (N * rate_1) per family
+                "serve_studies_per_sec_mesh": serve_mesh_rates,
+                "pbt_member_steps_per_sec_mesh": pbt_mesh_rates,
+                "mesh_scaling_efficiency": {
+                    "serve": serve_mesh_eff,
+                    "pbt": pbt_mesh_eff,
+                },
                 "device_loop_trials_per_sec": (
                     round(loop_rate, 1) if loop_rate else None
                 ),

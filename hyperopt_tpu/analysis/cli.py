@@ -109,6 +109,14 @@ def _build_parser():
 
 
 def _main_ir(args):
+    # the graftmesh program contracts trace over a forced multi-device
+    # virtual CPU mesh; arm the flag BEFORE anything imports jax (this
+    # module and the engine are stdlib-only by design, so a fresh CLI
+    # process reaches here with jax uninitialized)
+    from ..parallel.mesh import REGISTRY_MESH_DEVICES, force_host_cpu_devices
+
+    force_host_cpu_devices(max(8, REGISTRY_MESH_DEVICES))
+
     from . import ir as ir_mod
 
     contracts = args.contracts

@@ -78,7 +78,13 @@ _CALLBACK_PRIMS = frozenset({
 _TRANSFER_PRIMS = frozenset({"device_put"})
 _WIDE_DTYPES = frozenset({"float64", "complex128"})
 
-_ALIASING_RE = re.compile(r"%arg(\d+):[^,)]*?\btf\.aliasing_output\b")
+_ARG_RE = re.compile(r"%arg(\d+):")
+#: the donation markers jit lowering stamps on main-function arguments:
+#: single-device programs alias input to output directly
+#: (``tf.aliasing_output``); multi-device (shard_map/GSPMD) programs
+#: defer the aliasing decision to XLA and mark the argument a
+#: ``jax.buffer_donor`` instead -- both ARE the honored donation
+_DONOR_ATTRS = ("tf.aliasing_output", "jax.buffer_donor")
 
 
 @dataclasses.dataclass
@@ -142,14 +148,24 @@ def _aval_str(aval):
 
 
 def _donated_argnums(lowered_text):
-    """Input positions the lowered module aliases to outputs -- the
-    donations XLA actually received (``tf.aliasing_output`` on the main
-    function's arguments)."""
+    """Input positions the lowered module donates -- the donations XLA
+    actually received (``tf.aliasing_output`` or, on multi-device
+    programs, ``jax.buffer_donor`` on the main function's arguments).
+    Per-argument attribute dicts may embed commas inside quoted
+    sharding strings, so the signature is split on ``%argN:`` markers
+    rather than matched with one regex."""
     main = lowered_text
     m = re.search(r"func\.func public @main\((.*?)\)\s*->", main, re.S)
     if m:
         main = m.group(1)
-    return tuple(sorted(int(i) for i in _ALIASING_RE.findall(main)))
+    marks = list(_ARG_RE.finditer(main))
+    out = []
+    for i, mk in enumerate(marks):
+        end = marks[i + 1].start() if i + 1 < len(marks) else len(main)
+        chunk = main[mk.end(): end]
+        if any(attr in chunk for attr in _DONOR_ATTRS):
+            out.append(int(mk.group(1)))
+    return tuple(sorted(out))
 
 
 @contextlib.contextmanager

@@ -540,6 +540,7 @@ def compile_sha(
     mesh=None,
     trial_axis="trial",
     replicas=1,
+    shard_mode=None,
 ):
     """Successive halving over TRAINING, on-device.
 
@@ -566,7 +567,14 @@ def compile_sha(
 
     ``n_configs`` must be a power of ``eta`` (every rung's population
     stays mesh-divisible); ``n_rungs`` defaults to halving down to one
-    survivor per bracket.  Returns ``runner(seed=0, checkpoint=None) ->
+    survivor per bracket.  ``shard_mode="shard_map"`` (graftmesh)
+    shards every rung's member axis with ``shard_map`` over a per-rung
+    sub-mesh of ``gcd(members, mesh size)`` devices: member blocks
+    train collective-free and the only mesh-wide work is ONE loss
+    all_gather per rung boundary (the replicated ranking) -- late tiny
+    rungs shrink their sub-mesh instead of breaking divisibility, and
+    the ladder is bitwise the unsharded one (same contract as
+    :func:`hyperopt_tpu.pbt.compile_pbt`'s shard_map mode).  Returns ``runner(seed=0, checkpoint=None) ->
     {"best_loss", "best_hypers", "rungs": [{"n", "steps",
     "best_loss"}...], "state", "replica_bests"}`` (``best_*`` is the
     best across brackets; ``n`` counts ONE bracket's rung population).
@@ -646,11 +654,19 @@ def compile_sha(
     else:
         _validate_leading(init_state)
     names, log_lo, log_hi = _log_bounds(hyper_bounds)
-    constrain = _make_constrain(mesh, trial_axis)
+    from .pbt import _resolve_shard_mode
+
+    mode = _resolve_shard_mode(shard_mode, mesh)
+    # shard_map lays the member axis out itself; GSPMD constraints
+    # inside its per-shard bodies would be wrong
+    constrain = _make_constrain(
+        mesh if mode == "constraint" else None, trial_axis
+    )
 
     @jax.jit
     def init_hypers(key):
-        u = jax.random.uniform(key, (R * P0, len(names)))
+        u = jax.random.uniform(key, (R * P0, len(names)),
+                               dtype=jnp.float32)
         return log_lo + u * (log_hi - log_lo)
 
     # one jitted program per rung, built ONCE (the schedule is static);
@@ -667,7 +683,7 @@ def compile_sha(
 
             state, losses_seq = jax.lax.scan(step, state, keys)
             losses = losses_seq[-1]  # [R * p_live]
-            if mesh is not None:
+            if mode == "constraint":
                 # replicate the bookkeeping outputs: with the population
                 # sharded over a multi-PROCESS mesh, trial-sharded
                 # losses/order would not be host-addressable and the
@@ -685,7 +701,7 @@ def compile_sha(
             order = order + (
                 jnp.arange(R, dtype=order.dtype)[:, None] * p_live
             )
-            if mesh is not None:
+            if mode == "constraint":
                 from jax.sharding import NamedSharding, PartitionSpec
 
                 order = jax.lax.with_sharding_constraint(
@@ -695,10 +711,74 @@ def compile_sha(
 
         return jax.jit(rung)
 
+    def make_rung_sharded(n_steps, p_live):
+        """The graftmesh rung (shard_map over a per-rung sub-mesh):
+        each device trains its member block collective-free; the rung
+        boundary pays ONE loss all_gather and the ranking runs
+        replicated (bitwise :func:`make_rung`'s, per member).
+        Returns ``(jitted_fn, member_sharding)`` -- the runner places
+        rung inputs with the sharding before each call, since sub-mesh
+        device sets shrink with the rung population."""
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as Pspec
+
+        from .parallel.sharded import _shard_map
+
+        m = R * p_live
+        n_dev_total = int(mesh.shape[trial_axis])
+        k = math.gcd(m, n_dev_total)
+        sub = Mesh(
+            np.asarray(list(mesh.devices.flat)[:k]), (trial_axis,)
+        )
+        p_loc = m // k
+
+        def body(state, log_h, key):
+            lo = jax.lax.axis_index(trial_axis) * p_loc
+            # exp over the FULL replicated table, block sliced after
+            # (CPU libm vectorizes transcendentals differently at
+            # narrow widths -- exp-then-slice keeps hypers bitwise)
+            hyp = {
+                n: jax.lax.dynamic_slice_in_dim(v, lo, p_loc)
+                for n, v in _hypers_dict(log_h, names).items()
+            }
+            keys = jax.random.split(key, n_steps)
+
+            def step(state, kk):
+                state, losses = train_fn(state, hyp, kk)
+                return state, losses
+
+            state, losses_seq = jax.lax.scan(step, state, keys)
+            losses = jax.lax.all_gather(
+                losses_seq[-1], trial_axis, tiled=True
+            )
+            keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
+            by_rep = keyed.reshape(R, p_live)
+            order = jnp.argsort(by_rep, axis=1)
+            order = order + (
+                jnp.arange(R, dtype=order.dtype)[:, None] * p_live
+            )
+            return state, losses, order
+
+        fn = jax.jit(_shard_map()(
+            body, mesh=sub,
+            in_specs=(Pspec(trial_axis), Pspec(), Pspec()),
+            out_specs=(Pspec(trial_axis), Pspec(), Pspec()),
+            check_vma=False,
+        ))
+        return fn, NamedSharding(sub, Pspec(trial_axis))
+
     rung_fns = []
+    rung_shardings = []  # shard_map mode: per-rung member placement
     p = P0
     for r in range(n_rungs):
-        rung_fns.append(make_rung(int(steps_per_rung) * eta**r, p))
+        n_steps_r = int(steps_per_rung) * eta**r
+        if mode == "shard_map":
+            fn, sharding = make_rung_sharded(n_steps_r, p)
+            rung_fns.append(fn)
+            rung_shardings.append(sharding)
+        else:
+            rung_fns.append(make_rung(n_steps_r, p))
+            rung_shardings.append(None)
         if r < n_rungs - 1:
             p //= eta
 
@@ -808,7 +888,20 @@ def compile_sha(
         n_live = P0 // eta ** min(start, n_rungs - 1)
         per_rung_dev = []  # device arrays; fetched ONCE after the last rung
         for r in range(start, n_rungs):
-            state, losses, order = rung_fns[r](state, log_h, rung_keys[r])
+            key_r = rung_keys[r]
+            if rung_shardings[r] is not None:
+                # graftmesh: sub-mesh device sets shrink with the rung
+                # population, so each rung's inputs are explicitly
+                # placed (members sharded, bookkeeping replicated) --
+                # device-to-device moves, no host round-trip
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as Pspec
+
+                repl = NamedSharding(rung_shardings[r].mesh, Pspec())
+                state = jax.device_put(state, rung_shardings[r])
+                log_h = jax.device_put(log_h, repl)
+                key_r = jax.device_put(key_r, repl)
+            state, losses, order = rung_fns[r](state, log_h, key_r)
             if r < n_rungs - 1:
                 keep = order[:, : n_live // eta].reshape(-1)
                 state = jax.tree.map(lambda x: x[keep], state)
@@ -868,6 +961,10 @@ def compile_sha(
             "replica_bests": [float(b) for b in rep_bests],
         }
 
+    # the graftir seam: per-rung jitted programs + their placements
+    runner._rung_fns = rung_fns
+    runner._rung_shardings = rung_shardings
+    runner._shard_mode = mode
     return runner
 
 
@@ -1367,3 +1464,58 @@ def asha(
         ],
         "trials": trials,
     }
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "hyperband.sha_rung_mesh",
+    families=("hyperopt_tpu.hyperband:compile_sha",),
+)
+def _registry_sha_rung_mesh(p):
+    """The graftmesh device-ASHA rung: member blocks training
+    collective-free under shard_map with ONE loss all_gather at the
+    rung boundary, traced over the forced 4-virtual-CPU-device trial
+    mesh (rung 0 of an 8-config ladder; later rungs shrink their
+    sub-mesh but share the body's family)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .parallel.mesh import TRIAL_AXIS, registry_cpu_mesh
+
+    mesh = registry_cpu_mesh(axis=TRIAL_AXIS)
+    n_cfg = 8
+
+    def train_fn(state, hypers, key):
+        theta = state["theta"] - hypers["lr"] * 2.0 * (
+            state["theta"] - 0.7
+        )
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    runner = compile_sha(
+        train_fn, {"theta": jnp.zeros((n_cfg,), jnp.float32)},
+        {"lr": (1e-3, 1.0)}, n_configs=n_cfg, eta=2, steps_per_rung=2,
+        mesh=mesh, trial_axis=TRIAL_AXIS, shard_mode="shard_map",
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    sharding = runner._rung_shardings[0]
+    repl = NamedSharding(sharding.mesh, Pspec())
+    key_aval = jax.eval_shape(lambda: jax.random.key(0))
+    return ProgramCapture(
+        fn=runner._rung_fns[0],
+        args=(
+            {"theta": jax.ShapeDtypeStruct(
+                (n_cfg,), jnp.float32, sharding=sharding
+            )},
+            jax.ShapeDtypeStruct((n_cfg, 1), jnp.float32, sharding=repl),
+            jax.ShapeDtypeStruct(
+                key_aval.shape, key_aval.dtype, sharding=repl
+            ),
+        ),
+    )

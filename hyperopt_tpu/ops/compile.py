@@ -471,6 +471,8 @@ _PROGRAM_MODULES = (
     "hyperopt_tpu.parallel.sharded",
     "hyperopt_tpu.ops.pallas_kernels",
     "hyperopt_tpu.serve.batched",
+    "hyperopt_tpu.pbt",
+    "hyperopt_tpu.hyperband",
 )
 
 

@@ -4,16 +4,41 @@ One place decides how devices are arranged; everything else takes a Mesh.
 Axis conventions:
   ``cand``  -- candidate-batch sharding (the throughput axis; rides ICI)
   ``trial`` -- trial-batch sharding for population evaluation (data-ish)
+  ``study`` -- study-slot sharding for the serve engine (graftmesh):
+              the stacked :class:`~hyperopt_tpu.serve.batched.
+              StudyBatchState` splits its slot axis over this axis, so
+              slot capacity multiplies with device count
 """
 
 from __future__ import annotations
 
+import os
+import sys
+
 import numpy as np
 
-__all__ = ["default_mesh", "device_count", "mesh_from_spec", "CAND_AXIS", "TRIAL_AXIS"]
+__all__ = [
+    "default_mesh",
+    "device_count",
+    "force_host_cpu_devices",
+    "mesh_from_spec",
+    "registry_cpu_mesh",
+    "study_mesh",
+    "subprocess_env_with_devices",
+    "CAND_AXIS",
+    "STUDY_AXIS",
+    "TRIAL_AXIS",
+]
 
 CAND_AXIS = "cand"
 TRIAL_AXIS = "trial"
+STUDY_AXIS = "study"
+
+#: study-axis width the graftir mesh-sharded program contracts are
+#: pinned at (and the device count every repo entry point -- conftest,
+#: bench, the lint CLI, the multichip dryrun -- forces on the virtual
+#: CPU platform, so the contracts trace identically everywhere)
+REGISTRY_MESH_DEVICES = 4
 
 
 def device_count():
@@ -46,3 +71,101 @@ def mesh_from_spec(shape, axis_names, devices=None):
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     arr = np.asarray(devices[:n]).reshape(shape)
     return Mesh(arr, tuple(axis_names))
+
+
+def study_mesh(n_devices=None, devices=None, axis=STUDY_AXIS):
+    """1-D ``study`` mesh over the first ``n_devices`` devices -- the
+    serve engine's slot-axis mesh (graftmesh).  ``n_devices=None``
+    takes every visible device (the pod-scale default)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        n = int(n_devices)
+        if n > len(devices):
+            raise ValueError(
+                f"study_mesh needs {n} devices, have {len(devices)}"
+            )
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def registry_cpu_mesh(n_devices=REGISTRY_MESH_DEVICES, axis=STUDY_AXIS):
+    """The forced multi-device CPU mesh the graftir mesh-sharded
+    program contracts are pinned over.
+
+    Every repo entry point that traces the registry (tests/conftest.py,
+    ``hyperopt-tpu-lint --ir``, bench.py, the multichip dryrun) forces
+    at least :data:`REGISTRY_MESH_DEVICES` virtual CPU devices via
+    :func:`force_host_cpu_devices` BEFORE jax initializes; a process
+    that skipped that step gets a loud error here, never a silently
+    drifted single-device contract."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.local_devices(backend="cpu")
+    if len(devices) < int(n_devices):
+        raise RuntimeError(
+            f"graftir's mesh-sharded contracts trace over "
+            f"{int(n_devices)} virtual CPU devices but this process has "
+            f"{len(devices)}; call hyperopt_tpu.parallel.mesh."
+            "force_host_cpu_devices() before jax initializes (set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{int(n_devices)})"
+        )
+    return Mesh(np.asarray(devices[: int(n_devices)]), (axis,))
+
+
+def force_host_cpu_devices(n=8):
+    """Force >= ``n`` virtual CPU devices, BEFORE jax backend init.
+
+    The shared harness behind every multi-device CPU entry point (the
+    test fixture, the lint CLI's ``--ir`` path, bench.py): mutates
+    ``XLA_FLAGS`` with ``--xla_force_host_platform_device_count=n`` so
+    mesh parity tests and the mesh-sharded contract traces run without
+    real multi-chip hardware.  A no-op once jax's backends are live --
+    callers that may run late check the returned effective count."""
+    if "jax" in sys.modules:
+        # a LIVE backend latches the flag; probe without creating one
+        # (jax.devices() would itself initialize under current flags)
+        initialized = False
+        try:
+            from jax._src import xla_bridge as xb
+
+            initialized = bool(xb._backends)
+        except Exception:
+            initialized = False
+        if initialized:
+            import jax
+
+            try:
+                return len(jax.local_devices(backend="cpu"))
+            except RuntimeError:
+                return 0
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={int(n)}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    return int(n)
+
+
+def subprocess_env_with_devices(n, env=None):
+    """An environment dict for a subprocess pinned to the virtual CPU
+    platform with exactly ``n`` devices -- the subprocess half of the
+    multi-device harness (tests spawn parity checks under device
+    counts the parent process does not run at)."""
+    env = dict(os.environ if env is None else env)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={int(n)}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

@@ -75,6 +75,58 @@ def _make_constrain(mesh, trial_axis):
     return constrain
 
 
+def _resolve_shard_mode(shard_mode, mesh):
+    """The population-sharding regime: ``None`` keeps the historical
+    default (GSPMD sharding constraints when a mesh is given),
+    ``"shard_map"`` selects the graftmesh collective-explicit path --
+    per-shard member blocks train with ZERO collectives and the only
+    mesh-wide communication is the loss/state all_gather at exploit
+    (or rung) boundaries."""
+    if shard_mode is None:
+        return "constraint" if mesh is not None else None
+    mode = str(shard_mode)
+    if mode not in ("constraint", "shard_map"):
+        raise ValueError(
+            f"shard_mode={shard_mode!r}; expected 'constraint' or "
+            "'shard_map'"
+        )
+    if mesh is None:
+        raise ValueError(f"shard_mode={mode!r} requires mesh=")
+    return mode
+
+
+def _check_divisible(pop, mesh, trial_axis, what):
+    n_dev = int(mesh.shape[trial_axis])
+    if pop % n_dev:
+        raise ValueError(
+            f"{what}={pop} must divide by the {trial_axis!r} mesh axis "
+            f"size {n_dev} for shard_map population sharding"
+        )
+    return n_dev
+
+
+def _place_population(state, mesh, trial_axis):
+    """DCN-aware population placement for the shard_map path.
+
+    Single-process: commit the leaves sharded over the trial axis so
+    the jitted schedule never reshards them.  Multi-process (a
+    ``jax.distributed`` mesh spanning hosts): a host-committed array
+    cannot feed a global-mesh computation, so leaves pass through as
+    host arrays and jit itself places them over the global mesh --
+    the :func:`hyperopt_tpu.parallel.sharded._history_inputs`
+    placement contract, population-shaped."""
+    import jax
+
+    if jax.process_count() > 1:
+        import numpy as np_
+
+        return jax.tree.map(np_.asarray, state)
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    sharding = NamedSharding(mesh, Pspec(trial_axis))
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), state)
+
+
 def compile_pbt(
     train_fn,
     init_state,
@@ -86,6 +138,7 @@ def compile_pbt(
     perturb_factors=(0.8, 1.25),
     mesh=None,
     trial_axis="trial",
+    shard_mode=None,
 ):
     """Compile a PBT schedule into one reusable device program.
 
@@ -106,6 +159,17 @@ def compile_pbt(
       perturb_factors: multiplicative explore range (log-uniform within).
       mesh / trial_axis: optional population sharding, as in
         :func:`hyperopt_tpu.device_loop.compile_fmin`.
+      shard_mode: ``"constraint"`` (the default with a mesh: GSPMD
+        sharding constraints) or ``"shard_map"`` (graftmesh): the
+        population splits into per-device member blocks that train
+        with ZERO collectives -- the only mesh-wide communication is
+        ONE loss all_gather plus ONE member-state all_gather per
+        exploit boundary, so populations of thousands scale with chip
+        count.  Requires ``pop_size`` divisible by the mesh size; the
+        schedule is bitwise the unsharded one for any ``train_fn``
+        whose per-member math does not depend on its position in the
+        batch (the vmapped-contract norm).  ``train_fn`` receives its
+        shard's member block (``P / n_devices`` leading axis).
 
     Returns ``runner(seed=0, init=None) -> dict`` with ``best_loss``,
     ``best_hypers`` ({name: float} of the best final member),
@@ -130,7 +194,15 @@ def compile_pbt(
         )
     log_pf = (float(np.log(perturb_factors[0])),
               float(np.log(perturb_factors[1])))
-    constrain = _make_constrain(mesh, trial_axis)
+    mode = _resolve_shard_mode(shard_mode, mesh)
+    if mode == "shard_map":
+        n_dev = _check_divisible(P, mesh, trial_axis, "pop_size")
+        p_local = P // n_dev
+    # the shard_map path lays the population out itself; GSPMD
+    # constraints inside its per-shard body would be wrong
+    constrain = _make_constrain(
+        mesh if mode == "constraint" else None, trial_axis
+    )
 
     def hypers_dict(log_h):
         return _hypers_dict(log_h, names)
@@ -161,7 +233,55 @@ def compile_pbt(
 
         factors = jax.random.uniform(
             k_perturb, (n_replace, log_h.shape[1]),
-            minval=log_pf[0], maxval=log_pf[1],
+            minval=log_pf[0], maxval=log_pf[1], dtype=jnp.float32,
+        )
+        new_rows = jnp.clip(log_h[top] + factors, log_lo, log_hi)
+        log_h = log_h.at[bottom].set(new_rows)
+        return (state, log_h), losses
+
+    def train_rounds_sharded(carry, key):
+        """The graftmesh round body, run INSIDE shard_map: this shard's
+        member block trains ``exploit_every`` steps collective-free
+        (``log_h`` is replicated -- the block slices its hyper rows by
+        axis index), then the exploit boundary pays the run's ONLY
+        collectives: one loss all_gather for the replicated ranking,
+        one member-state all_gather for the bottom-quantile copy.
+        Per-member math is bitwise :func:`train_rounds`'s."""
+        state, log_h = carry
+        k_steps, k_perturb = jax.random.split(key)
+        lo = jax.lax.axis_index(trial_axis) * p_local
+        # exp over the FULL replicated table, block sliced after: the
+        # unsharded path exponentiates at width P, and CPU libm
+        # vectorizes transcendentals differently at narrow widths --
+        # exp-then-slice keeps every member's hypers bitwise
+        blk_hypers = {
+            n: jax.lax.dynamic_slice_in_dim(v, lo, p_local)
+            for n, v in hypers_dict(log_h).items()
+        }
+
+        def step(state, k):
+            state, losses = train_fn(state, blk_hypers, k)
+            return state, losses
+
+        state, losses_seq = jax.lax.scan(
+            step, state, jax.random.split(k_steps, exploit_every)
+        )
+        losses = jax.lax.all_gather(
+            losses_seq[-1], trial_axis, tiled=True
+        )
+        order = jnp.argsort(losses)  # replicated: identical everywhere
+        top = order[:n_replace]
+        bottom = order[P - n_replace:]
+        src = jnp.arange(P).at[bottom].set(top)
+        full = jax.tree.map(
+            lambda x: jax.lax.all_gather(x, trial_axis, tiled=True),
+            state,
+        )
+        src_blk = jax.lax.dynamic_slice_in_dim(src, lo, p_local)
+        state = jax.tree.map(lambda x: x[src_blk], full)
+        factors = jax.random.uniform(
+            k_perturb, (n_replace, log_h.shape[1]),
+            minval=log_pf[0], maxval=log_pf[1], dtype=jnp.float32,
         )
         new_rows = jnp.clip(log_h[top] + factors, log_lo, log_hi)
         log_h = log_h.at[bottom].set(new_rows)
@@ -179,7 +299,7 @@ def compile_pbt(
     def run(seed_arr):
         base = jax.random.key(seed_arr)
         k_init, k_rounds = jax.random.split(base)
-        u = jax.random.uniform(k_init, (P, len(names)))
+        u = jax.random.uniform(k_init, (P, len(names)), dtype=jnp.float32)
         log_h0 = log_lo + u * (log_hi - log_lo)  # log-uniform start
         (state, log_h), loss_hist = jax.lax.scan(
             train_rounds,
@@ -202,6 +322,46 @@ def compile_pbt(
             jax.random.split(k_rounds, n_rounds),
         )
         return _finish(state, log_h, loss_hist)
+
+    if mode == "shard_map":
+        from jax.sharding import PartitionSpec as Pspec
+
+        from .parallel.sharded import _shard_map
+
+        def _schedule(state0, log_h0, round_keys):
+            (state, log_h), loss_hist = jax.lax.scan(
+                train_rounds_sharded, (state0, log_h0), round_keys
+            )
+            return state, log_h, loss_hist
+
+        sharded_schedule = _shard_map()(
+            _schedule, mesh=mesh,
+            in_specs=(Pspec(trial_axis), Pspec(), Pspec()),
+            out_specs=(Pspec(trial_axis), Pspec(), Pspec()),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def run_sharded(seed_arr, state0):
+            base = jax.random.key(seed_arr)
+            k_init, k_rounds = jax.random.split(base)
+            u = jax.random.uniform(
+                k_init, (P, len(names)), dtype=jnp.float32
+            )
+            log_h0 = log_lo + u * (log_hi - log_lo)
+            state, log_h, loss_hist = sharded_schedule(
+                state0, log_h0, jax.random.split(k_rounds, n_rounds)
+            )
+            return _finish(state, log_h, loss_hist)
+
+        @jax.jit
+        def run_resume_sharded(seed_arr, state0, log_h0):
+            base = jax.random.fold_in(jax.random.key(seed_arr), 1)
+            _, k_rounds = jax.random.split(base)
+            state, log_h, loss_hist = sharded_schedule(
+                state0, log_h0, jax.random.split(k_rounds, n_rounds)
+            )
+            return _finish(state, log_h, loss_hist)
 
     def runner(seed=0, init=None):
         """``init=prev_out`` resumes: the population state AND hypers of
@@ -228,11 +388,26 @@ def compile_pbt(
                 [jnp.asarray(init["hypers"][n], jnp.float32) for n in names],
                 axis=1,
             ))
-            state, log_h, loss_hist, best_i = run_resume(
-                np.uint32(int(seed) % 2**32), init["state"], log_h0
-            )
+            if mode == "shard_map":
+                state, log_h, loss_hist, best_i = run_resume_sharded(
+                    np.uint32(int(seed) % 2**32),
+                    _place_population(init["state"], mesh, trial_axis),
+                    log_h0,
+                )
+            else:
+                state, log_h, loss_hist, best_i = run_resume(
+                    np.uint32(int(seed) % 2**32), init["state"], log_h0
+                )
             return _package(state, log_h, loss_hist, best_i)
-        state, log_h, loss_hist, best_i = run(np.uint32(int(seed) % 2**32))
+        if mode == "shard_map":
+            state, log_h, loss_hist, best_i = run_sharded(
+                np.uint32(int(seed) % 2**32),
+                _place_population(init_state, mesh, trial_axis),
+            )
+        else:
+            state, log_h, loss_hist, best_i = run(
+                np.uint32(int(seed) % 2**32)
+            )
         return _package(state, log_h, loss_hist, best_i)
 
     def _package(state, log_h, loss_hist, best_i):
@@ -253,4 +428,57 @@ def compile_pbt(
             "n_steps": int(n_rounds * exploit_every),
         }
 
+    # the graftir seam (like device_loop's runner._compiled_run): the
+    # jitted schedule itself, traceable over abstract inputs
+    runner._compiled_run = run_sharded if mode == "shard_map" else run
+    runner._shard_mode = mode
     return runner
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+from .ops.compile import ProgramCapture, register_program  # noqa: E402
+
+
+@register_program(
+    "pbt.sharded_schedule",
+    families=("hyperopt_tpu.pbt:compile_pbt",),
+)
+def _registry_pbt_sharded(p):
+    """The graftmesh PBT schedule: per-shard member blocks training
+    collective-free with the loss/state all_gathers only at exploit
+    boundaries, traced over the forced 4-virtual-CPU-device trial
+    mesh (whole schedule = one program, no donation)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .parallel.mesh import TRIAL_AXIS, registry_cpu_mesh
+
+    mesh = registry_cpu_mesh(axis=TRIAL_AXIS)
+    pop = 8
+
+    def train_fn(state, hypers, key):
+        theta = state["theta"] - hypers["lr"] * 2.0 * (
+            state["theta"] - 0.7
+        )
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    runner = compile_pbt(
+        train_fn, {"theta": jnp.zeros((pop,), jnp.float32)},
+        {"lr": (1e-3, 1.0)}, pop_size=pop, exploit_every=2, n_rounds=3,
+        mesh=mesh, trial_axis=TRIAL_AXIS, shard_mode="shard_map",
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    sharding = NamedSharding(mesh, Pspec(TRIAL_AXIS))
+    return ProgramCapture(
+        fn=runner._compiled_run,
+        args=(
+            jax.ShapeDtypeStruct((), np.uint32),
+            {"theta": jax.ShapeDtypeStruct(
+                (pop,), jnp.float32, sharding=sharding
+            )},
+        ),
+    )

@@ -24,6 +24,18 @@ width (the max of the per-study pow2 buckets) and one pow2 SLOT
 capacity, so the program family retraces only on bucket/capacity
 growth -- studies joining and leaving a slotted batch reuse the same
 trace, exactly like history growth in the solo path.
+
+graftmesh (PR 12): every builder takes ``mesh=`` -- a 1-D ``study``
+mesh (:func:`hyperopt_tpu.parallel.mesh.study_mesh`) over which the
+slot axis shards with ``shard_map``.  The per-shard body IS the same
+vmapped closure run over that shard's slot block, so a 1-device mesh
+is bitwise the unsharded engine and an n-device mesh multiplies slot
+capacity by n with zero cross-shard collectives (slots never interact;
+the only mesh-wide work is the input scatter/output gather at the jit
+boundary).  Slot capacities round up to a multiple of the study-axis
+size (:func:`slot_capacity` ``shards=``) so the stacked state always
+shards evenly -- dead pad slots hide behind the active mask like any
+freed slot.
 """
 
 from __future__ import annotations
@@ -38,6 +50,7 @@ __all__ = [
     "build_batched_delta_fn",
     "build_finite_check_fn",
     "stack_states",
+    "restack_shards",
     "slot_capacity",
     "MIN_SLOTS",
 ]
@@ -65,36 +78,28 @@ class StudyBatchState(NamedTuple):
     valid: object   # [S, cap] slot occupancy (per-study prefix mask)
 
 
-def slot_capacity(n_studies, max_batch):
-    """The pow2 slot capacity a batch of ``n_studies`` runs at:
+def slot_capacity(n_studies, max_batch, shards=1):
+    """The slot capacity a batch of ``n_studies`` runs at: pow2
     doubling from :data:`MIN_SLOTS`, clamped to ``max_batch`` (the
-    scheduler's configured ceiling)."""
+    scheduler's configured ceiling), then rounded UP to a multiple of
+    ``shards`` (the mesh study-axis size) so the stacked state always
+    shards evenly -- the rounding pads dead slots behind the active
+    mask, it never truncates live ones."""
     cap = MIN_SLOTS
     while cap < n_studies and cap < max_batch:
         cap <<= 1
-    return min(cap, max_batch)
+    cap = min(cap, max_batch)
+    m = max(1, int(shards))
+    return -(-cap // m) * m
 
 
-def stack_states(buffers, slot_cap, bucket):
-    """Stack per-study host buffers into a device StudyBatchState.
-
-    ``buffers`` maps slot index -> ObsBuffer (missing slots are zero
-    history -- freed or never-joined, masked out by the scheduler).
-    One ``device_put`` of the stacked arrays; the upload that happens
-    on joins, bucket growth, and out-of-order re-materializations (the
-    log schedule of the solo resident mirror, batch-wide).
-    Returns ``(state, nbytes)``.
-    """
-    import jax
-
-    d = None
-    for buf in buffers.values():
-        d = buf.space.n_dims
-        break
-    if d is None:
-        raise ValueError("stack_states needs at least one study buffer")
+def _host_stack(buffers, slot_cap, bucket, n_dims):
+    """The four stacked host arrays for ``slot_cap`` slots (relative
+    slot indices) at ``bucket`` width -- shared by the full
+    materialization and the per-shard block rebuild."""
     s = int(slot_cap)
     b = int(bucket)
+    d = int(n_dims)
     values = np.zeros((s, d, b), dtype=np.float32)
     active = np.zeros((s, d, b), dtype=bool)
     losses = np.zeros((s, b), dtype=np.float32)
@@ -107,9 +112,86 @@ def stack_states(buffers, slot_cap, bucket):
         active[i, :, :w] = buf.active[:, :w]
         losses[i, :w] = buf.losses[:w]
         valid[i, :w] = buf.valid[:w]
-    arrays = (values, active, losses, valid)
+    return values, active, losses, valid
+
+
+def _study_sharding(mesh, axis):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P(axis))
+
+
+def stack_states(buffers, slot_cap, bucket, mesh=None, axis=None):
+    """Stack per-study host buffers into a device StudyBatchState.
+
+    ``buffers`` maps slot index -> ObsBuffer (missing slots are zero
+    history -- freed or never-joined, masked out by the scheduler).
+    One ``device_put`` of the stacked arrays; the upload that happens
+    on joins, bucket growth, and out-of-order re-materializations (the
+    log schedule of the solo resident mirror, batch-wide).  With
+    ``mesh=`` the arrays are placed sharded over the study axis, so
+    the batched step's ``shard_map`` never reshards its state.
+    Returns ``(state, nbytes)``.
+    """
+    import jax
+
+    d = None
+    for buf in buffers.values():
+        d = buf.space.n_dims
+        break
+    if d is None:
+        raise ValueError("stack_states needs at least one study buffer")
+    arrays = _host_stack(buffers, slot_cap, bucket, d)
     nbytes = sum(a.nbytes for a in arrays)
-    return StudyBatchState(*(jax.device_put(a) for a in arrays)), nbytes
+    if mesh is None:
+        return StudyBatchState(*(jax.device_put(a) for a in arrays)), nbytes
+    from ..parallel.mesh import STUDY_AXIS
+
+    sharding = _study_sharding(mesh, axis or STUDY_AXIS)
+    return StudyBatchState(
+        *(jax.device_put(a, sharding) for a in arrays)
+    ), nbytes
+
+
+def restack_shards(state, buffers, slot_cap, bucket, n_dims, mesh, axis,
+                   dirty_shards):
+    """Shard-local re-materialization: rebuild ONLY the dirty shards'
+    slot blocks from host truth, reusing every clean shard's device
+    buffers untouched -- siblings on other shards are pinned bitwise
+    by construction (their bytes never move).  Returns
+    ``(state, nbytes_uploaded)``.
+
+    ``buffers`` maps GLOBAL slot index -> ObsBuffer; ``dirty_shards``
+    is the set of shard ordinals (mesh device order) to rebuild.
+    """
+    import jax
+
+    n_shards = int(mesh.shape[axis])
+    s = int(slot_cap)
+    blk = s // n_shards
+    devices = list(mesh.devices.flat)
+    sharding = _study_sharding(mesh, axis)
+    host = {}
+    for k in sorted(dirty_shards):
+        lo = k * blk
+        sub = {
+            i - lo: buf for i, buf in buffers.items() if lo <= i < lo + blk
+        }
+        host[k] = _host_stack(sub, blk, bucket, n_dims)
+    nbytes = sum(a.nbytes for blks in host.values() for a in blks)
+    out = []
+    for field, prev in enumerate(state):
+        by_dev = {sh.device: sh.data for sh in prev.addressable_shards}
+        datas = []
+        for k, dev in enumerate(devices):
+            if k in host:
+                datas.append(jax.device_put(host[k][field], dev))
+            else:
+                datas.append(by_dev[dev])
+        out.append(jax.make_array_from_single_device_arrays(
+            prev.shape, sharding, datas
+        ))
+    return StudyBatchState(*out), nbytes
 
 
 def _dummy_delta(ps, slot_cap):
@@ -129,7 +211,7 @@ def _dummy_delta(ps, slot_cap):
 def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
                           prior_weight=1.0, n_cand_cat=None,
                           above_cap=None, avg_best_idx=2.0,
-                          shrink_coef=0.1):
+                          shrink_coef=0.1, mesh=None, mesh_axis=None):
     """Compile (once per parameterization) the batched fused tell+ask
     step for a PackedSpace.
 
@@ -152,6 +234,13 @@ def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
     (:func:`hyperopt_tpu.tpe_jax.build_suggest_fn`) or ``"anneal"``
     (:func:`hyperopt_tpu.anneal_jax.build_anneal_fn`).
 
+    ``mesh=`` (graftmesh) shards the slot axis over a 1-D study mesh
+    with ``shard_map``: each device runs the IDENTICAL vmapped per-slot
+    body over its slot block, so a 1-device mesh is bitwise this
+    function's unsharded program and slot capacity scales with device
+    count.  The slot axis length must divide by the mesh size
+    (:func:`slot_capacity` ``shards=`` guarantees it).
+
     The jitted program is cached ON the PackedSpace (the
     ``cached_suggest_fn`` pattern): a restarted service over the same
     compiled space -- the crash-recovery loop -- reuses the program and
@@ -168,6 +257,7 @@ def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
         None if n_cand_cat is None else int(n_cand_cat),
         None if above_cap is None else int(above_cap),
         float(avg_best_idx), float(shrink_coef),
+        None if mesh is None else (mesh, mesh_axis),
     )
     cache = getattr(ps, "_serve_step_cache", None)
     if cache is None:
@@ -207,7 +297,22 @@ def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
             na = jnp.where(wm, warm_a, pri_a)
             return tuple(st) + (nv, na)
 
-        return jax.vmap(one)(
+        body = jax.vmap(one)
+        if mesh is not None:
+            # graftmesh: the SAME vmapped closure per shard -- slots
+            # never interact, so there is no collective in the body
+            # and each slot's math is bitwise the unsharded program's
+            from jax.sharding import PartitionSpec as P
+
+            from ..parallel.mesh import STUDY_AXIS
+            from ..parallel.sharded import _shard_map
+
+            ax = mesh_axis or STUDY_AXIS
+            body = _shard_map()(
+                body, mesh=mesh, in_specs=(P(ax),) * 11,
+                out_specs=P(ax), check_vma=False,
+            )
+        return body(
             keys, values, active, losses, valid, vcol, acol, loss, idx,
             apply, warm,
         )
@@ -220,9 +325,10 @@ def build_batched_step_fn(ps, algo="tpe", n_cand=16, gamma=0.25, lf=25.0,
 
 
 _FINITE_CHECK_FN = None  # lazily-built; shared by every scheduler
+_FINITE_CHECK_FN_MESH = {}  # (mesh, axis) -> jitted sharded twin
 
 
-def build_finite_check_fn():
+def build_finite_check_fn(mesh=None, mesh_axis=None):
     """The graftguard poisoned-slot detector: ``fn(values, active,
     losses, valid, new_v) -> poisoned [S] bool``.
 
@@ -238,42 +344,83 @@ def build_finite_check_fn():
     Read-only by design (NO donation): it runs between the batched
     step and the acks, and the state it inspects is the state the next
     round dispatches from.  Built once per process -- like the delta
-    drain, it has no space dependence."""
+    drain, it has no space dependence.  ``mesh=`` builds the
+    shard_map twin (per-shard reduction over its slot block -- the
+    guard stays shard-local, one cached program per mesh)."""
+    import jax
+    import jax.numpy as jnp
+
+    def finite_check(values, active, losses, valid, new_v):
+        v_ok = jnp.all(
+            jnp.isfinite(jnp.where(active, values, 0.0)), axis=(1, 2)
+        )
+        l_ok = jnp.all(
+            jnp.isfinite(jnp.where(valid, losses, 0.0)), axis=1
+        )
+        s_ok = jnp.all(jnp.isfinite(new_v), axis=(1, 2))
+        return ~(v_ok & l_ok & s_ok)
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import STUDY_AXIS
+        from ..parallel.sharded import _shard_map
+
+        ax = mesh_axis or STUDY_AXIS
+        key = (mesh, ax)
+        fn = _FINITE_CHECK_FN_MESH.get(key)
+        if fn is None:
+            fn = jax.jit(_shard_map()(
+                finite_check, mesh=mesh, in_specs=(P(ax),) * 5,
+                out_specs=P(ax), check_vma=False,
+            ))
+            _FINITE_CHECK_FN_MESH[key] = fn
+        return fn
     global _FINITE_CHECK_FN
     if _FINITE_CHECK_FN is None:
-        import jax
-        import jax.numpy as jnp
-
-        def finite_check(values, active, losses, valid, new_v):
-            v_ok = jnp.all(
-                jnp.isfinite(jnp.where(active, values, 0.0)), axis=(1, 2)
-            )
-            l_ok = jnp.all(
-                jnp.isfinite(jnp.where(valid, losses, 0.0)), axis=1
-            )
-            s_ok = jnp.all(jnp.isfinite(new_v), axis=(1, 2))
-            return ~(v_ok & l_ok & s_ok)
-
         _FINITE_CHECK_FN = jax.jit(finite_check)
     return _FINITE_CHECK_FN
 
 
 _BATCHED_DELTA_FN = None  # lazily-built; shared by every scheduler
+_BATCHED_DELTA_FN_MESH = {}  # (mesh, axis) -> jitted sharded twin
 
 
-def build_batched_delta_fn():
+def build_batched_delta_fn(mesh=None, mesh_axis=None):
     """The stacked twin of the standalone O(D) delta-tell program:
     ``fn(values, active, losses, valid, vcol, acol, loss, idx, apply)``
     -- one dispatch applies (at most) one staged delta per slot, the
     backlog-drain path when a study told more than once between asks.
     Donated state, like the solo ``_apply_delta_fn`` (and like it,
-    built once per process -- it has no space dependence)."""
+    built once per process -- it has no space dependence).  ``mesh=``
+    builds the shard_map twin over the study axis (one cached program
+    per mesh; the per-slot write is bitwise the unsharded one)."""
+    import jax
+
+    from ..ops.kernels import apply_delta_masked
+
+    if mesh is not None:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.mesh import STUDY_AXIS
+        from ..parallel.sharded import _shard_map
+
+        ax = mesh_axis or STUDY_AXIS
+        key = (mesh, ax)
+        fn = _BATCHED_DELTA_FN_MESH.get(key)
+        if fn is None:
+            fn = jax.jit(
+                _shard_map()(
+                    jax.vmap(apply_delta_masked), mesh=mesh,
+                    in_specs=(P(ax),) * 9, out_specs=P(ax),
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1, 2, 3),
+            )
+            _BATCHED_DELTA_FN_MESH[key] = fn
+        return fn
     global _BATCHED_DELTA_FN
     if _BATCHED_DELTA_FN is None:
-        import jax
-
-        from ..ops.kernels import apply_delta_masked
-
         _BATCHED_DELTA_FN = jax.jit(
             jax.vmap(apply_delta_masked), donate_argnums=(0, 1, 2, 3)
         )
@@ -341,6 +488,69 @@ def _registry_serve_delta(p):
     return ProgramCapture(
         fn=fn,
         args=p.study_history_specs() + p.study_delta_specs(),
+        donate_argnums=(0, 1, 2, 3),
+    )
+
+
+def _mesh_specs(specs, mesh, axis):
+    """Re-pin abstract specs with the study-axis sharding attached, so
+    the traced/lowered mesh program sees the layout production runs at
+    (and GL403 reads the multi-device donation attributes)."""
+    import jax
+
+    sharding = _study_sharding(mesh, axis)
+    return tuple(
+        jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sharding)
+        for s in specs
+    )
+
+
+@register_program(
+    "serve.batched_step_mesh",
+    families=("hyperopt_tpu.serve.batched:build_batched_step_fn",),
+)
+def _registry_serve_step_mesh(p):
+    """The graftmesh twin of ``serve.batched_step``: the same vmapped
+    per-slot body shard_mapped over a forced 4-virtual-CPU-device
+    study mesh (donated stacked state, verified under shard_map by
+    GL403 via the multi-device ``jax.buffer_donor`` attributes)."""
+    from ..parallel.mesh import STUDY_AXIS, registry_cpu_mesh
+
+    mesh = registry_cpu_mesh()
+    fn = build_batched_step_fn(
+        p.space, algo="tpe", n_cand=16, mesh=mesh, mesh_axis=STUDY_AXIS,
+    )
+    specs = (
+        (p.keys_spec(),) + p.study_history_specs()
+        + p.study_delta_specs() + (p.study_mask_spec(),)
+    )
+    return ProgramCapture(
+        fn=fn,
+        args=_mesh_specs(specs, mesh, STUDY_AXIS),
+        kwargs={"batch": 1},
+        donate_argnums=(1, 2, 3, 4),
+        # per-slot closures x64-pinned by the solo registrations (same
+        # precedent as serve.batched_step)
+        x64_check=False,
+    )
+
+
+@register_program(
+    "serve.batched_delta_mesh",
+    families=("hyperopt_tpu.ops.kernels:apply_delta_masked",),
+)
+def _registry_serve_delta_mesh(p):
+    """The graftmesh backlog-drain twin: one masked O(D) delta per
+    slot, shard_mapped over the forced study mesh (donated stacked
+    state, GL403-verified under shard_map)."""
+    from ..parallel.mesh import STUDY_AXIS, registry_cpu_mesh
+
+    mesh = registry_cpu_mesh()
+    fn = build_batched_delta_fn(mesh=mesh, mesh_axis=STUDY_AXIS)
+    specs = p.study_history_specs() + p.study_delta_specs()
+    return ProgramCapture(
+        fn=fn,
+        args=_mesh_specs(specs, mesh, STUDY_AXIS),
         donate_argnums=(0, 1, 2, 3),
     )
 

@@ -56,6 +56,7 @@ from .batched import (
     build_batched_delta_fn,
     build_batched_step_fn,
     build_finite_check_fn,
+    restack_shards,
     slot_capacity,
     stack_states,
 )
@@ -226,13 +227,30 @@ class BatchScheduler:
                  n_startup_jobs=20, fs=REAL_FS, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
                  finite_check=True, quarantine_trips=QUARANTINE_TRIPS,
-                 circuit_threshold=CIRCUIT_THRESHOLD, **algo_kw):
+                 circuit_threshold=CIRCUIT_THRESHOLD, mesh=None,
+                 **algo_kw):
         self.ps = ps
         self.algo = str(algo)
         self.max_batch = int(max_batch)
         self.max_wait = float(max_wait)
         self.n_startup_jobs = int(n_startup_jobs)
         self.fs = fs
+        # graftmesh: a 1-D study mesh shards the slot axis with
+        # shard_map -- slot capacity multiplies with device count, and
+        # re-materialization/quarantine stay shard-local
+        self.mesh = mesh
+        if mesh is not None:
+            axes = list(mesh.shape)
+            if len(axes) != 1:
+                raise ValueError(
+                    f"BatchScheduler mesh must be 1-D (the study axis); "
+                    f"got axes {axes}"
+                )
+            self._mesh_axis = axes[0]
+            self._n_shards = int(mesh.shape[self._mesh_axis])
+        else:
+            self._mesh_axis = None
+            self._n_shards = 1
         self.max_queue = (
             4 * self.max_batch if max_queue is None else int(max_queue)
         )
@@ -265,10 +283,15 @@ class BatchScheduler:
         else:
             self._pow2_cap = None
         self._step_fn = build_batched_step_fn(
-            ps, algo=self.algo, **self.algo_kw
+            ps, algo=self.algo, mesh=self.mesh,
+            mesh_axis=self._mesh_axis, **self.algo_kw
         )
-        self._delta_fn = build_batched_delta_fn()
-        self._finite_fn = build_finite_check_fn()
+        self._delta_fn = build_batched_delta_fn(
+            mesh=self.mesh, mesh_axis=self._mesh_axis
+        )
+        self._finite_fn = build_finite_check_fn(
+            mesh=self.mesh, mesh_axis=self._mesh_axis
+        )
 
         self._lock = threading.RLock()
         self._cond = threading.Condition(self._lock)
@@ -296,6 +319,7 @@ class BatchScheduler:
         self.upload_bytes = 0
         self.joins = 0
         self.rebuckets = 0
+        self.shard_restacks = 0  # graftmesh shard-local re-uploads
         # graftguard accounting (deterministic, except the _ms timings)
         self.admitted_count = 0
         self.shed_count = 0  # Overloaded + DeadlineExpired refusals
@@ -314,6 +338,34 @@ class BatchScheduler:
         self.occupancy = collections.deque(maxlen=METRICS_WINDOW)
 
     # -- tenancy -----------------------------------------------------------
+    def _alloc_slot(self):
+        """Pick the next study's slot (lock held).  Unsharded: reuse
+        the lowest freed slot, else append.  Sharded (graftmesh):
+        stripe across shards -- the unoccupied slot whose shard holds
+        the fewest studies (lowest index on ties), so tenants spread
+        over the mesh instead of piling onto shard 0 and every shard's
+        re-materializations stay small."""
+        if self._n_shards == 1:
+            if self._free:
+                return self._free.pop()
+            return len(self._studies)
+        cap = max(
+            self._slot_cap,
+            slot_capacity(
+                len(self._studies) + 1, self.max_batch,
+                shards=self._n_shards,
+            ),
+        )
+        blk = max(1, cap // self._n_shards)
+        occ = collections.Counter(s // blk for s in self._slots)
+        slot = min(
+            (s for s in range(cap) if s not in self._slots),
+            key=lambda s: (occ.get(s // blk, 0), s),
+        )
+        if slot in self._free:
+            self._free.remove(slot)
+        return slot
+
     def open_study(self, name, seed=0, study=None):
         """Join a (new or restored) study to the slotted batch."""
         with self._lock:
@@ -327,15 +379,11 @@ class BatchScheduler:
             st = study if study is not None else ServeStudy(
                 name, seed, self.ps
             )
-            if self._free:
-                st.slot = self._free.pop()
-            else:
-                st.slot = len(self._studies)
-            st.dirty = True
+            st.slot = self._alloc_slot()
+            st.dirty = True  # _maintain re-materializes its shard
             self._studies[name] = st
             self._slots[st.slot] = st
             self.joins += 1
-            self._materialize = True
             return st
 
     def close_study(self, name):
@@ -544,26 +592,57 @@ class BatchScheduler:
         if not buffers:
             self._state = None
             return
-        self._state, nbytes = stack_states(buffers, slot_cap, bucket)
+        self._state, nbytes = stack_states(
+            buffers, slot_cap, bucket, mesh=self.mesh,
+            axis=self._mesh_axis,
+        )
         self.upload_events += 1
         self.upload_bytes += nbytes
         for st in self._slots.values():
             st.dirty = False
             st.pending.clear()  # host truth already includes them
 
+    def _restack_dirty_shards(self):
+        """graftmesh shard-local re-materialization (lock held,
+        geometry unchanged): rebuild only the shards holding dirty
+        slots from host truth; every other shard's device buffers are
+        reused untouched -- siblings there are pinned bitwise because
+        their bytes never move.  Pending deltas of the rebuilt shards
+        clear (host truth already includes them); other shards keep
+        their staged backlogs."""
+        blk = self._slot_cap // self._n_shards
+        dirty_shards = {
+            st.slot // blk for st in self._slots.values() if st.dirty
+        }
+        buffers = {st.slot: st.buf for st in self._slots.values()}
+        self._state, nbytes = restack_shards(
+            self._state, buffers, self._slot_cap, self._bucket,
+            self.ps.n_dims, self.mesh, self._mesh_axis, dirty_shards,
+        )
+        self.upload_events += 1
+        self.upload_bytes += nbytes
+        self.shard_restacks += 1
+        for st in self._slots.values():
+            if st.slot // blk in dirty_shards:
+                st.dirty = False
+                st.pending.clear()
+
     def _maintain(self):
         """Bring the stacked state up to date with tenancy/host truth:
         slot-capacity growth, obs-bucket growth, joins, dirty slots --
-        all absorbed by ONE re-materialization; then drain any
-        remaining multi-delta backlog down to one staged tell per slot
-        (the fused dispatch absorbs the last one)."""
+        all absorbed by ONE re-materialization (shard-local on a mesh
+        when geometry is unchanged); then drain any remaining
+        multi-delta backlog down to one staged tell per slot (the
+        fused dispatch absorbs the last one)."""
         # size from the HIGHEST occupied slot, not the study count:
         # churn can leave survivors on slots >= len(self._studies)
         # (closed studies free their low slots, survivors keep high
         # ones), and stack_states must cover every occupied index
         top_slot = max(self._slots, default=-1)
         slot_cap = max(
-            slot_capacity(top_slot + 1, self.max_batch),
+            slot_capacity(
+                top_slot + 1, self.max_batch, shards=self._n_shards
+            ),
             self._slot_cap,  # capacities never shrink mid-flight
         )
         bucket = self._compute_bucket()
@@ -571,7 +650,15 @@ class BatchScheduler:
             if self._state is not None:
                 self.rebuckets += 1
             self._materialize = True
-        if any(st.dirty for st in self._slots.values()):
+        dirty = any(st.dirty for st in self._slots.values())
+        if (
+            dirty
+            and not self._materialize
+            and self._n_shards > 1
+            and self._state is not None
+        ):
+            self._restack_dirty_shards()
+        elif dirty:
             self._materialize = True
         if self._materialize:
             self._slot_cap, self._bucket = slot_cap, bucket

@@ -317,7 +317,7 @@ class SuggestService:
                  max_wait_ms=2.0, n_startup_jobs=20, background=True,
                  fs=REAL_FS, snapshot_cadence=256, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
-                 finite_check=True, **algo_kw):
+                 finite_check=True, mesh=None, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -333,7 +333,7 @@ class SuggestService:
             n_startup_jobs=n_startup_jobs, fs=fs, max_queue=max_queue,
             study_queue_cap=study_queue_cap,
             dispatch_timeout=dispatch_timeout,
-            finite_check=finite_check, **algo_kw,
+            finite_check=finite_check, mesh=mesh, **algo_kw,
         )
         if self._background:
             self.scheduler.start()
@@ -458,6 +458,9 @@ class SuggestService:
             "upload_bytes": s.upload_bytes,
             "joins": s.joins,
             "rebuckets": s.rebuckets,
+            # graftmesh accounting
+            "shard_restacks": s.shard_restacks,
+            "mesh_shards": s._n_shards,
             # graftguard accounting
             "admitted_count": s.admitted_count,
             "shed_count": s.shed_count,
@@ -661,13 +664,26 @@ def main(argv=None):
         help="watchdog deadline (seconds) per device dispatch; "
         "0 disables the watchdog",
     )
+    parser.add_argument(
+        "--mesh-devices", type=int, default=0,
+        help="shard the study slot axis over this many devices "
+        "(graftmesh; 0 = single-device engine, -1 = every visible "
+        "device)",
+    )
     args = parser.parse_args(argv)
 
+    mesh = None
+    if args.mesh_devices:
+        from ..parallel.mesh import study_mesh
+
+        mesh = study_mesh(
+            None if args.mesh_devices < 0 else args.mesh_devices
+        )
     service = SuggestService(
         _load_space(args.space), algo=args.algo, root=args.root,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         n_startup_jobs=args.n_startup_jobs, max_queue=args.max_queue,
-        dispatch_timeout=args.dispatch_timeout or None,
+        dispatch_timeout=args.dispatch_timeout or None, mesh=mesh,
     )
     server = serve_forever(service, host=args.host, port=args.port)
     host, port = server.server_address[:2]

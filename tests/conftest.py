@@ -37,6 +37,32 @@ except Exception:
     pass
 
 
+import pytest
+
+
+@pytest.fixture
+def cpu_mesh():
+    """The graftmesh fast-tier harness: a 1-D mesh over the first n of
+    this session's forced virtual CPU devices (8, see module
+    docstring), so mesh parity tests run in tier-1 without real
+    multi-chip hardware.  For parity checks that need a DIFFERENT
+    device count than the session's, use
+    :func:`hyperopt_tpu.parallel.mesh.subprocess_env_with_devices`
+    (the subprocess half of the harness)."""
+
+    def make(n, axis="study"):
+        import numpy as np
+        import jax
+        from jax.sharding import Mesh
+
+        devs = jax.devices()
+        if len(devs) < n:
+            pytest.skip(f"needs {n} virtual devices, have {len(devs)}")
+        return Mesh(np.asarray(devs[:n]), (axis,))
+
+    return make
+
+
 def pytest_configure(config):
     # session start for the fast-tier wall-clock budget pin
     # (tests/test_zz_wallclock_budget.py, VERDICT r5 item 7b): stored on

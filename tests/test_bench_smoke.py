@@ -122,3 +122,17 @@ def test_bench_prints_one_json_line():
     assert 0 < d["serve_shed_rate"] < 1
     assert d["serve_quarantine_count"] == 3
     assert d["serve_watchdog_recovery_ms"] > 0
+    # round-17: graftmesh rows -- per-mesh-shape throughput of the
+    # study-sharded serve engine and the shard_map PBT schedule, keyed
+    # by mesh shape, plus the scaling-efficiency diagnostic per family
+    serve_mesh = d["serve_studies_per_sec_mesh"]
+    assert set(serve_mesh) == {"study=1", "study=2", "study=4"}
+    assert all(v > 0 for v in serve_mesh.values())
+    pbt_mesh = d["pbt_member_steps_per_sec_mesh"]
+    assert set(pbt_mesh) == {"trial=1", "trial=2", "trial=4"}
+    assert all(v > 0 for v in pbt_mesh.values())
+    eff = d["mesh_scaling_efficiency"]
+    assert set(eff) == {"serve", "pbt"}
+    assert set(eff["serve"]) == {"study=2", "study=4"}
+    assert set(eff["pbt"]) == {"trial=2", "trial=4"}
+    assert all(v > 0 for fam in eff.values() for v in fam.values())

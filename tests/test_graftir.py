@@ -58,8 +58,10 @@ def test_ir_gate_clean_and_fast():
     # noise inside the 9-minute wallclock pin (raised 10 -> 15 s when
     # the serve-batched families grew the registry 11 -> 14 programs,
     # 15 -> 25 s when the chunked/trainable device-loop families grew
-    # it 14 -> 18 -- the train_step trace runs grad through an MLP)
-    assert elapsed < 25.0, f"--ir took {elapsed:.2f}s (budget 25s)"
+    # it 14 -> 18 -- the train_step trace runs grad through an MLP --
+    # and 25 -> 40 s when the graftmesh shard_map families grew it
+    # 18 -> 22: each traces AND lowers over the forced 4-device mesh)
+    assert elapsed < 40.0, f"--ir took {elapsed:.2f}s (budget 40s)"
 
 
 def test_manifest_covers_every_registered_program():

@@ -1,0 +1,448 @@
+"""graftmesh (ISSUE 12): shard the study and population axes across
+multi-chip meshes.
+
+The acceptance contract, pinned deterministically on the session's
+8-virtual-CPU-device harness (tests/conftest.py):
+
+* SERVE PARITY: the mesh-sharded batched tell+ask is BITWISE the
+  single-device engine -- on a 1-device mesh and on a 4-virtual-device
+  mesh -- through the full 64-study scenario: join/leave churn with
+  slot reuse, dirty-slot re-materialization from an out-of-order tell,
+  multi-tell backlog drains, and a NaN tenant quarantined with every
+  sibling stream pinned (the single-device engine is itself pinned
+  bitwise against solo fused runs by tests/test_serve.py, so parity
+  here is transitive to the solo path);
+* SHARD-LOCALITY: on a multi-device mesh, a dirty slot re-uploads only
+  ITS shard (counted: ``shard_restacks``), sibling shards' device
+  buffers are reused untouched;
+* SLOT CAPACITY: capacities round up to a multiple of the mesh
+  study-axis size -- including non-pow2 sizes -- padding dead slots
+  behind the active mask (the uneven-churn regression);
+* PBT / device-ASHA: the shard_map population schedules are bitwise
+  the unsharded ones at equal population, with all-gathers only at
+  exploit/rung boundaries.
+"""
+
+import math
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.exceptions import StudyPoisoned, StudyQuarantined
+from hyperopt_tpu.serve import SuggestService
+from hyperopt_tpu.serve.batched import slot_capacity
+
+# a deliberately small space: the mesh suite compiles its own 64-slot
+# vmapped step programs per mesh (cache-keyed by mesh), so the per-slot
+# body is kept cheap to protect the fast-tier wall-clock budget
+SPACE = {
+    "x": hp.uniform("x", -5.0, 5.0),
+    "c": hp.choice("c", [0, 1, 2]),
+}
+ALGO_KW = dict(n_cand=8, n_cand_cat=4)
+N_STARTUP = 2
+
+
+def loss_fn(vals):
+    return (vals["x"] - 1.0) ** 2 / 10 + 0.1 * vals["c"]
+
+
+def drive(svc, handles, streams, rounds):
+    for _ in range(rounds):
+        futs = [(h, h.ask_async()) for h in handles]
+        svc.pump()
+        for h, f in futs:
+            tid, vals = f.result(timeout=60)
+            streams.setdefault(h.name, []).append(vals)
+            h.tell(tid, loss_fn(vals))
+
+
+def serve_scenario(mesh, n_studies=60, max_batch=64):
+    """The acceptance scenario: churn + out-of-order dirty slot +
+    multi-tell backlog + NaN quarantine, all in one service run.
+    Returns (streams, counters, quarantined)."""
+    svc = SuggestService(
+        SPACE, max_batch=max_batch, background=False,
+        n_startup_jobs=N_STARTUP, mesh=mesh, **ALGO_KW,
+    )
+    streams = {}
+    handles = [
+        svc.create_study(f"s{i:02d}", seed=400 + i)
+        for i in range(n_studies)
+    ]
+    drive(svc, handles, streams, 2)
+
+    # churn: close a quarter mid-run, join replacements (slot reuse)
+    for h in handles[: n_studies // 4]:
+        h.close()
+    survivors = handles[n_studies // 4:]
+    joined = [
+        svc.create_study(f"j{i:02d}", seed=600 + i)
+        for i in range(n_studies // 4)
+    ]
+    drive(svc, survivors + joined, streams, 2)
+
+    # dirty-slot re-materialization: an OUT-OF-ORDER tell (tid below
+    # the study's last) forces the slot back to host truth
+    ooo = survivors[0]
+    st = svc.scheduler.study(ooo.name)
+    t_hi = st.next_tid
+    t_lo = t_hi + 1  # tell hi first, then lo: lo lands out of order
+    ooo.tell(t_lo, 0.9, vals={"x": 0.5, "c": 1})
+    ooo.tell(t_hi, 0.7, vals={"x": -0.5, "c": 0})
+    st.next_tid = t_lo + 1
+    assert st.dirty, "out-of-order tell must dirty the slot"
+
+    # multi-tell backlog on another study (drains via the masked-delta
+    # program, at most one staged tell fused into the next ask)
+    blg = survivors[1]
+    st_b = svc.scheduler.study(blg.name)
+    base = st_b.next_tid
+    for k in range(3):
+        blg.tell(base + k, 0.5 + 0.1 * k, vals={"x": 0.1 * k, "c": 0})
+    st_b.next_tid = base + 3
+    drive(svc, survivors + joined, streams, 2)
+
+    # a NaN tenant trips the finite check K times and is evicted;
+    # every sibling must stay bitwise undisturbed
+    bad = svc.create_study("bad", seed=999)
+    st_bad = svc.scheduler.study("bad")
+    bad.tell(st_bad.next_tid, float("nan"), vals={"x": 0.0, "c": 0})
+    st_bad.next_tid += 1
+    for _ in range(4):
+        if st_bad.quarantined:
+            break
+        try:
+            f = bad.ask_async()
+            svc.pump()
+            f.exception(timeout=60)
+        except (StudyPoisoned, StudyQuarantined):
+            break
+    drive(svc, survivors + joined, streams, 1)
+
+    counters = dict(svc.counters)
+    quarantined = st_bad.quarantined
+    svc.shutdown()
+    return streams, counters, quarantined
+
+
+_REF = {}
+
+
+def _reference(n_studies=60):
+    """The single-device engine's scenario run (shared across params:
+    the suite compares every mesh against ONE unsharded run)."""
+    if n_studies not in _REF:
+        _REF[n_studies] = serve_scenario(None, n_studies=n_studies)
+    return _REF[n_studies]
+
+
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_mesh_serve_64_study_scenario_bitwise(cpu_mesh, n_dev):
+    """THE acceptance pin: the mesh-sharded engine is bitwise the
+    single-device engine through the full 64-slot scenario (60
+    tenants + churn + the quarantined NaN tenant) -- churn, dirty-slot
+    re-materialization, backlog drains, quarantine -- on a 1-device
+    mesh AND a 4-virtual-device mesh."""
+    ref_streams, ref_counters, ref_q = _reference()
+    streams, counters, quarantined = serve_scenario(cpu_mesh(n_dev))
+
+    assert quarantined and ref_q, "NaN tenant must be evicted"
+    assert counters["evictions"] == ref_counters["evictions"] == 1
+    for name, stream in ref_streams.items():
+        assert streams[name] == stream, (
+            f"study {name} diverged on the {n_dev}-device mesh"
+        )
+    assert counters["mesh_shards"] == n_dev
+    # same number of ROUND dispatches; the mesh run may pay extra
+    # masked-delta drains where the unsharded engine's full remat
+    # absorbed a sibling shard's staged backlog as a side effect
+    assert (
+        counters["dispatch_count"] - counters["delta_drain_dispatches"]
+        == ref_counters["dispatch_count"]
+        - ref_counters["delta_drain_dispatches"]
+    )
+    if n_dev > 1:
+        # shard-locality really engaged: the out-of-order dirty slot,
+        # the quarantine re-materializations, and the churn joins all
+        # re-upload single shards instead of the whole stacked state
+        assert counters["shard_restacks"] > 0
+        assert counters["upload_bytes"] < ref_counters["upload_bytes"]
+
+
+def test_mesh_serve_uneven_churn_non_pow2_shards(cpu_mesh):
+    """REGRESSION (the slot-capacity satellite): on a 3-shard mesh the
+    pow2 capacity schedule alone would leave the slot axis indivisible
+    -- capacities must round up to a multiple of the study-axis size,
+    and the padded dead slots must stay invisible through uneven churn
+    (close-before-first-dispatch leaves survivors on high slots)."""
+    mesh = cpu_mesh(3)
+    svc = SuggestService(
+        SPACE, max_batch=16, background=False,
+        n_startup_jobs=N_STARTUP, mesh=mesh, **ALGO_KW,
+    )
+    handles = [svc.create_study(f"u{i}", seed=50 + i) for i in range(5)]
+    handles[0].close()  # frees a slot BEFORE the first dispatch
+    survivors = handles[1:]
+    streams = {}
+    drive(svc, survivors, streams, 3)
+    assert svc.scheduler._slot_cap % 3 == 0
+    state = svc.scheduler._state
+    assert state.values.shape[0] == svc.scheduler._slot_cap
+    counters = dict(svc.counters)
+    svc.shutdown()
+
+    ref = SuggestService(
+        SPACE, max_batch=16, background=False,
+        n_startup_jobs=N_STARTUP, **ALGO_KW,
+    )
+    rhandles = [ref.create_study(f"u{i}", seed=50 + i) for i in range(5)]
+    rhandles[0].close()
+    rstreams = {}
+    drive(ref, rhandles[1:], rstreams, 3)
+    ref.shutdown()
+    assert streams == rstreams, "uneven churn diverged on 3 shards"
+    assert counters["mesh_shards"] == 3
+
+
+def test_slot_capacity_rounds_to_shard_multiple():
+    # the historical pow2 schedule is the shards=1 degenerate case
+    assert slot_capacity(1, 64) == 4
+    assert slot_capacity(5, 64) == 8
+    assert slot_capacity(100, 64) == 64
+    # shard rounding: up to a multiple of the study-axis size
+    assert slot_capacity(1, 64, shards=4) == 4
+    assert slot_capacity(5, 64, shards=4) == 8
+    assert slot_capacity(5, 64, shards=3) == 9
+    assert slot_capacity(1, 64, shards=3) == 6
+    assert slot_capacity(33, 64, shards=3) == 66  # pads past max_batch
+    assert slot_capacity(3, 2, shards=4) == 4
+    for n in (1, 3, 5, 17):
+        for m in (1, 2, 3, 4, 5, 8):
+            cap = slot_capacity(n, 64, shards=m)
+            assert cap % m == 0 and cap >= min(n, 4)
+
+
+def test_mesh_slot_placement_stripes_across_shards(cpu_mesh):
+    """Shard-aware placement: new studies spread over the mesh instead
+    of piling onto shard 0, so every shard's re-materializations stay
+    small."""
+    svc = SuggestService(
+        SPACE, max_batch=16, background=False,
+        n_startup_jobs=N_STARTUP, mesh=cpu_mesh(4), **ALGO_KW,
+    )
+    for i in range(4):
+        svc.create_study(f"p{i}", seed=i)
+    sched = svc.scheduler
+    cap = max(
+        sched._slot_cap,
+        slot_capacity(4, 16, shards=4),
+    )
+    blk = cap // 4
+    shards = sorted(s // blk for s in sched._slots)
+    assert shards == [0, 1, 2, 3], (
+        f"expected one study per shard, got slot->shard {shards}"
+    )
+    svc.shutdown()
+
+
+def test_subprocess_harness_forces_device_count():
+    """The subprocess half of the multi-device harness: a child pinned
+    to exactly 4 virtual CPU devices runs a mesh parity check the
+    parent's device count cannot influence."""
+    from hyperopt_tpu.parallel.mesh import subprocess_env_with_devices
+
+    code = """
+import jax
+assert jax.device_count() == 4, jax.device_count()
+import numpy as np
+from hyperopt_tpu import hp
+from hyperopt_tpu.parallel.mesh import study_mesh
+from hyperopt_tpu.serve import SuggestService
+
+space = {"x": hp.uniform("x", -2.0, 2.0)}
+
+def run(mesh):
+    svc = SuggestService(space, max_batch=4, background=False,
+                         n_startup_jobs=1, n_cand=4, mesh=mesh)
+    hs = [svc.create_study(f"s{i}", seed=i) for i in range(4)]
+    streams = []
+    for _ in range(2):
+        futs = [h.ask_async() for h in hs]
+        svc.pump()
+        for h, f in zip(hs, futs):
+            tid, vals = f.result(timeout=60)
+            streams.append(vals)
+            h.tell(tid, vals["x"] ** 2)
+    svc.shutdown()
+    return streams
+
+assert run(study_mesh(4)) == run(None)
+print("MESH_SUBPROCESS_OK")
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        env=subprocess_env_with_devices(4),
+        capture_output=True, text=True, timeout=300,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "MESH_SUBPROCESS_OK" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# sharded PBT / device-ASHA parity
+# ---------------------------------------------------------------------------
+
+
+def _pbt_train_fn():
+    import jax
+    import jax.numpy as jnp
+
+    def train_fn(state, hypers, key):
+        # shared (member-position-independent) noise from the step key
+        # + per-member elementwise math: the vmapped-contract norm
+        noise = jax.random.normal(key, (), dtype=jnp.float32) * 0.01
+        theta = state["theta"] - hypers["lr"] * 2.0 * (
+            state["theta"] - 0.7
+        ) + noise
+        return {"theta": theta}, (theta - 0.7) ** 2
+
+    return train_fn
+
+
+@pytest.mark.parametrize("n_dev", [1, 2, 4])
+def test_pbt_shard_map_exploit_boundary_parity(cpu_mesh, n_dev):
+    """Sharded-PBT parity at equal population: the shard_map schedule
+    (per-shard member blocks, all-gathers only at exploit boundaries)
+    is bitwise the unsharded schedule -- loss history, final hypers,
+    final member state, and the resumed segment."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.pbt import compile_pbt
+
+    train_fn = _pbt_train_fn()
+    init = {"theta": jnp.linspace(0.0, 5.0, 16, dtype=jnp.float32)}
+    kw = dict(
+        hyper_bounds={"lr": (1e-3, 1.0)}, pop_size=16,
+        exploit_every=3, n_rounds=4,
+    )
+    plain = compile_pbt(train_fn, init, **kw)
+    ref = plain(seed=7)
+    sharded = compile_pbt(
+        train_fn, init, mesh=cpu_mesh(n_dev, axis="trial"),
+        trial_axis="trial", shard_mode="shard_map", **kw,
+    )
+    out = sharded(seed=7)
+    np.testing.assert_array_equal(
+        np.asarray(out["loss_history"]), np.asarray(ref["loss_history"])
+    )
+    for n in ref["hypers"]:
+        np.testing.assert_array_equal(out["hypers"][n], ref["hypers"][n])
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(out["state"]["theta"])),
+        np.asarray(jax.device_get(ref["state"]["theta"])),
+    )
+    assert out["best_loss"] == ref["best_loss"]
+    assert out["best_index"] == ref["best_index"]
+
+    # resume parity: a second segment from the sharded result matches
+    # the unsharded second segment bitwise
+    out2 = sharded(seed=7, init=out)
+    ref2 = plain(seed=7, init=ref)
+    np.testing.assert_array_equal(
+        np.asarray(out2["loss_history"]),
+        np.asarray(ref2["loss_history"]),
+    )
+
+
+def test_pbt_shard_map_validation():
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.parallel.mesh import mesh_from_spec
+    from hyperopt_tpu.pbt import compile_pbt
+
+    train_fn = _pbt_train_fn()
+    init = {"theta": jnp.zeros((6,), jnp.float32)}
+    with pytest.raises(ValueError, match="requires mesh"):
+        compile_pbt(
+            train_fn, init, {"lr": (1e-3, 1.0)}, pop_size=6,
+            shard_mode="shard_map",
+        )
+    mesh = mesh_from_spec((4,), ("trial",))
+    with pytest.raises(ValueError, match="divide"):
+        compile_pbt(
+            train_fn, init, {"lr": (1e-3, 1.0)}, pop_size=6,
+            mesh=mesh, trial_axis="trial", shard_mode="shard_map",
+        )
+    with pytest.raises(ValueError, match="shard_mode"):
+        compile_pbt(
+            train_fn, init, {"lr": (1e-3, 1.0)}, pop_size=8,
+            mesh=mesh, trial_axis="trial", shard_mode="nonsense",
+        )
+
+
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_sha_shard_map_rung_parity(cpu_mesh, n_dev):
+    """Sharded device-ASHA: every rung's population shards over a
+    per-rung sub-mesh (gcd keeps late tiny rungs divisible) and the
+    ladder -- per-rung bests, winner, hypers -- is bitwise the
+    unsharded one."""
+    import jax.numpy as jnp
+
+    from hyperopt_tpu.hyperband import compile_sha
+
+    train_fn = _pbt_train_fn()
+    init = {"theta": jnp.linspace(0.5, 5.0, 8, dtype=jnp.float32)}
+    kw = dict(
+        hyper_bounds={"lr": (1e-3, 1.0)}, n_configs=8, eta=2,
+        steps_per_rung=2,
+    )
+    ref = compile_sha(train_fn, init, **kw)(seed=9)
+    out = compile_sha(
+        train_fn, init, mesh=cpu_mesh(n_dev, axis="trial"),
+        trial_axis="trial", shard_mode="shard_map", **kw,
+    )(seed=9)
+    assert out["best_loss"] == ref["best_loss"]
+    assert out["best_hypers"] == ref["best_hypers"]
+    assert out["best_index"] == ref["best_index"]
+    assert [r["best_loss"] for r in out["rungs"]] == [
+        r["best_loss"] for r in ref["rungs"]
+    ]
+    # the per-rung sub-meshes really shrink with the rung population
+    runner = compile_sha(
+        train_fn, init, mesh=cpu_mesh(n_dev, axis="trial"),
+        trial_axis="trial", shard_mode="shard_map", **kw,
+    )
+    sizes = [
+        int(np.prod(list(s.mesh.shape.values())))
+        for s in runner._rung_shardings
+    ]
+    assert sizes == [math.gcd(8 // 2**r, n_dev) for r in range(4)]
+
+
+def test_mesh_programs_registered_in_ir_manifest():
+    """The tooling satellite: the graftmesh program families are
+    registered and their contracts -- including the donation verified
+    under shard_map (GL403 reads the multi-device buffer-donor
+    attributes) -- are pinned in the committed manifest."""
+    import os
+
+    from hyperopt_tpu.analysis.ir import load_contracts
+    from hyperopt_tpu.ops.compile import registered_programs
+
+    specs = registered_programs()
+    for name in ("serve.batched_step_mesh", "serve.batched_delta_mesh",
+                 "pbt.sharded_schedule", "hyperband.sha_rung_mesh"):
+        assert name in specs, name
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    manifest = load_contracts(
+        os.path.join(repo, "program_contracts.json")
+    )["programs"]
+    assert manifest["serve.batched_step_mesh"]["donation"] == [1, 2, 3, 4]
+    assert manifest["serve.batched_delta_mesh"]["donation"] == [0, 1, 2, 3]
+    assert manifest["pbt.sharded_schedule"]["donation"] == []
+    assert manifest["hyperband.sha_rung_mesh"]["donation"] == []
