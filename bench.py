@@ -754,6 +754,99 @@ def bench_guard(space, n_cand=128):
     }
 
 
+def bench_fleet(space, n_replicas=3, n_studies=12, rounds=3, n_cand=128):
+    """graftfleet rows (round 18): the horizontal serve fleet -- N
+    replica services behind the consistent-hash router, studies rooted
+    in one shared WAL/snapshot directory with claim/epoch fencing.
+
+    ``fleet_studies_per_sec``: asks served per second aggregated
+    across the fleet (per-replica coalesced dispatch rounds via the
+    router's batch path).  ``fleet_ask_p99_ms_failover``: p99 per-ask
+    latency over a window in which one replica is KILLED -- the first
+    ask that finds it dead pays the failover (WAL+bundle
+    re-materialization on survivors) inline, so the tail IS the
+    recovery story.  ``fleet_recovery_ms``: wall-clock of that
+    failover re-materialization (measured).  The 10^4-study churn soak
+    lives in ``tests/test_fleet_chaos.py`` (slow tier); this is its
+    small, every-round twin.
+    """
+    import shutil
+    import tempfile
+
+    from hyperopt_tpu.serve import Fleet, FleetRouter
+
+    def loss(vals):
+        return sum(
+            float(v) for v in vals.values() if isinstance(v, (int, float))
+        )
+
+    root = tempfile.mkdtemp(prefix="bench-fleet-")
+    try:
+        fleet = Fleet(
+            space, root, n_replicas=n_replicas, max_batch=16,
+            n_startup_jobs=3, n_cand=n_cand, snapshot_cadence=64,
+        )
+        router = FleetRouter(fleet)
+        names = [f"f{i:03d}" for i in range(n_studies)]
+        for i, n in enumerate(names):
+            router.create_study(n, seed=i)
+
+        def round_once():
+            got = router.ask_batch(names, timeout=120)
+            for n, (tid, vals) in got.items():
+                router.tell(n, tid, loss(vals), vals=vals)
+
+        # boot pre-warm (the LLM-serving pattern): push every replica
+        # to its full pow2 slot cap once, so the one cap-16 trace is
+        # compiled up front and neither churn nor failover adoption
+        # ever recompiles mid-traffic (pow2 caps never shrink, so the
+        # shape sticks) -- the failover window below then measures
+        # failover, not XLA compiles
+        for rid in sorted(fleet.replicas):
+            rep = fleet.replicas[rid]
+            n_pads = max(0, 9 - len(rep.service.studies()))
+            pads = [
+                rep.open_study(f"warm-{rid}-{i:02d}", seed=1000 + i)
+                for i in range(n_pads)
+            ]
+            futs = [h.ask_async() for h in pads]
+            if futs:
+                rep.pump_until(futs, timeout=120)
+            for h in pads:
+                h.close()
+
+        round_once()  # compile + first materialization
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            round_once()
+        dt = time.perf_counter() - t0
+        rate = n_studies * rounds / dt
+
+        # the failover window: kill one replica, then drive per-ask so
+        # the latency distribution includes the inline recovery
+        victim = fleet.route(names[0])
+        fleet.kill_replica(victim)
+        lats = []
+        for _ in range(2):
+            for n in names:
+                t1 = time.perf_counter()
+                tid, vals = router.ask(n, timeout=120)
+                lats.append(time.perf_counter() - t1)
+                router.tell(n, tid, loss(vals), vals=vals)
+        recovery_ms = fleet.recovery_ms
+        fleet.shutdown()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    lats_ms = sorted(1000.0 * x for x in lats)
+    p99 = lats_ms[min(len(lats_ms) - 1, int(0.99 * len(lats_ms)))]
+    return {
+        "fleet_studies_per_sec": round(rate, 1),
+        "fleet_ask_p99_ms_failover": round(p99, 3),
+        "fleet_recovery_ms": round(float(recovery_ms), 3),
+        "fleet_replicas": n_replicas,
+    }
+
+
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
@@ -1163,6 +1256,14 @@ def main():
     # round-13 graftguard rows: overload shedding, poisoned-tenant
     # quarantine, and watchdog recovery on deterministic scenarios
     guard_rows = bench_guard(space, n_cand=n_cand)
+    # round-18 graftfleet rows: the horizontal fleet -- aggregate
+    # throughput through the router, p99 ask latency across a
+    # replica-kill window, and failover recovery time
+    fleet_rows = bench_fleet(
+        space,
+        n_replicas=int(os.environ.get("BENCH_FLEET_REPLICAS", "3")),
+        n_cand=n_cand,
+    )
     # round-17 graftmesh rows: the study-sharded serve engine and the
     # shard_map PBT schedule per mesh shape (virtual CPU devices here;
     # the MULTICHIP dryrun runs the same programs on real meshes)
@@ -1267,6 +1368,10 @@ def main():
                 # protection -- shed rate, quarantine trips, watchdog
                 # recovery latency
                 **guard_rows,
+                # round-18 graftfleet rows (bench_fleet): sharded
+                # replicas behind the consistent-hash router --
+                # aggregate studies/sec, failover-window p99, recovery
+                **fleet_rows,
                 # round-17 graftmesh rows: per-mesh-shape throughput
                 # of the study-sharded serve engine and the shard_map
                 # PBT schedule, plus the near-linear-scaling

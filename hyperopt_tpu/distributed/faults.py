@@ -51,7 +51,8 @@ __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
     "DeviceFaultPlan",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
-    "DEVICE_LOOP_CRASH_POINTS", "ALL_CRASH_POINTS",
+    "DEVICE_LOOP_CRASH_POINTS", "FLEET_CRASH_POINTS",
+    "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -130,9 +131,38 @@ DEVICE_LOOP_CRASH_POINTS = (
     "device_loop_after_ckpt_before_next_chunk",
 )
 
+#: crash points of the horizontal serve FLEET (hyperopt_tpu/serve/
+#: fleet.py + router.py): replica death rides the existing
+#: ``serve_mid_batch`` point armed on THAT replica's plan; the fleet
+#: adds the windows the single-process serve stack cannot have.  The
+#: fleet chaos suite (``tests/test_fleet_chaos.py``) iterates these::
+#:
+#:     fleet_router_after_forward_before_ack   the replica executed the
+#:                                             op (tell durable / ask
+#:                                             served), the router died
+#:                                             before acking the client
+#:                                             -- retried idempotently
+#:                                             (tid-dedup / recover-ask)
+#:     fleet_migrate_after_snapshot_before_handoff  drain migration:
+#:                                             snapshot published, the
+#:                                             source still owns the
+#:                                             study (migration aborts,
+#:                                             source keeps serving)
+#:     fleet_migrate_after_handoff_before_restore   drain migration:
+#:                                             source released its
+#:                                             claim, target not yet
+#:                                             restored (the router
+#:                                             lazily adopts on the
+#:                                             ring owner)
+FLEET_CRASH_POINTS = (
+    "fleet_router_after_forward_before_ack",
+    "fleet_migrate_after_snapshot_before_handoff",
+    "fleet_migrate_after_handoff_before_restore",
+)
+
 ALL_CRASH_POINTS = (
     CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
-    + DEVICE_LOOP_CRASH_POINTS
+    + DEVICE_LOOP_CRASH_POINTS + FLEET_CRASH_POINTS
 )
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError

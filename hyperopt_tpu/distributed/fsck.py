@@ -16,6 +16,17 @@ completed_claim     crash between DONE publish and claim release     release (un
 duplicate_tid       completed job recycled back into new/running     retire the shadowed copy
 ==================  ==============================================  ===========================
 
+``--serve ROOT`` audits a SERVE study root -- the shared directory a
+fleet of ``SuggestService`` replicas keeps one ``<name>.wal`` /
+``<name>.snap`` / ``<name>.claim`` family per study in.  Every family
+gets the driver-family checks below (torn WAL tails truncated,
+mid-file corruption and foreign-guard snapshots quarantined, orphaned
+snapshot tmps unlinked), plus ``claim_orphaned`` -- a claim token
+whose study artifacts are gone (unlink).  After ``--serve ROOT
+--repair`` the root is restorable: every surviving study family loads
+via ``SuggestService(root=ROOT).create_study(name)`` -- the same
+contract ``--driver`` gives ``fmin(resume_from=...)``.
+
 ``--driver PATH`` audits a driver checkpoint family instead (``PATH``,
 ``PATH.meta``, ``PATH.wal`` -- ``fmin(trials_save_file=)``'s recovery
 artifacts):
@@ -55,7 +66,8 @@ from .filequeue import _read_json
 logger = logging.getLogger(__name__)
 
 __all__ = [
-    "Issue", "audit", "repair", "audit_driver", "repair_driver", "main",
+    "Issue", "audit", "repair", "audit_driver", "repair_driver",
+    "audit_serve", "repair_serve", "main",
 ]
 
 _SUBS = ("new", "running", "done")
@@ -222,6 +234,111 @@ def repair(root, issues, fs=REAL_FS):
 
 
 # ---------------------------------------------------------------------------
+# serve study root (a fleet's shared WAL/snapshot/claim families)
+# ---------------------------------------------------------------------------
+
+
+def audit_serve(root, fs=REAL_FS, tmp_grace=60.0):
+    """Audit a serve study root: one ``<name>.wal`` / ``<name>.snap``
+    / ``<name>.claim`` family per study, every crash mode a killed or
+    failed-over replica can leave.  Returns the list of
+    :class:`Issue` (kinds shared with :func:`audit_driver`, plus
+    ``claim_orphaned``)."""
+    import pickle
+
+    from ..exceptions import CheckpointError
+    from ..utils.wal import TellWAL
+
+    root = os.path.abspath(root)
+    issues = []
+    now = time.time()
+    try:
+        names = sorted(fs.listdir(root))
+    except FileNotFoundError:
+        return issues
+    families = {}
+    for name in names:
+        full = os.path.join(root, name)
+        if ".tmp." in name:
+            try:
+                age = now - fs.getmtime(full)
+            except OSError:
+                continue
+            if age >= tmp_grace:
+                issues.append(Issue(
+                    "orphaned_snapshot_tmp", full, f"age {age:.0f}s"
+                ))
+            continue
+        for suffix in (".wal", ".snap", ".claim"):
+            if name.endswith(suffix):
+                families.setdefault(
+                    name[: -len(suffix)], set()
+                ).add(suffix)
+    for fam in sorted(families):
+        kinds = families[fam]
+        base = os.path.join(root, fam)
+        wal_guard = None
+        if ".wal" in kinds:
+            wal = TellWAL(base + ".wal", fs=fs)
+            try:
+                header, _records, _good, torn = wal.scan()
+                wal_guard = (header or {}).get("guard")
+                if torn:
+                    issues.append(Issue(
+                        "wal_torn_tail", wal.path, f"{torn} torn byte(s)"
+                    ))
+            except CheckpointError as e:
+                issues.append(Issue("wal_corrupt", wal.path, str(e)))
+        if ".snap" in kinds:
+            snap = base + ".snap"
+            snap_guard = None
+            try:
+                with fs.open(snap, "rb") as f:
+                    snap_guard = pickle.loads(f.read()).get("guard")
+            except Exception:  # graftlint: disable=GL302 an unreadable bundle is reported as an issue, not retried
+                issues.append(Issue(
+                    "ckpt_fingerprint_mismatch", snap, "bundle unreadable"
+                ))
+            if (
+                snap_guard is not None
+                and wal_guard is not None
+                and list(snap_guard) != list(wal_guard)
+            ):
+                issues.append(Issue(
+                    "ckpt_fingerprint_mismatch", snap,
+                    f"bundle guard {snap_guard!r} != WAL guard "
+                    f"{wal_guard!r}",
+                ))
+        if kinds == {".claim"}:
+            issues.append(Issue(
+                "claim_orphaned", base + ".claim",
+                "claim token with no WAL or snapshot",
+            ))
+    return issues
+
+
+def repair_serve(root, issues, fs=REAL_FS):
+    """Fix every repairable serve-root :class:`Issue`; returns the
+    repaired count.  Family kinds delegate to :func:`repair_driver`
+    (truncate / quarantine / unlink are path-local); orphaned claims
+    are unlinked -- nothing references them."""
+    repaired = 0
+    rest = []
+    for issue in issues:
+        if issue.kind != "claim_orphaned":
+            rest.append(issue)
+            continue
+        try:
+            fs.unlink(issue.path)
+            repaired += 1
+        except FileNotFoundError:
+            repaired += 1
+        except OSError as e:
+            logger.error("could not repair %r: %s", issue, e)
+    return repaired + repair_driver(root, rest, fs=fs)
+
+
+# ---------------------------------------------------------------------------
 # driver checkpoint family (fmin's WAL + bundle artifacts)
 # ---------------------------------------------------------------------------
 
@@ -337,6 +454,11 @@ def main(argv=None):
         "PATH.wal) instead of a queue directory",
     )
     parser.add_argument(
+        "--serve", metavar="ROOT",
+        help="audit a serve study root (a fleet's shared directory of "
+        "per-study <name>.wal/.snap/.claim families) instead",
+    )
+    parser.add_argument(
         "--repair", action="store_true",
         help="fix repairable issues instead of only reporting them",
     )
@@ -355,9 +477,22 @@ def main(argv=None):
         level=logging.DEBUG if options.verbose else logging.INFO,
         stream=sys.stderr,
     )
-    if bool(options.dir) == bool(options.driver):
-        parser.error("exactly one of --dir or --driver is required")
-    if options.driver:
+    n_targets = sum(
+        1 for t in (options.dir, options.driver, options.serve) if t
+    )
+    if n_targets != 1:
+        parser.error(
+            "exactly one of --dir, --driver or --serve is required"
+        )
+    if options.serve:
+        target = options.serve
+        do_audit = lambda: audit_serve(  # noqa: E731
+            options.serve, tmp_grace=options.tmp_grace
+        )
+        do_repair = lambda issues: repair_serve(  # noqa: E731
+            options.serve, issues
+        )
+    elif options.driver:
         target = options.driver
         do_audit = lambda: audit_driver(  # noqa: E731
             options.driver, tmp_grace=options.tmp_grace

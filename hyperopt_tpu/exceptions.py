@@ -131,3 +131,19 @@ class DispatchTimeout(ServeError):
     """A device dispatch exceeded the scheduler's watchdog deadline.
     Treated as transient: the round retries once against a freshly
     re-materialized stacked state before failing the picked asks."""
+
+
+class OwnershipLost(ServeError):
+    """The serve-fleet twin of :class:`ClaimLost`: this replica's
+    per-study claim/epoch token was taken over (failover or planned
+    migration bumped the epoch), so the replica must drop the operation
+    instead of double-serving a study it no longer owns.  A partitioned
+    or zombie replica surfaces this on its next fenced ask/tell; the
+    client retries through the router, which routes to the new owner."""
+
+
+class ReplicaDead(ServeError):
+    """A fleet replica marked dead (killed, crashed, or partitioned
+    away from the router) was asked to serve: the router converts this
+    into failover -- re-materializing the dead replica's studies on
+    survivors -- and retries against the new owner."""

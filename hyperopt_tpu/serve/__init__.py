@@ -22,15 +22,29 @@ the way LLM serving batches requests (continuous batching):
   socket transport behind the ``hyperopt-tpu-serve`` console script.
 """
 
-__all__ = ["StudyHandle", "SuggestService"]
+__all__ = [
+    "StudyHandle", "SuggestService",
+    # graftfleet: the horizontal tier above one service
+    "Fleet", "FleetRouter", "HashRing", "StudyClaim",
+]
+
+_HOMES = {
+    "StudyHandle": "service",
+    "SuggestService": "service",
+    "Fleet": "fleet",
+    "StudyClaim": "fleet",
+    "FleetRouter": "router",
+    "HashRing": "router",
+}
 
 
 def __getattr__(name):
     # lazy: the graftir registry imports ``serve.batched`` on every
     # lint/bench run; pulling the scheduler/service front along would
     # be dead weight there
-    if name in __all__:
-        from . import service
+    home = _HOMES.get(name)
+    if home is not None:
+        import importlib
 
-        return getattr(service, name)
+        return getattr(importlib.import_module(f".{home}", __name__), name)
     raise AttributeError(name)

@@ -1,0 +1,473 @@
+"""graftfleet: the horizontal serve fleet -- sharded replicas, claim/
+epoch study ownership, WAL-backed migration, and failover.
+
+The ROADMAP's "millions of users" tier (the Vizier-style service
+architecture) composed from primitives that already exist:
+
+* a study is a portable WAL+bundle pair (PR 6/8) rooted in a SHARED
+  directory, so "migrating" a study is a snapshot + a claim handoff --
+  nothing is copied, the new owner restores in place with tid-dedup
+  exactly-once replay;
+* each replica is an ordinary :class:`~hyperopt_tpu.serve.service.
+  SuggestService` with a fleet identity (``owner=``): a per-study
+  :class:`StudyClaim` -- the ``distributed/`` claim-token idiom at the
+  study granularity, plus a monotone EPOCH -- fences every ask/tell,
+  so a partitioned or zombie replica gets
+  :class:`~hyperopt_tpu.exceptions.OwnershipLost` instead of
+  double-serving a study that failed over;
+* the :class:`Fleet` is the control plane: a consistent-hash ring
+  (:class:`~hyperopt_tpu.serve.router.HashRing` salted with the study-
+  family guard fingerprint) places studies on replicas, ``failover``
+  re-materializes a dead replica's studies on ring survivors from
+  their WAL+bundle pairs, and ``drain_replica`` runs the planned
+  rolling-restart path (PR-9 drain protocol: typed
+  ``Overloaded(reason="draining", retry_after=...)`` to clients,
+  snapshot -> hand off -> new owner restores -> router repoints).
+
+Determinism: placement is a pure function of (guard fingerprint,
+study name, alive replicas); suggestion streams are pure functions of
+(study seed, tell history) with submit-time seeds and WAL-logged
+cursors, so a failed-over stream continues bitwise -- the fleet chaos
+suite (``tests/test_fleet_chaos.py``) pins surviving streams against
+the same-seed no-fault run.
+
+Fencing caveat (documented, not hidden): the claim check and the WAL
+append it guards are two filesystem operations, so a takeover landing
+in the instruction window between them can still interleave one
+record; production deployments put the claim on a lease (the file's
+mtime) and fence at the storage layer.  The chaos suite exercises the
+protocol-visible windows deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import uuid
+
+from ..distributed import _common
+from ..distributed.faults import REAL_FS
+from ..exceptions import OwnershipLost, ReplicaDead
+from .router import HashRing
+from .service import SuggestService, _study_guard
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["StudyClaim", "Replica", "Fleet", "fleet_salt"]
+
+
+def fleet_salt(algo, space):
+    """The ring salt: the study-family guard fingerprint, so placement
+    is deterministic across routers, processes, and runs."""
+    return json.dumps(_study_guard(algo, space), sort_keys=True)
+
+
+class StudyClaim:
+    """Per-study ownership token at ``<root>/<name>.claim``.
+
+    The file holds ``{"replica", "token", "epoch", "released"}``,
+    published atomically (tmp + fsync + rename).  ``token`` is the
+    uniqueness check (the filequeue claim idiom: a holder proves
+    liveness by reading its own token back); ``epoch`` is the fencing
+    counter -- every acquire and release bumps it, so any observer can
+    totally order ownership changes and a zombie's stale epoch can
+    never win an argument with the current owner.  ``release`` writes
+    a tombstone (keeping the epoch monotone) rather than unlinking.
+    """
+
+    SUFFIX = ".claim"
+
+    def __init__(self, path, replica, token, epoch, fs=REAL_FS):
+        self.path = path
+        self.replica = replica
+        self.token = token
+        self.epoch = int(epoch)
+        self.fs = fs
+
+    # -- reading -----------------------------------------------------------
+    @staticmethod
+    def path_for(root, name):
+        return os.path.join(str(root), name + StudyClaim.SUFFIX)
+
+    @classmethod
+    def read(cls, root, name, fs=REAL_FS):
+        """The current claim doc, or None when never claimed."""
+        path = cls.path_for(root, name)
+
+        def _read():
+            if not fs.exists(path):
+                return None
+            with fs.open(path, "r") as f:
+                return json.load(f)
+
+        return _common.with_retries(_read, label="claim read")
+
+    # -- acquiring ---------------------------------------------------------
+    @classmethod
+    def acquire(cls, root, name, replica, fs=REAL_FS, takeover=False):
+        """Claim the study for ``replica``; returns the live claim.
+
+        A study live-owned by ANOTHER replica is refused with
+        :class:`OwnershipLost` unless ``takeover=True`` -- the router/
+        fleet failover path, which is the only authority entitled to
+        declare an owner dead.  The publish is last-writer-wins
+        (atomic rename) followed by a read-back: losing the race to a
+        concurrent claimant surfaces as :class:`OwnershipLost`, never
+        as two winners."""
+        fs.makedirs(str(root), exist_ok=True)
+        cur = cls.read(root, name, fs=fs)
+        if (
+            cur is not None
+            and not cur.get("released")
+            and cur.get("replica") not in (None, replica)
+            and not takeover
+        ):
+            raise OwnershipLost(
+                f"study {name!r} is owned by replica "
+                f"{cur['replica']!r} (epoch {cur.get('epoch')}); only "
+                "the failover/migration path may take it over"
+            )
+        epoch = (int(cur.get("epoch", -1)) + 1) if cur is not None else 0
+        claim = cls(
+            cls.path_for(root, name), str(replica), uuid.uuid4().hex,
+            epoch, fs=fs,
+        )
+        claim._publish({
+            "replica": claim.replica, "token": claim.token,
+            "epoch": claim.epoch, "released": False,
+        })
+        back = cls.read(root, name, fs=fs)
+        if back is None or back.get("token") != claim.token:
+            raise OwnershipLost(
+                f"lost the claim race for study {name!r} to "
+                f"{(back or {}).get('replica')!r}"
+            )
+        return claim
+
+    def _publish(self, doc):
+        def _write():
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with self.fs.open(tmp, "w") as f:
+                f.write(json.dumps(doc, sort_keys=True))
+                self.fs.fsync(f)
+            self.fs.rename(tmp, self.path)
+
+        _common.with_retries(_write, label="claim publish")
+
+    # -- fencing -----------------------------------------------------------
+    def is_live(self):
+        """Whether this replica still owns the study: the claim file
+        carries OUR token.  False after any takeover or release."""
+        def _read():
+            if not self.fs.exists(self.path):
+                return None
+            with self.fs.open(self.path, "r") as f:
+                return json.load(f)
+
+        cur = _common.with_retries(_read, label="claim check")
+        return (
+            cur is not None
+            and not cur.get("released")
+            and cur.get("token") == self.token
+        )
+
+    def ensure_live(self):
+        if not self.is_live():
+            raise OwnershipLost(
+                f"replica {self.replica!r} no longer holds the claim "
+                f"for {os.path.basename(self.path)!r} (taken over or "
+                "released); dropping the operation instead of "
+                "double-serving"
+            )
+
+    def release(self):
+        """Tombstone the claim (epoch bumped, monotone) -- the planned
+        handoff half of migration.  A crashed owner never releases;
+        its successor takes over with ``acquire(takeover=True)``."""
+        if not self.is_live():
+            return  # taken over already; nothing of ours to release
+        self.epoch += 1
+        self._publish({
+            "replica": None, "token": None,
+            "epoch": self.epoch, "released": True,
+        })
+
+
+class Replica:
+    """One fleet member: a fleet-identified ``SuggestService`` plus
+    the liveness flags the in-process harness needs (``dead`` -- the
+    process is gone; ``partitioned`` -- alive but unreachable from the
+    router, the zombie case the claim epochs exist for)."""
+
+    def __init__(self, rid, service):
+        self.rid = str(rid)
+        self.service = service
+        self.dead = False
+        self.partitioned = False
+
+    def _check(self):
+        if self.dead:
+            raise ReplicaDead(f"replica {self.rid!r} is dead")
+
+    def _handle(self, name):
+        svc = self.service
+        with svc._lock:
+            handle = svc._handles.get(name)
+        if handle is None and svc.root is not None:
+            # lazy adoption: the router routed this study here (ring
+            # owner), so any artifacts in the shared root are ours to
+            # restore -- the failover / aborted-migration heal path
+            handle = svc.create_study(name, takeover=True)
+        if handle is None:
+            raise ValueError(f"study {name!r} unknown on {self.rid!r}")
+        return handle
+
+    # -- the ops the router forwards ---------------------------------------
+    def open_study(self, name, seed=0, takeover=False):
+        self._check()
+        return self.service.create_study(name, seed=seed, takeover=takeover)
+
+    def ask(self, name, timeout=60.0, recover=False):
+        self._check()
+        return self._handle(name).ask(timeout=timeout, recover=recover)
+
+    def ask_async(self, name):
+        self._check()
+        return self._handle(name).ask_async()
+
+    def tell(self, name, tid, loss, vals=None):
+        self._check()
+        return self._handle(name).tell(tid, loss, vals=vals)
+
+    def best(self, name):
+        self._check()
+        return self._handle(name).best()
+
+    def close_study(self, name):
+        self._check()
+        self.service.close_study(name)
+
+    def pump_until(self, futures, timeout=60.0):
+        """Deterministic-mode gather: pump coalesced rounds until every
+        future resolves (crashes propagate to the caller -- the router
+        is the failure detector)."""
+        self._check()
+        deadline = time.perf_counter() + float(timeout)
+        while not all(f.done() for f in futures):
+            if self.service.pump() == 0:
+                if time.perf_counter() > deadline:
+                    raise TimeoutError(
+                        f"replica {self.rid!r}: batch not served within "
+                        f"{timeout}s"
+                    )
+                time.sleep(0.001)
+
+    # -- liveness ----------------------------------------------------------
+    def die(self):
+        """Crash semantics: the process is gone.  No snapshots, no
+        claim releases -- just drop the file handles a dead process
+        would drop, and refuse every future op."""
+        if self.dead:
+            return
+        self.dead = True
+        for st in list(self.service.scheduler._studies.values()):
+            if st.persist is not None:
+                st.persist.wal.close()
+
+
+class Fleet:
+    """The control plane: replicas + ring + registry + failover.
+
+    ``plans`` maps replica id -> :class:`~hyperopt_tpu.distributed.
+    faults.FaultPlan` (arm crash points / storms per replica); ``fs``
+    is the FLEET MANAGER's own seam, carrying the migration crash
+    point between handoff and restore.  ``service_kw`` passes through
+    to every replica's ``SuggestService`` (batch sizes, algo params --
+    keep them identical across replicas or streams stop being
+    placement-independent)."""
+
+    def __init__(self, space, root, n_replicas=3, algo="tpe",
+                 replica_ids=None, plans=None, fs=REAL_FS, vnodes=64,
+                 **service_kw):
+        self.space = space
+        self.root = str(root)
+        self.algo = str(algo)
+        self.fs = fs
+        self.service_kw = dict(service_kw)
+        self.salt = fleet_salt(algo, space)
+        self.ring = HashRing(salt=self.salt, vnodes=vnodes)
+        self.replicas = {}
+        self.registry = set()  # studies created through the router
+        self._moved = {}  # name -> rid: migration repoints ahead of ring
+        self.recovery_ms = None  # last failover's re-materialization time
+        plans = plans or {}
+        for rid in replica_ids or [f"r{i}" for i in range(n_replicas)]:
+            plan = plans.get(rid)
+            self.add_replica(
+                rid, fs=None if plan is None else plan.fs(), migrate=False
+            )
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, rid, fs=None, migrate=True):
+        """Join a replica.  With ``migrate=True`` (scale-out / rolling
+        replacement), the registered studies whose ring owner becomes
+        the new replica are handed over via the drain-migrate protocol
+        BEFORE the ring flips -- adding a node moves ~1/N of the keys
+        and nothing else."""
+        rid = str(rid)
+        if rid in self.replicas:
+            raise ValueError(f"replica {rid!r} already in the fleet")
+        service = SuggestService(
+            self.space, algo=self.algo, root=self.root,
+            fs=fs if fs is not None else REAL_FS, owner=rid,
+            background=False, **self.service_kw,
+        )
+        replica = Replica(rid, service)
+        before = (
+            self.ring.placement(self.registry)
+            if migrate and self.registry else {}
+        )
+        self.replicas[rid] = replica
+        self.ring.add(rid)
+        if before:
+            after = self.ring.placement(self.registry)
+            for name in sorted(self.registry):
+                if after[name] == rid and before[name] != rid:
+                    self.migrate_study(name, rid, src_rid=before[name])
+        return replica
+
+    def register(self, name):
+        self.registry.add(name)
+
+    def unregister(self, name):
+        self.registry.discard(name)
+        self._moved.pop(name, None)
+
+    def route(self, name):
+        """The replica currently serving ``name``: a migration
+        override when one is pending, else the ring owner."""
+        rid = self._moved.get(name)
+        if rid is not None and rid in self.ring.nodes:
+            return rid
+        return self.ring.owner(name)
+
+    # -- failure handling --------------------------------------------------
+    def mark_dead(self, rid):
+        """The router observed ``rid`` fail.  A partitioned replica is
+        left running (the zombie the claim epochs fence); anything
+        else gets crash semantics."""
+        replica = self.replicas.get(rid)
+        if replica is None or replica.partitioned:
+            return
+        replica.die()
+
+    def kill_replica(self, rid):
+        """Simulate external replica death (the chaos harness's kill
+        -9): crash semantics now, failover when the router notices."""
+        self.replicas[rid].die()
+
+    def partition(self, rid):
+        """Partition a replica away from the router: the router fails
+        its studies over, while the replica itself keeps running as a
+        zombie whose fenced ops must all raise ``OwnershipLost``."""
+        self.replicas[rid].partitioned = True
+
+    def failover(self, rid):
+        """Re-materialize a dead replica's studies on ring survivors
+        from their WAL+bundle pairs (tid-dedup exactly-once replay,
+        claim epochs bumped).  Idempotent; returns the moved names."""
+        if rid not in self.ring.nodes:
+            return []
+        t0 = time.perf_counter()
+        owned = [n for n in sorted(self.registry) if self.route(n) == rid]
+        self.ring.remove(rid)
+        self._moved = {
+            n: r for n, r in self._moved.items() if r != rid
+        }
+        for name in owned:
+            new_rid = self.ring.owner(name)
+            self.replicas[new_rid].open_study(name, takeover=True)
+            logger.info(
+                "failover: study %r re-materialized on %r (was %r)",
+                name, new_rid, rid,
+            )
+        self.recovery_ms = 1000.0 * (time.perf_counter() - t0)
+        return owned
+
+    # -- planned migration (the drain protocol) ----------------------------
+    def migrate_study(self, name, dst_rid, src_rid=None):
+        """Snapshot -> hand off -> new owner restores -> repoint.
+
+        Idempotent across coordinator crashes: a re-run skips the
+        handoff when the source already released the study (the
+        ``after_handoff_before_restore`` window) and the restore when
+        the target already adopted it."""
+        src_rid = src_rid if src_rid is not None else self.route(name)
+        if src_rid == dst_rid:
+            return
+        src = self.replicas[src_rid]
+        if not src.dead and name in src.service.studies():
+            src.service.handoff_study(name)
+        self.fs.crashpoint("fleet_migrate_after_handoff_before_restore")
+        self.replicas[dst_rid].open_study(name, takeover=True)
+        self._moved[name] = dst_rid
+
+    def begin_drain(self, rid, timeout=30.0):
+        """Mark the replica draining: new asks are refused with
+        ``Overloaded(reason="draining", retry_after=<time left until
+        the drain deadline>)`` while migration proceeds."""
+        self.replicas[rid].service.drain(timeout=timeout, block=False)
+
+    def complete_drain(self, rid):
+        """Migrate every owned study to its ring successor, flip the
+        ring, shut the replica down.  Returns the migrated names."""
+        replica = self.replicas[rid]
+        owned = [n for n in sorted(self.registry) if self.route(n) == rid]
+        for name in owned:
+            dst = self.ring.owner(name, exclude={rid})
+            self.migrate_study(name, dst, src_rid=rid)
+        self.ring.remove(rid)
+        self._moved = {
+            n: r for n, r in self._moved.items()
+            if n in self.registry and self.ring.owner(n) != r
+        }
+        replica.service.shutdown()
+        replica.dead = True
+        del self.replicas[rid]
+        return owned
+
+    def drain_replica(self, rid, timeout=30.0):
+        """The full rolling-restart step for one replica."""
+        self.begin_drain(rid, timeout=timeout)
+        return self.complete_drain(rid)
+
+    # -- observability -----------------------------------------------------
+    def health(self):
+        return {
+            rid: (
+                {"status": "dead"} if r.dead
+                else {"partitioned": True, **r.service.health()}
+                if r.partitioned else r.service.health()
+            )
+            for rid, r in sorted(self.replicas.items())
+        }
+
+    def counters(self):
+        """Fleet-aggregate deterministic counters (summed)."""
+        total = {}
+        for r in self.replicas.values():
+            if r.dead:
+                continue
+            for k, v in r.service.counters.items():
+                total[k] = total.get(k, 0) + v
+        total["replicas_alive"] = sum(
+            1 for r in self.replicas.values() if not r.dead
+        )
+        return total
+
+    def shutdown(self):
+        for r in self.replicas.values():
+            if not r.dead:
+                r.service.shutdown()

@@ -1,0 +1,470 @@
+"""graftfleet's routing front: the consistent-hash ring and the thin
+router that speaks the existing JSON-line protocol.
+
+Two routers share one :class:`HashRing`:
+
+* :class:`FleetRouter` -- the in-process front over a
+  :class:`~hyperopt_tpu.serve.fleet.Fleet`: routes
+  ``create/ask/tell/best/close`` by study name, converts an observed
+  replica death (:class:`~hyperopt_tpu.exceptions.ReplicaDead`, or a
+  :class:`~hyperopt_tpu.distributed.faults.SimulatedCrash` escaping a
+  replica's batching loop) into fleet failover and retries the op
+  against the new owner with ``recover=True`` -- the exactly-once
+  delivery path -- and propagates typed
+  :class:`~hyperopt_tpu.exceptions.Overloaded` backpressure (honoring
+  ``retry_after``) to the client untouched;
+* :class:`RouterServer` -- the same policy over TCP: clients speak the
+  ordinary JSON-line protocol to the router, which forwards each
+  request to the backend replica that owns the study.  Backends are
+  plain ``hyperopt-tpu-serve`` processes sharing a ``--root``
+  directory (and fenced by ``--owner`` claim tokens); when one stops
+  answering, the router reroutes its studies to ring survivors, which
+  restore them from their WAL+bundle pairs via ``create_study``.
+
+Placement is a pure function of (guard fingerprint, study name, the
+alive replica set): deterministic across processes, runs, and
+PYTHONHASHSEED -- the ring hashes with blake2b, never ``hash()``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import logging
+import socket
+import threading
+
+from ..distributed.faults import REAL_FS, SimulatedCrash
+from ..exceptions import OwnershipLost, ReplicaDead
+
+logger = logging.getLogger(__name__)
+
+__all__ = ["HashRing", "FleetRouter", "RouterServer", "main"]
+
+
+def _h64(s):
+    """Stable 64-bit point on the ring (process-independent)."""
+    return int.from_bytes(
+        hashlib.blake2b(s.encode("utf-8"), digest_size=8).digest(), "big"
+    )
+
+
+class HashRing:
+    """Consistent hashing of study names over replica ids.
+
+    ``salt`` is the study-family guard fingerprint: two fleets serving
+    different spaces place the same study names differently, and the
+    placement of one fleet is reproducible anywhere the fingerprint
+    is.  ``vnodes`` virtual points per replica keep the load within a
+    small factor of even; adding or removing one replica moves only
+    the keys whose arcs it owned -- ~1/N of them -- and no key whose
+    owner survives ever moves (the stability contract
+    ``tests/test_fleet.py`` pins).
+    """
+
+    def __init__(self, nodes=(), salt="", vnodes=64):
+        self.salt = str(salt)
+        self.vnodes = int(vnodes)
+        self._points = []  # sorted [(hash, node), ...]
+        self.nodes = set()
+        for node in nodes:
+            self.add(node)
+
+    def add(self, node):
+        node = str(node)
+        if node in self.nodes:
+            return
+        self.nodes.add(node)
+        for v in range(self.vnodes):
+            point = (_h64(f"{self.salt}|node|{node}|{v}"), node)
+            bisect.insort(self._points, point)
+
+    def remove(self, node):
+        node = str(node)
+        if node not in self.nodes:
+            return
+        self.nodes.discard(node)
+        self._points = [p for p in self._points if p[1] != node]
+
+    def owner(self, key, exclude=()):
+        """The replica owning ``key`` -- the first ring point at or
+        after the key's hash (wrapping), skipping ``exclude``."""
+        exclude = set(exclude)
+        alive = self.nodes - exclude
+        if not alive:
+            raise ReplicaDead(
+                f"no live replica on the ring for key {key!r}"
+            )
+        h = _h64(f"{self.salt}|key|{key}")
+        i = bisect.bisect_left(self._points, (h, ""))
+        n = len(self._points)
+        for step in range(n):
+            node = self._points[(i + step) % n][1]
+            if node in alive:
+                return node
+        raise ReplicaDead(f"ring exhausted for key {key!r}")  # unreachable
+
+    def placement(self, keys, exclude=()):
+        """{key: owner} for a batch of keys (the stability tests and
+        the drain planner both want the full map)."""
+        return {k: self.owner(k, exclude=exclude) for k in keys}
+
+
+class FleetRouter:
+    """The in-process routing front over a Fleet.
+
+    One router instance is one "router process": its ``fs`` seam
+    carries the router-side crash point
+    (``fleet_router_after_forward_before_ack`` -- the replica executed
+    the op, the client never saw the ack); a crashed router is
+    "restarted" by constructing a new one over the same fleet, and the
+    client retries idempotently (tells dedup by tid, asks re-deliver
+    with ``recover=True``).
+
+    Failure policy: an op that finds its replica dead (or watches it
+    die -- ``SimulatedCrash`` out of the replica's own batching loop)
+    triggers :meth:`~hyperopt_tpu.serve.fleet.Fleet.failover` and ONE
+    retry against the new owner; asks retry with ``recover=True`` so a
+    suggestion the dead replica logged or served is re-delivered
+    bitwise instead of burning a fresh seed.  Typed ``Overloaded``
+    (draining / queue-full / circuit-open) passes through to the
+    client -- backpressure is the client's signal, not the router's to
+    swallow.
+    """
+
+    def __init__(self, fleet, fs=REAL_FS):
+        self.fleet = fleet
+        self.fs = fs
+
+    # -- routing -----------------------------------------------------------
+    def _forward(self, name, op, recover_op=None):
+        """Run ``op(replica)`` on the study's owner; on replica death
+        (observed before the call -- the failure detector -- or DURING
+        it, a ``SimulatedCrash`` escaping the batching loop) fail the
+        replica over and retry once on the new owner (``recover_op``
+        when given)."""
+        rid = self.fleet.route(name)
+        replica = self.fleet.replicas[rid]
+        try:
+            if replica.dead or replica.partitioned:
+                raise ReplicaDead(f"replica {rid!r} is unreachable")
+            return op(replica)
+        except (ReplicaDead, SimulatedCrash):
+            self.fleet.mark_dead(rid)
+            self.fleet.failover(rid)
+            retry = recover_op or op
+            return retry(self.fleet.replicas[self.fleet.route(name)])
+
+    def _ack(self):
+        self.fs.crashpoint("fleet_router_after_forward_before_ack")
+
+    # -- the client API ----------------------------------------------------
+    def create_study(self, name, seed=0):
+        self.fleet.register(name)
+        out = self._forward(
+            name, lambda r: r.open_study(name, seed=seed).name
+        )
+        self._ack()
+        return out
+
+    def ask(self, name, timeout=60.0, recover=False):
+        out = self._forward(
+            name,
+            lambda r: r.ask(name, timeout=timeout, recover=recover),
+            recover_op=lambda r: r.ask(name, timeout=timeout, recover=True),
+        )
+        self._ack()
+        return out
+
+    def tell(self, name, tid, loss, vals=None):
+        self._forward(name, lambda r: r.tell(name, tid, loss, vals=vals))
+        self._ack()
+
+    def best(self, name):
+        out = self._forward(name, lambda r: r.best(name))
+        self._ack()
+        return out
+
+    def close_study(self, name):
+        self._forward(name, lambda r: r.close_study(name))
+        self.fleet.unregister(name)
+        self._ack()
+
+    def ask_batch(self, names, timeout=60.0):
+        """Fleet-throughput path: group asks by owning replica, submit
+        each group async (ONE coalesced dispatch per replica per
+        round), then gather.  Returns {name: (tid, vals)}; any name
+        whose replica died mid-round is retried through the failover
+        path with ``recover=True``."""
+        by_replica = {}
+        for name in names:
+            by_replica.setdefault(self.fleet.route(name), []).append(name)
+        out, retry = {}, []
+        for rid, group in by_replica.items():
+            replica = self.fleet.replicas[rid]
+            if replica.dead or replica.partitioned:
+                retry.extend(group)
+                continue
+            try:
+                futs = [(n, replica.ask_async(n)) for n in group]
+                replica.pump_until(
+                    [f for _, f in futs], timeout=timeout
+                )
+                for n, f in futs:
+                    out[n] = f.result(timeout=0)
+            except (ReplicaDead, SimulatedCrash, OwnershipLost):
+                self.fleet.mark_dead(rid)
+                self.fleet.failover(rid)
+                retry.extend(n for n in group if n not in out)
+        for n in retry:
+            out[n] = self.ask(n, timeout=timeout, recover=True)
+        self._ack()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# the TCP router: same policy, JSON-line protocol on both sides
+# ---------------------------------------------------------------------------
+
+
+class _Backend:
+    """One replica endpoint.  Connections are opened per handler
+    thread (stored on the caller), so the backend object itself holds
+    only the address and its liveness flag."""
+
+    def __init__(self, rid, host, port):
+        self.rid = rid
+        self.host = host
+        self.port = int(port)
+
+    def connect(self, timeout=10.0):
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=timeout
+        )
+        return sock.makefile("rwb")
+
+
+class RouterServer:
+    """The TCP routing front: JSON-line requests in, forwarded to the
+    owning backend, JSON-line replies out.
+
+    Every client connection gets its own handler thread with its OWN
+    backend connections (no shared sockets, no lock around I/O); the
+    only shared mutable state is the dead-backend set, mutated under a
+    small lock with nothing blocking inside.  A backend that fails a
+    forward is marked dead, the ring excludes it, and the request is
+    retried on the new owner -- ``create_study(takeover=True)`` first
+    when the study is not yet resident there (the shared ``--root``
+    restores it), then the original op with ``recover`` set for asks.
+    """
+
+    def __init__(self, backends, salt="", vnodes=64):
+        self.backends = {b.rid: b for b in backends}
+        self.ring = HashRing(self.backends, salt=salt, vnodes=vnodes)
+        self._lock = threading.Lock()
+        self._dead = set()
+
+    def _mark_dead(self, rid):
+        with self._lock:
+            self._dead.add(rid)
+
+    def _alive_excluded(self):
+        with self._lock:
+            return frozenset(self._dead)
+
+    def _rpc(self, conns, rid, req, timeout=30.0):
+        f = conns.get(rid)
+        if f is None:
+            f = conns[rid] = self.backends[rid].connect(timeout=timeout)
+        f.write((json.dumps(req) + "\n").encode("utf-8"))
+        f.flush()
+        line = f.readline()
+        if not line:
+            raise ConnectionError(f"backend {rid} closed the connection")
+        return json.loads(line)
+
+    def handle_request(self, req, conns):
+        """Route one request; ``conns`` is the calling thread's
+        backend-connection cache ({rid: file}).  Fleet-level ops
+        (health/ready/studies) aggregate over live backends."""
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True, "router": True}
+        if op in ("health", "ready", "studies"):
+            return self._aggregate(op, conns)
+        name = req.get("name") or req.get("study")
+        if not name:
+            return {"ok": False, "error": f"op {op!r} needs a study name"}
+        last_exc = None
+        for _attempt in range(1 + len(self.backends)):
+            try:
+                rid = self.ring.owner(name, exclude=self._alive_excluded())
+            except ReplicaDead as e:
+                return {"ok": False, "error": str(e),
+                        "error_type": "ReplicaDead"}
+            try:
+                reply = self._rpc(conns, rid, req)
+                if (
+                    not reply.get("ok")
+                    and reply.get("error_type") == "UnknownStudy"
+                    and op != "create_study"
+                ):
+                    # failover adoption: the ring owner has not loaded
+                    # this study yet -- restore it from the shared
+                    # root, then retry the op on the same backend
+                    adopt = self._rpc(conns, rid, {
+                        "op": "create_study", "name": name,
+                        "takeover": True,
+                    })
+                    if adopt.get("ok"):
+                        if op == "ask":
+                            req = dict(req, recover=True)
+                        reply = self._rpc(conns, rid, req)
+                return reply
+            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+                last_exc = e
+                conns.pop(rid, None)
+                self._mark_dead(rid)
+                logger.warning(
+                    "router: backend %s unreachable (%s); failing over",
+                    rid, e,
+                )
+                if op == "ask":
+                    req = dict(req, recover=True)
+                continue
+        return {
+            "ok": False, "error_type": "ReplicaDead",
+            "error": f"no backend could serve {name!r}: {last_exc}",
+        }
+
+    def _aggregate(self, op, conns):
+        replies = {}
+        for rid in self.backends:
+            if rid in self._alive_excluded():
+                continue
+            try:
+                replies[rid] = self._rpc(conns, rid, {"op": op})
+            except (OSError, ConnectionError) as e:
+                conns.pop(rid, None)
+                replies[rid] = {"ok": False, "error": str(e)}
+        if op == "ready":
+            return {
+                "ok": True,
+                "ready": any(
+                    r.get("ready") for r in replies.values()
+                ),
+                "replicas": {
+                    rid: bool(r.get("ready")) for rid, r in replies.items()
+                },
+            }
+        if op == "studies":
+            studies = sorted({
+                s for r in replies.values() for s in r.get("studies", [])
+            })
+            return {"ok": True, "studies": studies}
+        return {"ok": True, "replicas": replies}
+
+    def serve_forever(self, host="127.0.0.1", port=0):
+        """Bind the JSON-line front; returns the (not yet serving)
+        ``ThreadingTCPServer`` exactly like ``service.serve_forever``."""
+        import socketserver
+
+        router = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                conns = {}  # this thread's backend connections
+                try:
+                    for raw in self.rfile:
+                        line = raw.strip()
+                        if not line:
+                            continue
+                        try:
+                            reply = router.handle_request(
+                                json.loads(line), conns
+                            )
+                        except Exception as e:  # one bad request must
+                            # not kill the connection
+                            reply = {
+                                "ok": False,
+                                "error": f"{type(e).__name__}: {e}",
+                            }
+                        self.wfile.write(
+                            (json.dumps(reply) + "\n").encode("utf-8")
+                        )
+                        self.wfile.flush()
+                finally:
+                    for f in conns.values():
+                        try:
+                            f.close()
+                        except OSError:
+                            pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        return Server((host, int(port)), Handler)
+
+
+def main(argv=None):
+    """``hyperopt-tpu-router``: the fleet's routing front as a process.
+
+    Example (two replicas sharing a durability root)::
+
+        hyperopt-tpu-serve --space my.mod:space --root /shared/studies \\
+            --owner r0 --port 7070 &
+        hyperopt-tpu-serve --space my.mod:space --root /shared/studies \\
+            --owner r1 --port 7071 &
+        hyperopt-tpu-router --salt my-space \\
+            --backend r0=127.0.0.1:7070 --backend r1=127.0.0.1:7071
+    """
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="hyperopt-tpu-router",
+        description="consistent-hash router for a hyperopt-tpu serve "
+        "fleet: speaks the JSON-line protocol, routes by study name, "
+        "fails studies over to ring survivors (which restore from the "
+        "shared --root) when a replica dies",
+    )
+    parser.add_argument(
+        "--backend", action="append", required=True, metavar="ID=HOST:PORT",
+        help="one replica endpoint (repeatable)",
+    )
+    parser.add_argument(
+        "--salt", default="",
+        help="ring salt -- use the fleet's space/guard fingerprint so "
+        "placement matches any other router over the same fleet",
+    )
+    parser.add_argument("--vnodes", type=int, default=64)
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=7076)
+    args = parser.parse_args(argv)
+
+    backends = []
+    for spec in args.backend:
+        rid, _, addr = spec.partition("=")
+        host, _, port = addr.rpartition(":")
+        if not (rid and host and port):
+            raise SystemExit(f"--backend must be ID=HOST:PORT, got {spec!r}")
+        backends.append(_Backend(rid, host, int(port)))
+    router = RouterServer(backends, salt=args.salt, vnodes=args.vnodes)
+    server = router.serve_forever(host=args.host, port=args.port)
+    host, port = server.server_address[:2]
+    print(
+        f"hyperopt-tpu-router listening on {host}:{port} "
+        f"({len(backends)} backend(s))", flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

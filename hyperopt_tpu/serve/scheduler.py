@@ -145,7 +145,9 @@ class ServeStudy:
         self.n_asks = 0
         self.n_tells = 0
         self.outstanding = {}  # tid -> served vals (awaiting their tell)
+        self.pending_asks = {}  # tid -> seed: WAL-logged, never served
         self.persist = None  # durability hooks (service wires them)
+        self.claim = None  # fleet ownership token (service wires it)
 
     def best(self):
         """(loss, vals) of the best finite completed trial, or None --
@@ -308,6 +310,7 @@ class BatchScheduler:
 
         # graftguard state
         self.draining = False
+        self.drain_deadline = None  # absolute perf_counter() instant
         self.circuit_open = False
         self._round_failures = 0  # CONSECUTIVE failed dispatch rounds
         self._queued_per_study = collections.Counter()
@@ -429,6 +432,7 @@ class BatchScheduler:
             self.fs.crashpoint("serve_after_wal_before_dispatch")
             self._apply_tell(study, tid, vals, loss)
             study.outstanding.pop(tid, None)
+            study.pending_asks.pop(int(tid), None)
 
     def _apply_tell(self, study, tid, vals, loss):
         """Host-side tell application (shared with WAL replay, which
@@ -470,6 +474,18 @@ class BatchScheduler:
         p50 = lats[len(lats) // 2] if lats else 0.010
         return round(rounds * p50, 6)
 
+    def drain_retry_after(self):
+        """The CONCRETE back-off hint a ``draining`` refusal carries:
+        time left until the drain deadline (when migration/handoff will
+        have finished and the router has repointed), floored at one
+        queue-drain estimate so a client never hot-loops a replica
+        whose deadline just passed."""
+        floor = self.retry_after()
+        if self.drain_deadline is None:
+            return floor
+        left = self.drain_deadline - time.perf_counter()
+        return round(max(left, floor, 0.001), 6)
+
     def _dec_queue(self, req):
         """A request left the queue for good (picked, shed, dropped,
         or drained): release its per-study fairness budget."""
@@ -480,11 +496,18 @@ class BatchScheduler:
         else:
             c[name] -= 1
 
-    def submit_ask(self, study, deadline=None):  # graftlint: disable=GL503 the flush-only (no-fsync) ask record must stay ordered with the seed draw and tid allocation it snapshots -- the restored-cursor bitwise contract; the next tell's fsync is its barrier
+    def submit_ask(self, study, deadline=None, replay=None):  # graftlint: disable=GL503 the flush-only (no-fsync) ask record must stay ordered with the seed draw and tid allocation it snapshots -- the restored-cursor bitwise contract; the next tell's fsync is its barrier
         """Queue one ask; returns the queued request (``.tid`` /
         ``.future``).  The per-ask seed is drawn HERE, from the study's
         own stream -- the batching order downstream can no longer
         affect the suggestion.
+
+        ``replay=(tid, seed)`` re-queues a restored in-flight ask (a
+        WAL ``ask`` record with no ``tell`` -- the crashed owner logged
+        it but never served or never acked it): the logged seed is used
+        verbatim and nothing is drawn or re-logged, so the re-served
+        suggestion is bitwise what the crashed replica would have
+        served.  Admission control still applies.
 
         Admission control runs BEFORE the seed draw: a refused submit
         (:class:`Overloaded` / :class:`DeadlineExpired` /
@@ -512,7 +535,8 @@ class BatchScheduler:
                 self.shed_count += 1
                 raise Overloaded(
                     "service is draining for shutdown; retry against "
-                    "another replica", reason="draining",
+                    "another replica",
+                    retry_after=self.drain_retry_after(), reason="draining",
                 )
             if self.circuit_open:
                 self.shed_count += 1
@@ -546,13 +570,20 @@ class BatchScheduler:
                     retry_after=self.retry_after(),
                     reason="study_queue_cap",
                 )
-            seed = int(study.rstate.integers(2**31 - 1))
-            tid = study.next_tid
-            study.next_tid = tid + 1
-            study.n_asks += 1
-            self.admitted_count += 1
-            if study.persist is not None:
-                study.persist.log_ask(tid, seed, study.rstate)
+            if replay is not None:
+                # a restored in-flight ask: seed/tid come from its WAL
+                # record (already durable -- nothing to draw or re-log)
+                tid, seed = int(replay[0]), int(replay[1])
+                study.next_tid = max(study.next_tid, tid + 1)
+                self.admitted_count += 1
+            else:
+                seed = int(study.rstate.integers(2**31 - 1))
+                tid = study.next_tid
+                study.next_tid = tid + 1
+                study.n_asks += 1
+                self.admitted_count += 1
+                if study.persist is not None:
+                    study.persist.log_ask(tid, seed, study.rstate)
             req = _AskRequest(study, tid, seed, deadline=deadline)
             self._asks.append(req)
             self._queued_per_study[study.name] += 1
@@ -939,6 +970,7 @@ class BatchScheduler:
             if st.persist is not None:
                 st.persist.log_served(req.tid, vals)
             st.outstanding[req.tid] = vals
+            st.pending_asks.pop(req.tid, None)  # replayed ask served
             self.ask_latencies.append(now - req.t_submit)
             results.append((req, vals))
         # acks last: a crash above leaves every pick un-acked and
@@ -1005,13 +1037,19 @@ class BatchScheduler:
             )
             self._thread.start()
 
-    def drain(self):
+    def drain(self, timeout=None):
         """Enter draining mode (rolling-restart protocol): new submits
         are refused with ``Overloaded(reason="draining")`` while the
         already-queued asks keep being served; call :meth:`stop` once
-        the queue is empty."""
+        the queue is empty.  ``timeout`` (seconds) publishes a drain
+        DEADLINE: every draining refusal then carries the time left
+        until it as a concrete ``retry_after``, so routers and clients
+        back off for exactly the handoff window instead of hot-looping
+        the draining replica."""
         with self._lock:
             self.draining = True
+            if timeout is not None:
+                self.drain_deadline = time.perf_counter() + float(timeout)
             self._cond.notify_all()
 
     def stop(self):

@@ -99,8 +99,15 @@ class StudyPersistence:
         self.wal = TellWAL(base + ".wal", fs=fs, guard=guard)
         self._tells_since_snap = 0
 
+    def _snap_exists(self):
+        from ..distributed import _common
+
+        return _common.with_retries(
+            lambda: self.fs.exists(self.snap_path), label="snap exists"
+        )
+
     def exists(self):
-        return self.wal.exists() or self.fs.exists(self.snap_path)
+        return self.wal.exists() or self._snap_exists()
 
     # -- write-ahead records ----------------------------------------------
     def log_open(self, seed):
@@ -159,6 +166,11 @@ class StudyPersistence:
             "outstanding": {
                 int(t): dict(v) for t, v in study.outstanding.items()
             },
+            # restored-but-not-yet-re-served in-flight asks survive a
+            # snapshot that compacts their WAL records away
+            "pending_asks": {
+                int(t): int(s) for t, s in study.pending_asks.items()
+            },
         }
         _common.with_retries(
             lambda: durable_pickle(bundle, self.snap_path, fs=self.fs),
@@ -184,7 +196,7 @@ class StudyPersistence:
         if not self.exists():
             return None
         bundle = None
-        if self.fs.exists(self.snap_path):
+        if self._snap_exists():
             bundle = load_pickle_guarded(
                 self.snap_path, fs=self.fs, what="study snapshot"
             )
@@ -210,6 +222,10 @@ class StudyPersistence:
                 int(t): dict(v)
                 for t, v in bundle.get("outstanding", {}).items()
             }
+            study.pending_asks = {
+                int(t): int(s)
+                for t, s in bundle.get("pending_asks", {}).items()
+            }
         records = self.wal.replay() if self.wal.exists() else []
         last_cursor = None
         for rec in records:
@@ -219,10 +235,17 @@ class StudyPersistence:
                 if bundle is None:
                     study.rstate = np.random.default_rng(study.seed)
             elif kind == "ask":
-                study.next_tid = max(study.next_tid, int(rec["tid"]) + 1)
+                tid = int(rec["tid"])
+                study.next_tid = max(study.next_tid, tid + 1)
                 last_cursor = rec["rstate"]
+                # in-flight until a served/tell record supersedes it:
+                # the logged seed lets the new owner re-serve the ask
+                # bitwise (suggestion = f(seed, history))
+                study.pending_asks[tid] = int(rec["seed"])
             elif kind == "served":
-                study.outstanding[int(rec["tid"])] = dict(rec["vals"])
+                tid = int(rec["tid"])
+                study.outstanding[tid] = dict(rec["vals"])
+                study.pending_asks.pop(tid, None)
             elif kind == "tell":
                 tid = int(rec["tid"])
                 buf = study.buf
@@ -231,6 +254,7 @@ class StudyPersistence:
                     study.n_tells += 1
                 study.next_tid = max(study.next_tid, tid + 1)
                 study.outstanding.pop(tid, None)
+                study.pending_asks.pop(tid, None)
         if last_cursor is not None:
             study.rstate = decode_rstate(last_cursor)
         study.dirty = True
@@ -262,7 +286,7 @@ class StudyHandle:
         submit."""
         return self._service._ask_async(self._study)
 
-    def ask(self, timeout=60.0):
+    def ask(self, timeout=60.0, recover=False):
         """One suggestion, blocking until its batch is served.
 
         ``timeout`` doubles as the CLIENT DEADLINE the scheduler
@@ -270,7 +294,21 @@ class StudyHandle:
         from the queue (it will never consume a dispatch slot) and
         raises :class:`~hyperopt_tpu.exceptions.DeadlineExpired`; one
         already picked into an in-flight dispatch is awaited a short
-        grace period instead."""
+        grace period instead.
+
+        ``recover=True`` is the retrying client's declaration that its
+        PREVIOUS ask's reply was lost (replica failover, router crash
+        between forward and ack): the smallest undelivered suggestion
+        is re-served instead of drawing a fresh one -- a restored
+        in-flight ask re-dispatches with its WAL-logged seed (bitwise
+        what the crashed owner would have served), a served-but-unacked
+        one returns its recorded vals directly.  With one logical
+        client per study this gives exactly-once delivery; concurrent
+        clients of one study should not pass it casually."""
+        if recover:
+            got = self._service._recover_ask(self._study, timeout)
+            if got is not None:
+                return got
         req = self._service._submit(self._study, timeout=timeout)
         return self._service._await(req, timeout)
 
@@ -317,7 +355,7 @@ class SuggestService:
                  max_wait_ms=2.0, n_startup_jobs=20, background=True,
                  fs=REAL_FS, snapshot_cadence=256, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
-                 finite_check=True, mesh=None, **algo_kw):
+                 finite_check=True, mesh=None, owner=None, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -325,6 +363,12 @@ class SuggestService:
         self.snapshot_cadence = int(snapshot_cadence)
         self._guard = _study_guard(algo, space)
         self._background = bool(background)
+        # fleet identity: with an owner id AND a (shared) root, every
+        # study is fenced by a per-study claim/epoch token -- a replica
+        # that lost its claim (failover, migration) gets OwnershipLost
+        # instead of double-serving (graftfleet; the distributed/
+        # claim-token idiom at the study granularity)
+        self.owner = None if owner is None else str(owner)
         self._lock = threading.RLock()
         self._handles = {}
         self.scheduler = BatchScheduler(
@@ -339,8 +383,14 @@ class SuggestService:
             self.scheduler.start()
 
     # -- tenancy -----------------------------------------------------------
-    def create_study(self, name, seed=0):  # graftlint: disable=GL503 the durable open record must be atomic with the registry insert -- two racing creates of one name must serialize restore-or-create, and an unrecorded-but-registered study would lose its seed on crash
-        """Open (or re-attach to, or restore) a study by name."""
+    def create_study(self, name, seed=0, takeover=False):  # graftlint: disable=GL503 the durable open record must be atomic with the registry insert -- two racing creates of one name must serialize restore-or-create, and an unrecorded-but-registered study would lose its seed on crash
+        """Open (or re-attach to, or restore) a study by name.
+
+        With a fleet identity (``owner=``) the study's claim token is
+        acquired first: a study live-owned by another replica is
+        refused with :class:`~hyperopt_tpu.exceptions.OwnershipLost`
+        unless ``takeover=True`` (the failover/migration path, which
+        bumps the claim epoch and fences the previous owner out)."""
         if not _NAME_RE.fullmatch(name):
             raise ValueError(
                 f"study name {name!r} must match {_NAME_RE.pattern}"
@@ -348,6 +398,14 @@ class SuggestService:
         with self._lock:
             if name in self._handles:
                 return self._handles[name]
+            claim = None
+            if self.owner is not None and self.root is not None:
+                from .fleet import StudyClaim
+
+                claim = StudyClaim.acquire(
+                    self.root, name, self.owner, fs=self.fs,
+                    takeover=takeover,
+                )
             persist = None
             study = None
             if self.root is not None:
@@ -361,6 +419,7 @@ class SuggestService:
                 if persist is not None:
                     persist.log_open(seed)
             study.persist = persist
+            study.claim = claim
             self.scheduler.open_study(name, seed, study=study)
             handle = StudyHandle(self, study)
             self._handles[name] = handle
@@ -380,23 +439,77 @@ class SuggestService:
         if study.persist is not None:
             study.persist.maybe_snapshot(study, force=True)
             study.persist.close()
+        if study.claim is not None:
+            study.claim.release()
+
+    def handoff_study(self, name):
+        """The migration SOURCE half of the drain protocol (graftfleet):
+        publish a final snapshot while still owning the study, then --
+        past the ``fleet_migrate_after_snapshot_before_handoff`` crash
+        window, where an aborted migration leaves this replica owning
+        and serving -- unregister, close the WAL, and release the
+        claim so the target can adopt with a clean epoch bump.  The
+        study's artifacts (WAL + bundle + released claim) ARE the
+        handoff: nothing is copied, the target restores in place."""
+        with self._lock:
+            handle = self._handles.get(name)
+            if handle is None:
+                raise ValueError(f"study {name!r} is not open here")
+            study = handle._study
+        if study.persist is not None:
+            study.persist.maybe_snapshot(study, force=True)
+        self.fs.crashpoint("fleet_migrate_after_snapshot_before_handoff")
+        with self._lock:
+            self._handles.pop(name, None)
+            self.scheduler.close_study(name)
+        if study.persist is not None:
+            study.persist.close()
+        if study.claim is not None:
+            study.claim.release()
+        return study
 
     def studies(self):
         with self._lock:
             return sorted(self._handles)
 
     # -- the handle's plumbing ---------------------------------------------
+    def _fence(self, study):
+        """Ownership fence (fleet): refuse to act on a study whose
+        claim this replica no longer holds.  A no-op without claims."""
+        if study.claim is not None:
+            study.claim.ensure_live()
+
     def _ask_async(self, study):
+        self._fence(study)
         return self.scheduler.submit_ask(study).future
 
-    def _submit(self, study, timeout=None):
+    def _submit(self, study, timeout=None, replay=None):
         import time as _time
 
+        self._fence(study)
         deadline = (
             None if timeout is None
             else _time.perf_counter() + float(timeout)
         )
-        return self.scheduler.submit_ask(study, deadline=deadline)
+        return self.scheduler.submit_ask(
+            study, deadline=deadline, replay=replay
+        )
+
+    def _recover_ask(self, study, timeout):
+        """Re-serve the smallest undelivered suggestion for a retrying
+        client, or None when nothing is recoverable (fresh ask)."""
+        self._fence(study)
+        cand = sorted(set(study.pending_asks) | set(study.outstanding))
+        if not cand:
+            return None
+        tid = cand[0]
+        if tid in study.outstanding:
+            self._fence(study)
+            return tid, dict(study.outstanding[tid])
+        req = self._submit(
+            study, timeout=timeout, replay=(tid, study.pending_asks[tid])
+        )
+        return self._await(req, timeout)
 
     def _await(self, req, timeout):
         """Block on one admitted ask under its client deadline: pump
@@ -417,10 +530,14 @@ class SuggestService:
                         break
                     _time.sleep(0.001)
             if fut.done():
-                return fut.result(timeout=0)
+                out = fut.result(timeout=0)
+                self._fence(req.study)  # a zombie must not deliver
+                return out
         else:
             try:
-                return fut.result(timeout=timeout)
+                out = fut.result(timeout=timeout)
+                self._fence(req.study)
+                return out
             except _FutTimeout:
                 pass
         if self.scheduler.drop_request(req):
@@ -439,6 +556,10 @@ class SuggestService:
                 f"for tid {tid}; pass vals= explicitly (e.g. when "
                 "re-telling work a crashed service never acked)"
             )
+        # the ownership fence sits BEFORE the WAL append: a replica
+        # whose claim was taken over must not write to a log the new
+        # owner is appending to (the double-serve hazard)
+        self._fence(study)
         self.scheduler.tell(study, tid, vals, loss)
         if study.persist is not None:
             study.persist.maybe_snapshot(study)
@@ -495,6 +616,7 @@ class SuggestService:
         return {
             "status": status,
             "ready": self.ready(),
+            "owner": self.owner,
             "studies": n_studies,
             "queue_depth": len(s._asks),
             "max_queue": s.max_queue,
@@ -502,13 +624,19 @@ class SuggestService:
             "counters": self.counters,
         }
 
-    def drain(self, timeout=30.0):
+    def drain(self, timeout=30.0, block=True):
         """Rolling-restart protocol: refuse new asks with
-        ``Overloaded(reason="draining")``, serve what is already
-        queued, then shut down (snapshotting every durable study)."""
+        ``Overloaded(reason="draining", retry_after=<time left until
+        the drain deadline>)``, serve what is already queued, then shut
+        down (snapshotting every durable study).  ``block=False`` only
+        ENTERS draining mode (publishing the deadline) and returns --
+        the fleet's drain-migrate protocol serves the queue, hands the
+        studies off, and shuts the replica down itself."""
         import time as _time
 
-        self.scheduler.drain()
+        self.scheduler.drain(timeout=timeout)
+        if not block:
+            return
         deadline = _time.perf_counter() + float(timeout)
         while self.scheduler._asks and _time.perf_counter() < deadline:
             if not self._background:
@@ -542,7 +670,14 @@ def _serve_error_reply(e):
         "error_type": type(e).__name__,
     }
     if isinstance(e, Overloaded):
-        reply["retry_after"] = e.retry_after
+        ra = e.retry_after
+        if ra is None:
+            # the wire contract is a CONCRETE back-off: a router that
+            # sees null would hot-loop a draining replica (the
+            # scheduler derives the real value from its drain
+            # deadline; this floor only covers hand-built Overloadeds)
+            ra = 0.05
+        reply["retry_after"] = ra
         reply["reason"] = e.reason
     return reply
 
@@ -558,18 +693,33 @@ def _handle_request(service, req):
             return {"ok": True, "ready": service.ready()}
         if op == "create_study":
             h = service.create_study(
-                req["name"], seed=int(req.get("seed", 0))
+                req["name"], seed=int(req.get("seed", 0)),
+                takeover=bool(req.get("takeover", False)),
             )
             return {"ok": True, "study": h.name, "n_tells": h.n_tells}
         if op == "studies":
             return {"ok": True, "studies": service.studies()}
+        if op == "drain":
+            service.drain(
+                timeout=float(req.get("timeout", 30.0)), block=False
+            )
+            return {
+                "ok": True, "draining": True,
+                "retry_after": service.scheduler.drain_retry_after(),
+            }
         name = req.get("study")
         with service._lock:
             handle = service._handles.get(name)
         if handle is None:
-            return {"ok": False, "error": f"unknown study {name!r}"}
+            return {
+                "ok": False, "error": f"unknown study {name!r}",
+                "error_type": "UnknownStudy",
+            }
         if op == "ask":
-            tid, vals = handle.ask(timeout=float(req.get("timeout", 60.0)))
+            tid, vals = handle.ask(
+                timeout=float(req.get("timeout", 60.0)),
+                recover=bool(req.get("recover", False)),
+            )
             return {"ok": True, "tid": tid, "vals": vals}
         if op == "tell":
             handle.tell(
@@ -581,6 +731,9 @@ def _handle_request(service, req):
         if op == "close_study":
             handle.close()
             return {"ok": True}
+        if op == "handoff_study":
+            service.handoff_study(name)
+            return {"ok": True, "handed_off": name}
         return {"ok": False, "error": f"unknown op {op!r}"}
     except ServeError as e:
         return _serve_error_reply(e)
@@ -670,6 +823,13 @@ def main(argv=None):
         "(graftmesh; 0 = single-device engine, -1 = every visible "
         "device)",
     )
+    parser.add_argument(
+        "--owner", default=None,
+        help="fleet replica identity: with --root on a SHARED "
+        "directory, per-study claim/epoch tokens fence this replica "
+        "against double-serving a study another replica took over "
+        "(graftfleet; front replicas with hyperopt-tpu-router)",
+    )
     args = parser.parse_args(argv)
 
     mesh = None
@@ -684,6 +844,7 @@ def main(argv=None):
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         n_startup_jobs=args.n_startup_jobs, max_queue=args.max_queue,
         dispatch_timeout=args.dispatch_timeout or None, mesh=mesh,
+        owner=args.owner,
     )
     server = serve_forever(service, host=args.host, port=args.port)
     host, port = server.server_address[:2]

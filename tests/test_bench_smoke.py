@@ -122,6 +122,12 @@ def test_bench_prints_one_json_line():
     assert 0 < d["serve_shed_rate"] < 1
     assert d["serve_quarantine_count"] == 3
     assert d["serve_watchdog_recovery_ms"] > 0
+    # round-18 graftfleet rows: router-aggregated throughput, the
+    # replica-kill window's p99, and measured failover recovery
+    assert d["fleet_studies_per_sec"] > 0
+    assert d["fleet_ask_p99_ms_failover"] > 0
+    assert d["fleet_recovery_ms"] > 0
+    assert d["fleet_replicas"] == 3
     # round-17: graftmesh rows -- per-mesh-shape throughput of the
     # study-sharded serve engine and the shard_map PBT schedule, keyed
     # by mesh shape, plus the scaling-efficiency diagnostic per family

@@ -1,0 +1,389 @@
+"""graftfleet unit coverage: the consistent-hash ring's stability
+contract, claim/epoch fencing, the drain-deadline backpressure
+satellite, ``fsck --serve``, and the TCP router front (ISSUE 13).
+
+The fleet-level chaos scenarios (replica kill, router crash, migration
+crash, partition/zombie) live in ``tests/test_fleet_chaos.py``.
+"""
+
+import json
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.exceptions import Overloaded, OwnershipLost
+from hyperopt_tpu.serve import HashRing, SuggestService
+from hyperopt_tpu.serve.fleet import StudyClaim
+from hyperopt_tpu.serve.service import _serve_error_reply
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+
+KEYS = [f"study-{i:04d}" for i in range(2000)]
+NODES = [f"r{i}" for i in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# consistent-hash stability (satellite: pinned movement bound +
+# cross-process determinism)
+# ---------------------------------------------------------------------------
+
+
+def test_ring_remove_moves_only_the_removed_nodes_keys():
+    """The exact stability invariant: removing a replica reassigns the
+    keys IT owned and no others -- survivors' keys never move."""
+    ring = HashRing(NODES, salt="fp", vnodes=64)
+    before = ring.placement(KEYS)
+    ring.remove("r2")
+    after = ring.placement(KEYS)
+    for k in KEYS:
+        if before[k] != "r2":
+            assert after[k] == before[k], k
+        else:
+            assert after[k] != "r2"
+    moved = sum(1 for k in KEYS if before[k] != after[k])
+    assert moved == sum(1 for k in KEYS if before[k] == "r2")
+    # ~1/N of the keys belonged to the removed node (pinned bound:
+    # within 2x of even share either way)
+    assert len(KEYS) / (2 * len(NODES)) <= moved
+    assert moved <= 2 * len(KEYS) / len(NODES)
+
+
+def test_ring_add_moves_bounded_fraction_all_toward_new_node():
+    ring = HashRing(NODES, salt="fp", vnodes=64)
+    before = ring.placement(KEYS)
+    ring.add("r5")
+    after = ring.placement(KEYS)
+    moved = [k for k in KEYS if before[k] != after[k]]
+    assert moved, "a new replica must take some keys"
+    assert all(after[k] == "r5" for k in moved)
+    # expected share 1/(N+1); pin the 2x bound
+    assert len(moved) <= 2 * len(KEYS) / (len(NODES) + 1)
+
+
+def test_ring_balance_and_salt_sensitivity():
+    ring = HashRing(NODES, salt="fp", vnodes=64)
+    loads = {n: 0 for n in NODES}
+    for k in KEYS:
+        loads[ring.owner(k)] += 1
+    mean = len(KEYS) / len(NODES)
+    assert max(loads.values()) <= 2 * mean
+    assert min(loads.values()) >= mean / 3
+    # a different guard fingerprint places differently (the salt is
+    # load-bearing, not decoration)
+    other = HashRing(NODES, salt="other-fp", vnodes=64)
+    assert any(
+        ring.owner(k) != other.owner(k) for k in KEYS[:200]
+    )
+
+
+def test_ring_placement_deterministic_across_processes():
+    """Placement must not depend on PYTHONHASHSEED or process state:
+    a subprocess computes the identical map."""
+    ring = HashRing(NODES, salt="fp", vnodes=32)
+    keys = KEYS[:100]
+    here = ring.placement(keys)
+    code = (
+        "import json, sys\n"
+        "from hyperopt_tpu.serve import HashRing\n"
+        f"ring = HashRing({NODES!r}, salt='fp', vnodes=32)\n"
+        f"print(json.dumps(ring.placement({keys!r})))\n"
+    )
+    env = dict(os.environ, PYTHONHASHSEED="123",
+               PYTHONPATH=os.path.dirname(os.path.dirname(
+                   os.path.abspath(__file__))))
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert json.loads(out.stdout) == here
+
+
+# ---------------------------------------------------------------------------
+# claim/epoch tokens
+# ---------------------------------------------------------------------------
+
+
+def test_claim_acquire_fence_takeover_release(tmp_path):
+    root = str(tmp_path)
+    c0 = StudyClaim.acquire(root, "s", "r0")
+    assert c0.is_live() and c0.epoch == 0
+    # a second replica cannot steal without the takeover authority
+    with pytest.raises(OwnershipLost):
+        StudyClaim.acquire(root, "s", "r1")
+    assert c0.is_live()
+    # failover takeover bumps the epoch and fences r0 out
+    c1 = StudyClaim.acquire(root, "s", "r1", takeover=True)
+    assert c1.epoch == c0.epoch + 1
+    assert not c0.is_live()
+    with pytest.raises(OwnershipLost):
+        c0.ensure_live()
+    # release is a tombstone (epoch stays monotone), after which an
+    # ordinary acquire succeeds without takeover
+    c1.release()
+    assert not c1.is_live()
+    c2 = StudyClaim.acquire(root, "s", "r2")
+    assert c2.epoch > c1.epoch
+    # releasing a stale claim is a no-op, never a theft
+    c0.release()
+    assert c2.is_live()
+
+
+# ---------------------------------------------------------------------------
+# satellite: draining refusals carry a concrete retry_after
+# ---------------------------------------------------------------------------
+
+
+def test_draining_overloaded_carries_deadline_retry_after():
+    svc = SuggestService(
+        SPACE, background=False, max_batch=4, n_startup_jobs=2, **ALGO_KW
+    )
+    h = svc.create_study("d0", seed=1)
+    svc.drain(timeout=9.0, block=False)
+    with pytest.raises(Overloaded) as ei:
+        h.ask_async()
+    e = ei.value
+    assert e.reason == "draining"
+    # derived from the drain deadline, not the 10 ms queue heuristic
+    assert e.retry_after is not None
+    assert 1.0 < e.retry_after <= 9.0
+    # and it shrinks as the deadline approaches
+    time.sleep(0.05)
+    with pytest.raises(Overloaded) as ei2:
+        h.ask_async()
+    assert ei2.value.retry_after < e.retry_after
+    # the wire reply forwards the concrete hint
+    reply = _serve_error_reply(e)
+    assert reply["error_type"] == "Overloaded"
+    assert reply["reason"] == "draining"
+    assert reply["retry_after"] == e.retry_after
+    svc.shutdown()
+
+
+def test_serve_error_reply_never_ships_null_retry_after():
+    reply = _serve_error_reply(Overloaded("bare", reason="draining"))
+    assert reply["retry_after"] is not None and reply["retry_after"] > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite: fsck --serve
+# ---------------------------------------------------------------------------
+
+
+def test_fsck_serve_audit_repair_then_restorable(tmp_path):
+    """Damage a serve study root with every corruption class a killed
+    or failed-over replica can leave; ``fsck --serve --repair`` must
+    fix it, and the repaired family must then RESTORE."""
+    from hyperopt_tpu.distributed.fsck import audit_serve, repair_serve
+
+    root = str(tmp_path / "root")
+    svc = SuggestService(
+        SPACE, root=root, owner="r0", background=False, max_batch=4,
+        n_startup_jobs=2, snapshot_cadence=2, **ALGO_KW,
+    )
+    ha = svc.create_study("a", seed=1)
+    for tid in range(3):  # snapshot at cadence 2, 1 tell in the WAL
+        ha.tell(tid, 0.5 + tid, vals={"x": 0.1, "lr": 0.5, "c": 0})
+    hb = svc.create_study("b", seed=2)
+    hb.tell(0, 1.5, vals={"x": -0.2, "lr": 0.3, "c": 1})
+    # crash semantics: drop the handles, no final snapshots/releases
+    for n in ("a", "b"):
+        svc.scheduler.study(n).persist.wal.close()
+
+    # damage: torn WAL tail on a, foreign-guard snapshot on b, an
+    # orphaned claim token, and a stale snapshot tmp
+    with open(os.path.join(root, "a.wal"), "ab") as f:
+        f.write(b"\x00garbage torn tail")
+    with open(os.path.join(root, "b.snap"), "wb") as f:
+        pickle.dump({"guard": ["foreign", 0, "algo", "fp"]}, f)
+    with open(os.path.join(root, "zz.claim"), "w") as f:
+        f.write(json.dumps({"replica": "gone", "token": "t", "epoch": 3}))
+    tmp = os.path.join(root, "a.snap.tmp.999")
+    with open(tmp, "w") as f:
+        f.write("half")
+    os.utime(tmp, (time.time() - 600, time.time() - 600))
+
+    issues = audit_serve(root)
+    kinds = {i.kind for i in issues}
+    assert kinds == {
+        "wal_torn_tail", "ckpt_fingerprint_mismatch", "claim_orphaned",
+        "orphaned_snapshot_tmp",
+    }, issues
+    n = repair_serve(root, issues)
+    assert n == len(issues)
+    assert audit_serve(root) == []
+
+    # repaired-then-restorable: a new replica adopts both families
+    svc2 = SuggestService(
+        SPACE, root=root, owner="r1", background=False, max_batch=4,
+        n_startup_jobs=2, **ALGO_KW,
+    )
+    a = svc2.create_study("a", takeover=True)
+    b = svc2.create_study("b", takeover=True)
+    assert a.n_tells == 3  # 2 from the snapshot + 1 WAL replay
+    assert b.n_tells == 1  # quarantined foreign snap, WAL replay won
+    svc2.shutdown()
+
+
+def test_fsck_serve_cli(tmp_path):
+    from hyperopt_tpu.distributed import fsck
+
+    root = str(tmp_path / "cli")
+    os.makedirs(root)
+    with open(os.path.join(root, "x.claim"), "w") as f:
+        f.write(json.dumps({"replica": "gone", "token": "t", "epoch": 0}))
+    assert fsck.main(["--serve", root]) == 1  # audit-only: found
+    assert fsck.main(["--serve", root, "--repair"]) == 0
+    assert fsck.main(["--serve", root]) == 0  # clean now
+
+
+# ---------------------------------------------------------------------------
+# the TCP router front
+# ---------------------------------------------------------------------------
+
+
+class _Client:
+    def __init__(self, host, port):
+        self.sock = socket.create_connection((host, port), timeout=30)
+        self.f = self.sock.makefile("rwb")
+
+    def rpc(self, **req):
+        self.f.write((json.dumps(req) + "\n").encode())
+        self.f.flush()
+        return json.loads(self.f.readline())
+
+    def close(self):
+        self.f.close()
+        self.sock.close()
+
+
+def _spawn(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def test_tcp_router_routes_and_fails_over(tmp_path):
+    """End-to-end over real sockets: two replica backends sharing a
+    root, fronted by the TCP router; killing one backend reroutes its
+    studies to the survivor, which restores them from the shared
+    root."""
+    from hyperopt_tpu.serve.fleet import fleet_salt
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+    from hyperopt_tpu.serve.service import serve_forever
+
+    root = str(tmp_path / "root")
+    svcs, servers = {}, {}
+    for rid in ("b0", "b1"):
+        svc = SuggestService(
+            SPACE, root=root, owner=rid, background=True, max_batch=8,
+            n_startup_jobs=2, **ALGO_KW,
+        )
+        srv = serve_forever(svc, port=0)
+        _spawn(srv)
+        svcs[rid], servers[rid] = svc, srv
+    backends = [
+        _Backend(rid, *servers[rid].server_address[:2])
+        for rid in ("b0", "b1")
+    ]
+    router = RouterServer(backends, salt=fleet_salt("tpe", SPACE))
+    rsrv = router.serve_forever(port=0)
+    _spawn(rsrv)
+    host, port = rsrv.server_address[:2]
+
+    cli = _Client(host, port)
+    names = [f"t{i}" for i in range(4)]
+    try:
+        assert cli.rpc(op="ping")["router"] is True
+        assert cli.rpc(op="ready")["ready"] is True
+        for i, n in enumerate(names):
+            assert cli.rpc(op="create_study", name=n, seed=40 + i)["ok"]
+        assert cli.rpc(op="studies")["studies"] == sorted(names)
+        # both backends must actually hold a share (ring spread)
+        shares = {rid: len(svc.studies()) for rid, svc in svcs.items()}
+        assert all(v > 0 for v in shares.values()), shares
+        served = {}
+        for n in names:
+            r = cli.rpc(op="ask", study=n, timeout=30)
+            assert r["ok"], r
+            served[n] = (r["tid"], r["vals"])
+            assert cli.rpc(op="tell", study=n, tid=r["tid"],
+                           loss=0.25)["ok"]
+        # kill b0: graceful service stop, listener closed
+        dead = "b0"
+        servers[dead].shutdown()
+        servers[dead].server_close()
+        svcs[dead].shutdown()
+        moved = [n for n in names if n in svcs[dead].studies()] or [
+            n for n in names
+        ]
+        # a fresh client connection (fresh backend conns) must be able
+        # to serve EVERY study -- the survivor adopts from the root
+        cli2 = _Client(host, port)
+        for n in names:
+            r = cli2.rpc(op="ask", study=n, timeout=30, recover=True)
+            assert r["ok"], (n, r)
+            assert cli2.rpc(op="tell", study=n, tid=r["tid"],
+                            loss=0.5)["ok"]
+            b = cli2.rpc(op="best", study=n)
+            assert b["ok"] and b["best"] is not None
+        assert moved  # the scenario actually exercised failover
+        cli2.close()
+    finally:
+        cli.close()
+        for rid in ("b0", "b1"):
+            try:
+                servers[rid].shutdown()
+                servers[rid].server_close()
+                svcs[rid].shutdown()
+            except Exception:
+                pass
+        rsrv.shutdown()
+        rsrv.server_close()
+
+
+# ---------------------------------------------------------------------------
+# CI/tooling satellite: the static tiers cover the new modules
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_modules_lint_and_trace_clean():
+    """graftlint + graftrace over exactly the new fleet/router modules
+    (the whole-package gates in test_lint_clean.py cover them too;
+    this pins the satellite explicitly, with zero baseline)."""
+    from hyperopt_tpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [
+        os.path.join(repo, "hyperopt_tpu", "serve", "fleet.py"),
+        os.path.join(repo, "hyperopt_tpu", "serve", "router.py"),
+    ]
+    for pack in ("ast", "trace"):
+        result = lint_paths(paths, pack=pack)
+        assert not result.findings, (pack, result.findings)
+
+
+def test_fleet_crash_points_registered():
+    from hyperopt_tpu.distributed.faults import (
+        ALL_CRASH_POINTS,
+        FLEET_CRASH_POINTS,
+    )
+
+    assert set(FLEET_CRASH_POINTS) <= set(ALL_CRASH_POINTS)
+    assert set(FLEET_CRASH_POINTS) == {
+        "fleet_router_after_forward_before_ack",
+        "fleet_migrate_after_snapshot_before_handoff",
+        "fleet_migrate_after_handoff_before_restore",
+    }

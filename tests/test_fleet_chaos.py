@@ -1,0 +1,506 @@
+"""Fleet-level failover chaos (ISSUE 13): replica kill mid-batch under
+a transient-fault storm, router crash between forward and ack,
+migration crash windows, and partition/zombie fencing -- with ZERO
+lost / ZERO duplicate tells and every surviving stream bitwise
+identical to the same-seed no-fault run.
+
+Same discipline as ``tests/test_serve_chaos.py``: seeded
+:class:`FaultPlan`\\ s per replica (plus one for the router and one
+for the fleet coordinator), deterministic single-threaded pumping, the
+client retrying exactly as a real protocol client would (re-ask with
+``recover=True``, re-tell with explicit vals), and every scenario run
+twice same-seed to prove bitwise repeatability.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.distributed.faults import (
+    FLEET_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import Overloaded, OwnershipLost
+from hyperopt_tpu.serve import Fleet, FleetRouter, HashRing, SuggestService
+from hyperopt_tpu.serve.fleet import fleet_salt
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # every scheduler any replica builds rides the lockdep sanitizer;
+    # an observed lock-order inversion fails at acquisition time
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+KW = dict(max_batch=8, n_startup_jobs=2, snapshot_cadence=4, **ALGO_KW)
+REPLICAS = ("r0", "r1", "r2")
+NAMES = tuple(f"s{i:02d}" for i in range(9))
+R = 4  # tells per study the workload must end with, exactly
+
+
+def loss_fn(vals):
+    return (vals["x"]) ** 2 / 10 + abs(float(np.log(vals["lr"])) + 2) / 3
+
+
+def victim_rid(name="s00"):
+    """The deterministic kill target: whichever replica the ring
+    places ``name`` on (pure function of the guard fingerprint)."""
+    ring = HashRing(REPLICAS, salt=fleet_salt("tpe", SPACE))
+    return ring.owner(name)
+
+
+def make_fleet(root, storm_rate=0.0, arm_victim=None, seed=0, fs=None):
+    plans = {
+        rid: FaultPlan(seed=seed * 100 + i, rate=storm_rate)
+        for i, rid in enumerate(REPLICAS)
+    }
+    if arm_victim is not None:
+        point, at = arm_victim
+        plans[victim_rid()].arm(point, at=at)
+    return Fleet(
+        SPACE, root, replica_ids=list(REPLICAS), plans=plans,
+        fs=fs if fs is not None else FaultPlan(seed=seed).fs(), **KW,
+    )
+
+
+class Client:
+    """The protocol client's retry discipline, op-level: a crashed
+    router is restarted and the op retried idempotently (asks with
+    ``recover=True`` -- exactly-once delivery; tells with explicit
+    vals -- tid-dedup)."""
+
+    def __init__(self, fleet, router_fs=None):
+        self.fleet = fleet
+        self.router = (
+            FleetRouter(fleet) if router_fs is None
+            else FleetRouter(fleet, fs=router_fs)
+        )
+        self.router_crashes = 0
+
+    def _restart(self):
+        self.router_crashes += 1
+        self.router = FleetRouter(self.fleet)  # fresh process, no plan
+
+    def create(self, name, seed):
+        while True:
+            try:
+                return self.router.create_study(name, seed=seed)
+            except SimulatedCrash:
+                self._restart()
+
+    def ask(self, name):
+        recover = False
+        while True:
+            try:
+                return self.router.ask(name, timeout=30, recover=recover)
+            except SimulatedCrash:
+                self._restart()
+                recover = True
+
+    def tell(self, name, tid, loss, vals):
+        while True:
+            try:
+                return self.router.tell(name, tid, loss, vals=vals)
+            except SimulatedCrash:
+                self._restart()
+
+
+def drive(client, streams, rounds, names=NAMES):
+    for _ in range(rounds):
+        for n in names:
+            tid, vals = client.ask(n)
+            client.tell(n, tid, loss_fn(vals), vals)
+            streams[n].append((tid, tuple(sorted(vals.items()))))
+
+
+def final_state(fleet, names=NAMES):
+    out = {}
+    for n in names:
+        st = fleet.replicas[fleet.route(n)].service.scheduler.study(n)
+        buf = st.buf
+        out[n] = {
+            "count": int(buf.count),
+            "tids": buf.tids[: buf.count].tolist(),
+            "losses": buf.losses[: buf.count].tolist(),
+            "values": buf.values[:, : buf.count].copy(),
+            "wal_total_tells": st.persist.wal.total_tells,
+        }
+    return out
+
+
+def assert_zero_lost_zero_duplicate(state):
+    for n, d in state.items():
+        assert d["count"] == R, (n, d["count"])
+        assert len(set(d["tids"])) == R, f"{n}: duplicate tid absorbed"
+        assert d["wal_total_tells"] == R, (
+            f"{n}: WAL logged {d['wal_total_tells']} tells for "
+            f"{R} applied -- lost or duplicated"
+        )
+
+
+def assert_states_bitwise_equal(a, b, names=NAMES):
+    for n in names:
+        assert a[n]["tids"] == b[n]["tids"], n
+        assert a[n]["losses"] == b[n]["losses"], n
+        np.testing.assert_array_equal(a[n]["values"], b[n]["values"])
+        assert a[n]["wal_total_tells"] == b[n]["wal_total_tells"]
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """The same-seed NO-FAULT run every chaos scenario's surviving
+    streams are pinned against (shared across the module: the streams
+    are study-local, so one clean run serves every comparison)."""
+    root = tmp_path_factory.mktemp("fleet-clean")
+    fleet = make_fleet(str(root))
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, R)
+    state = final_state(fleet)
+    fleet.shutdown()
+    return streams, state
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_mid_batch_under_storm_acceptance(
+    tmp_path, clean_run
+):
+    """Kill a replica mid-batch under a 10% transient-errno storm:
+    the workload completes with zero lost / zero duplicate tells
+    (asserted live AND via cold WAL replay), EVERY stream -- including
+    the failed-over ones -- is bitwise the same-seed no-fault run's,
+    and the whole crash-and-failover scenario replays bitwise."""
+    clean_streams, clean_state = clean_run
+    runs = []
+    for rep in range(2):
+        root = str(tmp_path / f"kill-{rep}")
+        fleet = make_fleet(
+            root, storm_rate=0.10,
+            arm_victim=("serve_mid_batch", 2), seed=7,
+        )
+        victim = victim_rid()
+        client = Client(fleet)
+        for i, n in enumerate(NAMES):
+            client.create(n, seed=100 + i)
+        streams = {n: [] for n in NAMES}
+        drive(client, streams, R)
+        # the victim actually died and its studies failed over
+        assert fleet.replicas[victim].dead
+        assert victim not in fleet.ring.nodes
+        assert fleet.recovery_ms is not None and fleet.recovery_ms > 0
+        state = final_state(fleet)
+        assert_zero_lost_zero_duplicate(state)
+        fleet.shutdown()
+
+        # cold audit: re-materialize every study from nothing but its
+        # WAL+bundle pair -- the independent zero-lost/zero-dup proof
+        audit = SuggestService(
+            SPACE, root=root, owner="audit", background=False,
+            max_batch=16, n_startup_jobs=2, **ALGO_KW,
+        )
+        for n in NAMES:
+            h = audit.create_study(n, takeover=True)
+            assert h.n_tells == R, (n, h.n_tells)
+        cold = {
+            n: audit.scheduler.study(n).buf.tids[:R].tolist()
+            for n in NAMES
+        }
+        audit.shutdown()
+        for n in NAMES:
+            assert cold[n] == state[n]["tids"], n
+        runs.append((streams, state))
+
+    # every stream bitwise identical to the same-seed no-fault run --
+    # failover re-serves in-flight asks from their WAL-logged seeds,
+    # so even the killed replica's studies do not diverge
+    for streams, state in runs:
+        assert streams == clean_streams
+        assert_states_bitwise_equal(state, clean_state)
+    # and the whole scenario replays bitwise
+    assert runs[0][0] == runs[1][0]
+    assert_states_bitwise_equal(runs[0][1], runs[1][1])
+
+
+# ---------------------------------------------------------------------------
+# router crash between forward and ack
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("ack_ordinal", [24, 25])
+def test_router_crash_between_forward_and_ack(
+    tmp_path, clean_run, ack_ordinal
+):
+    """The router dies AFTER the replica executed the op but BEFORE
+    acking the client (ordinal 24 lands on an ask ack, 25 on a tell
+    ack, behind the 9 create acks + round-1 ask/tell acks).  The
+    restarted router's retry is idempotent: recover-asks re-deliver
+    the already-served suggestion, re-tells dedup by tid -- streams
+    stay bitwise the no-fault run's."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "rc")
+    fleet = make_fleet(root)
+    rplan = FaultPlan(seed=1).arm(
+        "fleet_router_after_forward_before_ack", at=ack_ordinal
+    )
+    client = Client(fleet, router_fs=rplan.fs())
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, R)
+    assert client.router_crashes == 1, "the router crash never fired"
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# migration crash windows (the drain protocol)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("point", [
+    "fleet_migrate_after_snapshot_before_handoff",
+    "fleet_migrate_after_handoff_before_restore",
+])
+def test_migration_crash_windows(tmp_path, clean_run, point):
+    """Drain migration killed between snapshot and handoff (source
+    still owns: migration aborts and re-runs) and between handoff and
+    restore (study unowned: the re-run adopts it on the target).
+    Either way the drain completes, nothing is lost or duplicated, and
+    streams stay bitwise the no-fault run's."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "mig")
+    on_fleet = point.endswith("before_restore")
+    victim = victim_rid()
+    fleet_plan = FaultPlan(seed=2)
+    if on_fleet:
+        fleet_plan.arm(point, at=1)
+    fleet = make_fleet(
+        root,
+        arm_victim=None if on_fleet else (point, 1),
+        fs=fleet_plan.fs(),
+    )
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 2)
+
+    fleet.begin_drain(victim, timeout=5.0)
+    crashes = 0
+    while victim in fleet.replicas:
+        try:
+            fleet.complete_drain(victim)
+        except SimulatedCrash:
+            crashes += 1  # the coordinator died; re-run the drain
+    assert crashes == 1, f"{point} never fired"
+    assert victim not in fleet.ring.nodes
+
+    drive(client, streams, R - 2)
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# partition / zombie fencing
+# ---------------------------------------------------------------------------
+
+
+def test_partitioned_zombie_never_double_serves(tmp_path, clean_run):
+    """A replica partitioned away from the router keeps running as a
+    zombie while its studies fail over.  Every fenced op the zombie
+    attempts -- ask, async ask, tell -- must raise OwnershipLost
+    (claim epoch bumped by the takeover), and the surviving streams
+    must be bitwise the no-fault run's: the zombie contributed
+    NOTHING."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "zombie")
+    fleet = make_fleet(root)
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 2)
+
+    victim = victim_rid()
+    zombie = fleet.replicas[victim]
+    fleet.partition(victim)
+    drive(client, streams, 1)  # router fails the partitioned rid over
+
+    znames = [n for n in NAMES if n in zombie.service.studies()]
+    assert znames, "the zombie should still hold its old handles"
+    for n in znames:
+        with pytest.raises(OwnershipLost):
+            zombie.ask(n, timeout=5)
+        with pytest.raises(OwnershipLost):
+            zombie.ask_async(n)
+        with pytest.raises(OwnershipLost):
+            zombie.tell(n, 99, 0.5, vals={"x": 0.1, "lr": 0.5, "c": 0})
+
+    drive(client, streams, 1)
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# rolling restart: drain-migrate with typed backpressure only
+# ---------------------------------------------------------------------------
+
+
+def test_rolling_restart_drain_migrate(tmp_path, clean_run):
+    """The planned path: drain a replica (clients see ONLY typed
+    ``Overloaded(reason="draining", retry_after=<drain deadline
+    left>)``), migrate its studies via snapshot -> handoff -> restore
+    -> repoint, replace it with a fresh replica (which pulls back ~its
+    ring share via the same migration), and finish the workload with
+    streams bitwise the no-restart run's."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "roll")
+    fleet = make_fleet(root)
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 2)
+
+    victim = victim_rid()
+    owned = [n for n in NAMES if fleet.route(n) == victim]
+    fleet.begin_drain(victim, timeout=7.5)
+    with pytest.raises(Overloaded) as ei:
+        client.router.ask(owned[0], timeout=5)
+    assert ei.value.reason == "draining"
+    assert ei.value.retry_after is not None
+    assert 0 < ei.value.retry_after <= 7.5
+    migrated = fleet.complete_drain(victim)
+    assert migrated == sorted(owned)
+    assert victim not in fleet.replicas
+
+    # a refused submit consumed nothing from the stream: the retry
+    # (now against the new owner) continues bitwise
+    drive(client, streams, 1)
+
+    # rolling replacement: the fresh replica joins and takes back ~1/N
+    # of the keys -- via planned migration, nothing else moves
+    before = {n: fleet.route(n) for n in NAMES}
+    fleet.add_replica("r9")
+    after = {n: fleet.route(n) for n in NAMES}
+    moved = [n for n in NAMES if before[n] != after[n]]
+    assert all(after[n] == "r9" for n in moved)
+    assert len(moved) < len(NAMES)
+
+    drive(client, streams, 1)
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+def test_fleet_points_registered():
+    """The CRASH_POINTS discipline: a new fleet crash point cannot be
+    added without this suite exercising it."""
+    from hyperopt_tpu.distributed.faults import ALL_CRASH_POINTS
+
+    assert set(FLEET_CRASH_POINTS) <= set(ALL_CRASH_POINTS)
+    assert set(FLEET_CRASH_POINTS) == {
+        "fleet_router_after_forward_before_ack",
+        "fleet_migrate_after_snapshot_before_handoff",
+        "fleet_migrate_after_handoff_before_restore",
+    }
+
+
+# ---------------------------------------------------------------------------
+# the soak: 10^4 churning studies through the fleet, with a mid-soak kill
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fleet_soak_10k_churning_studies(tmp_path):
+    """10,000+ studies churn through a 3-replica fleet in waves
+    (create -> 2 batched ask+tell rounds -> close), with one replica
+    killed mid-soak.  Asserts every wave completes exactly (zero lost
+    / zero duplicate tells per study) and stamps the fleet-aggregate
+    soak metrics the bench's ``bench_fleet`` mirrors at small scale."""
+    import time
+
+    n_studies = 10_000
+    wave_size = 18
+    rounds = 2
+    root = str(tmp_path / "soak")
+    # capacity headroom: a wave spreads ~evenly over 3 replicas, but
+    # after the mid-soak kill the survivors absorb the victim's share
+    kw = dict(KW, max_batch=32)
+    fleet = Fleet(
+        SPACE, root, replica_ids=list(REPLICAS),
+        plans={rid: FaultPlan(seed=i) for i, rid in enumerate(REPLICAS)},
+        **kw,
+    )
+    router = FleetRouter(fleet)
+    victim = victim_rid()
+    kill_at_wave = 3
+    t0 = time.perf_counter()
+    lat = []
+    served = told = 0
+    waves = (n_studies + wave_size - 1) // wave_size
+    for w in range(waves):
+        names = [
+            f"w{w:04d}x{j:02d}"
+            for j in range(min(wave_size, n_studies - w * wave_size))
+        ]
+        for j, n in enumerate(names):
+            router.create_study(n, seed=w * 100 + j)
+        if w == kill_at_wave:
+            fleet.kill_replica(victim)  # failover on first contact
+        for _ in range(rounds):
+            t_ask = time.perf_counter()
+            got = router.ask_batch(names, timeout=60)
+            lat.append((time.perf_counter() - t_ask) / len(names))
+            for n, (tid, vals) in got.items():
+                router.tell(n, tid, loss_fn(vals), vals=vals)
+                told += 1
+            served += len(got)
+        for n in names:
+            st = fleet.replicas[fleet.route(n)].service.scheduler.study(n)
+            assert st.buf.count == rounds, (n, st.buf.count)
+            assert st.persist.wal.total_tells == rounds
+            router.close_study(n)
+    dt = time.perf_counter() - t0
+    assert served == told == n_studies * rounds
+    assert fleet.replicas[victim].dead
+    assert fleet.recovery_ms is not None
+    lat_ms = sorted(1000.0 * x for x in lat)
+    p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
+    print(
+        f"\nfleet soak: {n_studies} studies, "
+        f"{served / dt:.1f} asks/s aggregate, "
+        f"p99 per-ask latency {p99:.2f} ms (incl. failover), "
+        f"recovery {fleet.recovery_ms:.1f} ms"
+    )
+    fleet.shutdown()

@@ -19,11 +19,12 @@ import time
 
 import pytest
 
-# ~9 min single-core (the tier-1 verify command allows 870 s total);
-# the measured round-6 fast tier is ~6-7 min on the reference container,
-# so the default leaves headroom for machine variance without letting a
-# minutes-scale regression through
-DEFAULT_BUDGET_S = 540.0
+# ~10 min single-core (the tier-1 verify command allows 870 s total);
+# the measured round-18 fast tier is ~8.5 min on the reference
+# container (the round-13..18 serve/guard/mesh/fleet suites grew it
+# past the old 9-min pin), so the default leaves headroom for machine
+# variance without letting a minutes-scale regression through
+DEFAULT_BUDGET_S = 600.0
 
 
 def test_fast_tier_wall_clock_budget(request):
