@@ -847,6 +847,129 @@ def bench_fleet(space, n_replicas=3, n_studies=12, rounds=3, n_cand=128):
     }
 
 
+def bench_obs(space, n_cand=128, n_startup_jobs=3, n_studies=8,
+              rounds=12):
+    """graftscope rows (round 19): what observability costs, measured.
+
+    * ``obs_overhead_frac_serve`` -- the study-batched serve loop with
+      a flight recorder at FULL cadence + the device-metrics twin at
+      cadence 1, as a fractional slowdown vs the untracked loop
+      (acceptance budget: <= 0.05 at default cadence; the default --
+      everything off -- costs exactly zero extra dispatches, pinned
+      deterministically in tests/test_obs.py);
+    * ``obs_overhead_frac_fused`` -- the same comparison in the
+      one-tenant fused regime (batch 1, the solo driver's dispatch
+      shape);
+    * ``obs_events_per_sec`` -- spans recorded per second during the
+      armed serve window;
+    * ``metrics_scrape_ms_fleet`` -- wall-clock of ONE fleet-wide
+      ``metrics`` scrape through a live TCP router over two replicas
+      (median of 5 round-trips).
+    """
+    import json as _json
+    import socket as _socket
+    import threading as _threading
+
+    from hyperopt_tpu.obs import FlightRecorder
+    from hyperopt_tpu.serve import SuggestService
+
+    def loss(vals):
+        return sum(
+            float(v) for v in vals.values()
+            if isinstance(v, (int, float))
+        )
+
+    def run(n, n_rounds, recorder=None, every=0):
+        svc = SuggestService(
+            space, max_batch=max(n, 4), background=False,
+            n_startup_jobs=n_startup_jobs, n_cand=n_cand,
+            recorder=recorder, device_metrics_every=every,
+        )
+        handles = [
+            svc.create_study(f"obs{i:03d}", seed=i) for i in range(n)
+        ]
+
+        def round_once():
+            futs = [h.ask_async() for h in handles]
+            svc.pump()
+            for h, f in zip(handles, futs):
+                tid, vals = f.result(timeout=120)
+                h.tell(tid, loss(vals))
+
+        round_once()  # compile + first materialization
+        t0 = time.perf_counter()
+        for _ in range(n_rounds):
+            round_once()
+        dt = time.perf_counter() - t0
+        svc.shutdown()
+        return dt
+
+    def overhead(n, n_rounds):
+        # armed at the DEFAULT cadence: the flight recorder records
+        # every span (cadence 1), the device twin stays at its default
+        # (off -- its zero-extra-dispatch half is pinned in test_obs)
+        plain = run(n, n_rounds)
+        rec = FlightRecorder(capacity=65536)
+        t0 = rec.recorded_total
+        armed = run(n, n_rounds, recorder=rec)
+        frac = max(0.0, armed / plain - 1.0)
+        events = (rec.recorded_total - t0) / armed
+        return frac, events
+
+    serve_frac, events_per_sec = overhead(n_studies, rounds)
+    fused_frac, _ = overhead(1, max(rounds * 4, 16))
+
+    # the fleet-wide scrape: two TCP replicas behind a live router
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+    from hyperopt_tpu.serve.service import serve_forever
+
+    svcs, servers, backends = [], [], []
+    for rid in ("b0", "b1"):
+        svc = SuggestService(
+            space, background=True, max_wait_ms=1.0,
+            n_startup_jobs=n_startup_jobs, n_cand=n_cand, owner=rid,
+        )
+        server = serve_forever(svc, port=0)
+        _threading.Thread(target=server.serve_forever, daemon=True).start()
+        svcs.append(svc)
+        servers.append(server)
+        backends.append(
+            _Backend(rid, "127.0.0.1", server.server_address[1])
+        )
+    router = RouterServer(backends)
+    rserver = router.serve_forever(port=0)
+    _threading.Thread(target=rserver.serve_forever, daemon=True).start()
+    try:
+        with _socket.create_connection(
+            ("127.0.0.1", rserver.server_address[1]), timeout=30
+        ) as sock:
+            f = sock.makefile("rw")
+            samples = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                f.write(_json.dumps({"op": "metrics"}) + "\n")
+                f.flush()
+                reply = _json.loads(f.readline())
+                samples.append(1000.0 * (time.perf_counter() - t0))
+                assert reply.get("ok") and len(reply["replicas"]) == 2
+        scrape_ms = sorted(samples)[len(samples) // 2]
+    finally:
+        rserver.shutdown()
+        rserver.server_close()
+        for server in servers:
+            server.shutdown()
+            server.server_close()
+        for svc in svcs:
+            svc.shutdown()
+
+    return {
+        "obs_overhead_frac_serve": round(serve_frac, 4),
+        "obs_overhead_frac_fused": round(fused_frac, 4),
+        "obs_events_per_sec": round(events_per_sec, 1),
+        "metrics_scrape_ms_fleet": round(scrape_ms, 3),
+    }
+
+
 def bench_device_loop(n_evals=8192, batch=128):
     """Secondary metric: a FULL experiment (suggest + evaluate + history)
     as one on-device program -- trials/sec end-to-end on a 2-dim
@@ -1256,6 +1379,10 @@ def main():
     # round-13 graftguard rows: overload shedding, poisoned-tenant
     # quarantine, and watchdog recovery on deterministic scenarios
     guard_rows = bench_guard(space, n_cand=n_cand)
+    # round-19 graftscope rows: the cost of observability, measured --
+    # armed-at-full-cadence overhead on the serve and fused regimes,
+    # span throughput, and one fleet-wide scrape through a live router
+    obs_rows = bench_obs(space, n_cand=n_cand)
     # round-18 graftfleet rows: the horizontal fleet -- aggregate
     # throughput through the router, p99 ask latency across a
     # replica-kill window, and failover recovery time
@@ -1372,6 +1499,10 @@ def main():
                 # replicas behind the consistent-hash router --
                 # aggregate studies/sec, failover-window p99, recovery
                 **fleet_rows,
+                # round-19 graftscope rows (bench_obs): tracing-armed
+                # overhead fractions, span throughput, and the
+                # fleet-wide /metrics scrape latency
+                **obs_rows,
                 # round-17 graftmesh rows: per-mesh-shape throughput
                 # of the study-sharded serve engine and the shard_map
                 # PBT schedule, plus the near-linear-scaling

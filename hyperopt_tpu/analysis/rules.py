@@ -717,6 +717,134 @@ def check_unbounded_service_append(ctx):
                 )
 
 
+# the call names that mark a timing delta as ALREADY landing on a
+# graftscope sink (Histogram.observe / ring append / Recorder.record /
+# the *_since helpers): the delta is computed en route to the registry,
+# which is the sanctioned place for it
+_METRIC_SINKS = frozenset({
+    "observe", "observe_since", "append", "record", "event",
+    "set_duration_ms",
+})
+
+_TIME_SOURCES = frozenset({"time.time", "time.perf_counter"})
+
+#: graftscope's own internals: the one place timing math and raw
+#: accumulator attributes are the implementation, not ad-hoc state
+_OBS_INTERNALS = frozenset({"registry.py", "flightrec.py"})
+
+#: class-body descriptor factories that register an attribute on the
+#: graftscope registry -- an attr declared this way is the MIGRATED
+#: idiom GL307 exists to steer toward
+_REGISTRY_DESCRIPTORS = frozenset({
+    "CounterAttr", "GaugeAttr", "HistogramAttr",
+})
+
+
+def _feeds_metric_sink(ctx, node):
+    """Is this expression an argument of a ``.observe(...)``-style
+    call (directly or via an enclosing expression)?"""
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.stmt):
+            return False
+        if (
+            isinstance(anc, ast.Call)
+            and isinstance(anc.func, ast.Attribute)
+            and anc.func.attr in _METRIC_SINKS
+        ):
+            return True
+    return False
+
+
+@register(
+    "GL307", "ad-hoc-metric-state",
+    "timing deltas (time.time()/perf_counter() subtraction) or public "
+    "counter attributes accumulated outside the graftscope registry in "
+    "serve//obs//distributed/ library code -- operational signals must "
+    "live on the typed, bounded, scrapeable registry",
+)
+def check_adhoc_metric_state(ctx):
+    in_domain = any(
+        p in ("serve", "obs", "distributed") for p in ctx.parts[:-1]
+    )
+    if not in_domain or _is_test_file(ctx):
+        return
+    base = ctx.parts[-1] if ctx.parts else ""
+    if "obs" in ctx.parts[:-1] and base in _OBS_INTERNALS:
+        return
+    # (a) inline timing deltas: a minus with a direct time.time()/
+    # perf_counter() operand that is NOT en route to a registry sink
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub)):
+            continue
+        for side in (node.left, node.right):
+            if (
+                isinstance(side, ast.Call)
+                and dotted_name(side.func) in _TIME_SOURCES
+                and not _feeds_metric_sink(ctx, node)
+            ):
+                yield ctx.finding(
+                    "GL307", node,
+                    f"ad-hoc {dotted_name(side.func)}() delta in library "
+                    "code: land it on the graftscope registry "
+                    "(Histogram.observe_since / Gauge.set_duration_ms) "
+                    "so it is bounded, typed, and scrapeable",
+                )
+                break
+    # (b) public numeric counter attrs (born as a literal in __init__)
+    # accumulated by +=/-= in methods, with no registry descriptor of
+    # that name on the class -- the pre-graftscope counter idiom
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        descriptor_attrs = {
+            t.id
+            for node in cls.body
+            if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and terminal_name(node.value.func) in _REGISTRY_DESCRIPTORS
+            for t in node.targets
+            if isinstance(t, ast.Name)
+        }
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        init = methods.get("__init__")
+        if init is None:
+            continue
+        counter_attrs = {
+            t.attr
+            for node in ast.walk(init)
+            if isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, (int, float))
+            and not isinstance(node.value.value, bool)
+            for t in node.targets
+            if isinstance(t, ast.Attribute)
+            and isinstance(t.value, ast.Name)
+            and t.value.id == "self"
+            and not t.attr.startswith("_")
+        } - descriptor_attrs
+        if not counter_attrs:
+            continue
+        for name, fn in methods.items():
+            if name == "__init__":
+                continue
+            for node in ast.walk(fn):
+                if (
+                    isinstance(node, ast.AugAssign)
+                    and isinstance(node.op, (ast.Add, ast.Sub))
+                    and _self_attr(node.target, counter_attrs)
+                ):
+                    yield ctx.finding(
+                        "GL307", node,
+                        f"self.{node.target.attr} is a hand-rolled "
+                        f"counter on {cls.name}: declare it as a "
+                        "graftscope CounterAttr/GaugeAttr so the "
+                        "total is typed, labeled, and scrapeable",
+                    )
+
+
 _NP_GLOBAL_STATE = frozenset({
     "seed", "rand", "randn", "randint", "random", "uniform", "normal",
     "choice", "shuffle", "permutation", "standard_normal", "beta",

@@ -149,6 +149,7 @@ def compile_fmin(
     checkpoint_every=1,
     resume=False,
     fs=None,
+    metrics_registry=None,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -275,6 +276,14 @@ def compile_fmin(
         or no_progress_steps < 1
     ):
         raise ValueError("no_progress_steps must be a positive integer")
+    if metrics_registry is not None and progress_callback is None:
+        # graftscope: land the declared per-chunk progress rows on a
+        # metrics registry (gauges + obs_device_events_total) instead
+        # of a hand-rolled callback -- same io_callback seam, same
+        # chunked-path requirement below
+        from .obs.device import progress_to_registry
+
+        progress_callback = progress_to_registry(metrics_registry)
     chunked = chunk_size is not None
     if not chunked and (
         progress_callback is not None

@@ -42,6 +42,7 @@ import time
 import uuid
 
 from ..base import Domain, JOB_STATE_DONE, JOB_STATE_NEW, SONify, STATUS_OK
+from ..obs.registry import CounterAttr, MetricsRegistry
 from . import _common
 from .filequeue import FileJobQueue, _read_json
 
@@ -246,9 +247,21 @@ class _TransportDriver:
     check still runs; ``reap(reserve_timeout)`` recycles stale claims.
     """
 
+    # graftscope: publish/collect/expire accounting behind the
+    # historic attribute names (asha_filequeue's cleanup decision and
+    # its uncollected-jobs warning read these)
+    published = CounterAttr(
+        "asha_published_total", "jobs enqueued by this run")
+    collected = CounterAttr(
+        "asha_collected_total", "completed job docs collected")
+    expired = CounterAttr(
+        "asha_expired_total",
+        "jobs that outran eval_timeout (may still be evaluated later)")
+
     def __init__(self, publish, fetch, reap, exp_key, poll_interval,
                  eval_timeout, reserve_timeout,
                  attachment_key="FMinIter_Domain"):
+        self.metrics = MetricsRegistry("asha_queue")
         self._publish = publish
         self._fetch = fetch
         self._reap = reap
@@ -260,13 +273,6 @@ class _TransportDriver:
         self._run_tag = uuid.uuid4().hex[:8]
         self._counter = itertools.count()
         self._lock = threading.Lock()
-        self.expired = 0  # timed-out jobs: their queue entries may
-        # still be evaluated later, so run-scoped cleanup must not
-        # delete the Domain from under them
-        self.published = 0  # publish/collect accounting: cleanup is
-        self.collected = 0  # safe only when every published job's
-        # result was collected (an aborted driver may leave jobs in
-        # the queue that still name this run's attachment)
         # reaping only matters on the reserve_timeout scale; one shared
         # rate limit keeps the polling slots from issuing full queue
         # scans every tick on a network mount / remote database

@@ -52,7 +52,7 @@ __all__ = [
     "DeviceFaultPlan",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
     "DEVICE_LOOP_CRASH_POINTS", "FLEET_CRASH_POINTS",
-    "ALL_CRASH_POINTS",
+    "OBS_CRASH_POINTS", "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -160,9 +160,19 @@ FLEET_CRASH_POINTS = (
     "fleet_migrate_after_handoff_before_restore",
 )
 
+#: crash point of graftscope's flight-recorder export (hyperopt_tpu/
+#: obs/flightrec.py): fires MID-RECORD, leaving a torn final line --
+#: exactly the state a machine crash produces -- which
+#: ``hyperopt-tpu-fsck --obs`` truncates and the recorder's scan rule
+#: skips.  tests/test_obs.py proves the log recoverable and the spans
+#: before the tear intact.
+OBS_CRASH_POINTS = (
+    "obs_flight_export_mid_append",
+)
+
 ALL_CRASH_POINTS = (
     CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
-    + DEVICE_LOOP_CRASH_POINTS + FLEET_CRASH_POINTS
+    + DEVICE_LOOP_CRASH_POINTS + FLEET_CRASH_POINTS + OBS_CRASH_POINTS
 )
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError

@@ -67,7 +67,7 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "Issue", "audit", "repair", "audit_driver", "repair_driver",
-    "audit_serve", "repair_serve", "main",
+    "audit_serve", "repair_serve", "audit_obs", "repair_obs", "main",
 ]
 
 _SUBS = ("new", "running", "done")
@@ -441,6 +441,38 @@ def repair_driver(path, issues, fs=REAL_FS):
     return repaired
 
 
+def audit_obs(path, fs=REAL_FS):
+    """Audit a graftscope flight-recorder log (``--obs PATH``): a torn
+    tail (crash mid-export) is repairable by truncation; mid-file
+    corruption is reported but left in place -- the span scanner
+    already skips it, and telemetry never warrants quarantine."""
+    from ..obs.flightrec import audit_flight_log
+
+    return [
+        Issue(kind, p, detail)
+        for kind, p, detail in audit_flight_log(path, fs=fs)
+    ]
+
+
+def repair_obs(path, issues, fs=REAL_FS):
+    """Truncate a flight log's torn tail (tmp + fsync + rename);
+    returns the repaired count."""
+    from ..obs.flightrec import repair_flight_log
+
+    repaired = 0
+    for issue in issues:
+        if issue.kind != "obs_torn_tail":
+            continue
+        dropped = repair_flight_log(issue.path, fs=fs)
+        if dropped:
+            logger.info(
+                "flight log %s: truncated %d torn byte(s)",
+                issue.path, dropped,
+            )
+            repaired += 1
+    return repaired
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m hyperopt_tpu.distributed.fsck",
@@ -457,6 +489,11 @@ def main(argv=None):
         "--serve", metavar="ROOT",
         help="audit a serve study root (a fleet's shared directory of "
         "per-study <name>.wal/.snap/.claim families) instead",
+    )
+    parser.add_argument(
+        "--obs", metavar="PATH",
+        help="audit a graftscope flight-recorder span log (torn export "
+        "tails are truncated under --repair) instead",
     )
     parser.add_argument(
         "--repair", action="store_true",
@@ -478,13 +515,21 @@ def main(argv=None):
         stream=sys.stderr,
     )
     n_targets = sum(
-        1 for t in (options.dir, options.driver, options.serve) if t
+        1 for t in (
+            options.dir, options.driver, options.serve, options.obs
+        ) if t
     )
     if n_targets != 1:
         parser.error(
-            "exactly one of --dir, --driver or --serve is required"
+            "exactly one of --dir, --driver, --serve or --obs is required"
         )
-    if options.serve:
+    if options.obs:
+        target = options.obs
+        do_audit = lambda: audit_obs(options.obs)  # noqa: E731
+        do_repair = lambda issues: repair_obs(  # noqa: E731
+            options.obs, issues
+        )
+    elif options.serve:
         target = options.serve
         do_audit = lambda: audit_serve(  # noqa: E731
             options.serve, tmp_grace=options.tmp_grace

@@ -300,7 +300,7 @@ def main_worker_helper(options, drain=None):
         else:
             if (
                 options.last_job_timeout is not None
-                and time.time() - idle_since > options.last_job_timeout
+                and time.time() - idle_since > options.last_job_timeout  # graftlint: disable=GL307 idle-timeout protocol arithmetic (exit decision), not a metric accumulation
             ):
                 logger.info("idle for %.0fs, exiting", options.last_job_timeout)
                 break

@@ -139,7 +139,14 @@ class FMinIter:
         recovery=None,
         trial_timeout=None,
         catch=(),
+        recorder=None,
     ):
+        # graftscope: the driver's trace spans (driver.trial /
+        # tell.wal_append / tell.applied) -- observation only, never
+        # touching the rstate stream (the invisibility invariant)
+        from .obs.flightrec import NULL_RECORDER
+
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
         self.algo = algo
         self.domain = domain
         self.trials = trials
@@ -274,6 +281,8 @@ class FMinIter:
         is never re-evaluated and never double-applied on resume."""
         if self._recovery is None:
             return
+        rec = self.recorder
+        t0 = timeit.default_timer() if rec.enabled else 0.0
         if result is not None:
             self._recovery.log_tell(
                 trial["tid"], JOB_STATE_DONE, result=result
@@ -283,6 +292,11 @@ class FMinIter:
                 trial["tid"], JOB_STATE_ERROR,
                 error=list(trial["misc"].get("error", ())),
                 tb=trial["misc"].get("traceback"),
+            )
+        if rec.enabled:
+            rec.record(
+                "tell.wal_append", t0, timeit.default_timer(),
+                study="driver", tid=int(trial["tid"]),
             )
 
     # -- evaluation --------------------------------------------------------
@@ -327,6 +341,9 @@ class FMinIter:
             spec = spec_from_misc(trial["misc"])
             ctrl = Ctrl(self.trials, current_trial=trial)
             result = failure = None
+            t_eval = (
+                timeit.default_timer() if self.recorder.enabled else 0.0
+            )
             try:
                 result = self._evaluate_one(spec, ctrl)
             except TrialTimeout as e:
@@ -365,6 +382,12 @@ class FMinIter:
                 trial["state"] = JOB_STATE_DONE
                 trial["result"] = result
                 trial["refresh_time"] = coarse_utcnow()
+                if self.recorder.enabled:
+                    self.recorder.record(
+                        "driver.trial", t_eval, timeit.default_timer(),
+                        study="driver", tid=int(trial["tid"]),
+                        status=result.get("status"),
+                    )
                 self._crashpoint("after_tell_before_ask_ahead")
                 self._notify_result()
             N -= 1
@@ -635,8 +658,16 @@ def fmin(
     catch=(),
     compiled=False,
     compiled_options=None,
+    recorder=None,
 ):
     """Minimize ``fn`` over ``space`` using ``algo``.
+
+    Observability (graftscope): ``recorder`` (a
+    :class:`~hyperopt_tpu.obs.FlightRecorder`) arms driver trace spans
+    (``driver.trial`` / ``tell.wal_append``); arming it changes no
+    suggestion stream (the invisibility invariant).  Compiled runs
+    stream per-chunk device metrics instead: pass
+    ``compiled_options={"chunk_size": ..., "metrics_registry": reg}``.
 
     Drop-in parity with the reference ``hyperopt.fmin`` (SURVEY.md SS2 L4);
     pass ``algo=hyperopt_tpu.tpe.suggest`` for the host parity path or
@@ -813,6 +844,7 @@ def fmin(
         recovery=recovery,
         trial_timeout=trial_timeout,
         catch=catch,
+        recorder=recorder,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
     if ask_ahead_seed is not None:

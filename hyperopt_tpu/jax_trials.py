@@ -20,6 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 from .base import Trials, posterior_state
+from .obs.registry import CounterAttr, MetricsRegistry
 from .ops.compile import PackedSpace
 
 __all__ = ["ObsBuffer", "JaxTrials", "MIN_CAPACITY", "GROWTH_FACTOR"]
@@ -77,8 +78,21 @@ class ObsBuffer:
     benchmarks and regression pins.
     """
 
+    # graftscope: the deterministic traffic/dispatch counters live on
+    # a per-buffer MetricsRegistry, exposed behind their historic
+    # attribute names (reads, `+=` writes, and pickles all unchanged)
+    transfer_bytes_total = CounterAttr(
+        "obs_transfer_bytes_total", "host->device history bytes moved")
+    delta_tells = CounterAttr(
+        "obs_delta_tells_total", "O(D) incremental delta tells applied")
+    full_uploads = CounterAttr(
+        "obs_full_uploads_total", "full history re-materializations")
+    dispatch_count = CounterAttr(
+        "obs_dispatch_total", "device programs dispatched by this buffer")
+
     def __init__(self, space: PackedSpace, capacity=MIN_CAPACITY,
                  resident=False):
+        self.metrics = MetricsRegistry("obs_buffer")
         self.space = space
         self.capacity = int(capacity)
         D = space.n_dims
@@ -97,11 +111,6 @@ class ObsBuffer:
         self._resident = None  # {"bucket": int, "arrays": HistoryState}
         self._resident_full = True  # mirror needs a full materialization
         self._pending_deltas = []  # [(slot, values-col, active-col, loss)]
-        # deterministic traffic/dispatch accounting (counted, not timed)
-        self.transfer_bytes_total = 0
-        self.delta_tells = 0
-        self.full_uploads = 0
-        self.dispatch_count = 0
 
     def _grow(self):
         new_cap = self.capacity * GROWTH_FACTOR

@@ -1,0 +1,63 @@
+"""graftscope: the unified observability subsystem.
+
+One schema for every operational signal the stack emits:
+
+* :mod:`.registry` -- the typed, bounded metrics registry
+  (Counter/Gauge/Histogram, label cardinality capped at registration,
+  snapshot-consistent reads) plus the back-compat descriptors that
+  keep every pre-graftscope attribute read path working;
+* :mod:`.flightrec` -- trace spans for the ask/tell lifecycle in a
+  bounded flight recorder with a WAL-style durable export
+  (``hyperopt-tpu-fsck --obs`` recovers a torn tail);
+* :mod:`.device` -- device-side event streaming: the declared
+  ``io_callback`` metrics twin (graftir ``obs.device_metrics``) and
+  the device-loop progress adapter;
+* :mod:`.expo` -- Prometheus-style text + JSON exposition, merged
+  fleet-wide by the router;
+* :mod:`.cli` -- the ``hyperopt-tpu-scope`` console script (scrape a
+  replica or the whole fleet through the router; tail spans live or
+  from a flight-log file).
+
+The governing invariant (tested, not aspirational): observability is
+**bitwise-invisible** -- arming a recorder at full cadence changes no
+suggestion stream, no WAL byte, no recovery outcome; and disabled
+device-metrics cadence dispatches exactly zero extra programs.
+"""
+
+from .expo import merge_rows, render_prometheus, tag_rows
+from .flightrec import (
+    NULL_RECORDER,
+    FlightRecorder,
+    NullRecorder,
+    audit_flight_log,
+    read_flight_log,
+    repair_flight_log,
+)
+from .registry import (
+    Counter,
+    CounterAttr,
+    Gauge,
+    GaugeAttr,
+    Histogram,
+    HistogramAttr,
+    MetricsRegistry,
+)
+
+__all__ = [
+    "Counter",
+    "CounterAttr",
+    "FlightRecorder",
+    "Gauge",
+    "GaugeAttr",
+    "Histogram",
+    "HistogramAttr",
+    "MetricsRegistry",
+    "NULL_RECORDER",
+    "NullRecorder",
+    "audit_flight_log",
+    "merge_rows",
+    "read_flight_log",
+    "render_prometheus",
+    "repair_flight_log",
+    "tag_rows",
+]

@@ -1,0 +1,121 @@
+"""``hyperopt-tpu-scope``: scrape metrics / tail spans from a live
+replica, the whole fleet through the router, or a flight-log file.
+
+Examples::
+
+    # one replica's metrics, Prometheus text
+    hyperopt-tpu-scope metrics --port 7077
+
+    # the WHOLE fleet in one call (point at the router)
+    hyperopt-tpu-scope metrics --port 7076 --json
+
+    # the last 20 spans of a live replica's flight recorder
+    hyperopt-tpu-scope trace --port 7077 --tail 20
+
+    # a flight-recorder file, offline (post-mortem)
+    hyperopt-tpu-scope flight /var/run/study-root/flight.wal --tail 50
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+
+
+def _rpc(host, port, req, timeout=30.0):
+    with socket.create_connection((host, int(port)), timeout=timeout) as s:
+        f = s.makefile("rwb")
+        f.write((json.dumps(req) + "\n").encode("utf-8"))
+        f.flush()
+        line = f.readline()
+    if not line:
+        raise ConnectionError(f"{host}:{port} closed the connection")
+    return json.loads(line)
+
+
+def _span_line(span):
+    fixed = {"name", "ts", "dur_ms", "seq"}
+    ids = " ".join(
+        f"{k}={span[k]}" for k in sorted(span) if k not in fixed
+    )
+    dur = f" {span['dur_ms']:.3f}ms" if "dur_ms" in span else ""
+    return f"{span.get('ts', 0):.6f} {span['name']}{dur} {ids}".rstrip()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="hyperopt-tpu-scope",
+        description="graftscope console: scrape Prometheus-style "
+        "metrics from a serve replica (or the whole fleet via the "
+        "router), tail trace spans, or read a flight-log file.",
+    )
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    for name, doc in (
+        ("metrics", "scrape /metrics-style exposition over the "
+         "JSON-line protocol (a router target aggregates every live "
+         "replica in one call)"),
+        ("trace", "tail the flight recorder of a live target"),
+    ):
+        p = sub.add_parser(name, help=doc)
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", type=int, required=True)
+        p.add_argument("--timeout", type=float, default=30.0)
+        p.add_argument("--json", action="store_true",
+                       help="print raw JSON instead of text")
+        if name == "trace":
+            p.add_argument("--tail", type=int, default=50)
+
+    p = sub.add_parser(
+        "flight", help="read a flight-recorder file offline"
+    )
+    p.add_argument("path")
+    p.add_argument("--tail", type=int, default=None)
+    p.add_argument("--json", action="store_true")
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == "flight":
+        from .flightrec import read_flight_log
+
+        spans = read_flight_log(args.path, tail=args.tail)
+        if args.json:
+            print(json.dumps(spans))
+        else:
+            for s in spans:
+                print(_span_line(s))
+        return 0
+
+    if args.cmd == "metrics":
+        reply = _rpc(
+            args.host, args.port, {"op": "metrics"}, timeout=args.timeout
+        )
+        if not reply.get("ok"):
+            print(json.dumps(reply))
+            return 1
+        if args.json:
+            print(json.dumps(reply.get("metrics", [])))
+        else:
+            print(reply.get("text", ""), end="")
+        return 0
+
+    # trace
+    reply = _rpc(
+        args.host, args.port,
+        {"op": "trace", "tail": args.tail}, timeout=args.timeout,
+    )
+    if not reply.get("ok"):
+        print(json.dumps(reply))
+        return 1
+    spans = reply.get("spans", [])
+    if args.json:
+        print(json.dumps(spans))
+    else:
+        for s in spans:
+            print(_span_line(s))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,127 @@
+"""Device-side event streaming: graftscope's declared io_callback twin.
+
+Two seams feed device-side signals into the registry without touching
+the hot programs:
+
+* :func:`build_device_metrics_fn` -- a SEPARATE tiny compiled program
+  over the serve stack's stacked history arrays (losses/valid with the
+  leading study axis) that reduces per-round occupancy / trials-done /
+  best-loss on device and ships ONE ordered ``io_callback`` row to the
+  host sink.  The scheduler dispatches it only on its
+  ``device_metrics_every`` cadence -- cadence off means the twin is
+  never even built, so disabled tracing costs exactly zero extra
+  dispatches (the pin in ``tests/test_obs.py``).  Registered in
+  graftir as ``obs.device_metrics`` with the callback DECLARED in
+  ``allowed_callbacks`` (GL401's contract: an undeclared callback is a
+  finding, and so is a stale declaration).
+* :func:`progress_to_registry` -- the adapter that turns the chunked
+  device loop's existing declared progress rows (PR 10's
+  ``progress_callback`` seam) into registry gauges/counters, so
+  ``compile_fmin(metrics_registry=...)`` streams per-chunk
+  trials/sec + best-loss without a second callback program.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..ops.compile import ProgramCapture, register_program
+
+__all__ = ["build_device_metrics_fn", "progress_to_registry"]
+
+
+def build_device_metrics_fn(sink):
+    """Compile the metrics twin: ``(losses [S,N], valid [S,N], active
+    [S]) -> n_active`` with one ordered ``io_callback`` shipping
+    ``{"active_slots", "trials_done", "best_loss"}`` to ``sink``.
+
+    Read-only by contract: no donation, no state outputs -- the round's
+    streams cannot be perturbed by dispatching it (the invisibility
+    invariant), only by its wall-clock cost, which the cadence bounds.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import io_callback
+
+    def _emit(n_active, done, best):
+        sink({
+            "active_slots": int(n_active),
+            "trials_done": int(done),
+            "best_loss": float(best),
+        })
+
+    def metrics_fn(losses, valid, active):
+        ok = valid & jnp.isfinite(losses) & active[:, None]
+        best = jnp.min(jnp.where(ok, losses, jnp.inf))
+        done = jnp.sum(ok)
+        n_active = jnp.sum(active)
+        # the ONLY sanctioned host hop in this family: declared in the
+        # graftir registration's allowed_callbacks (GL401 contract)
+        io_callback(_emit, None, n_active, done, best, ordered=True)
+        return n_active
+
+    return jax.jit(metrics_fn)
+
+
+def progress_to_registry(registry, recorder=None, t0=None):
+    """A ``progress_callback`` for :func:`hyperopt_tpu.device_loop.
+    compile_fmin` that lands each declared per-chunk row on
+    ``registry``: ``device_loop_best_loss`` / ``device_loop_trials_done``
+    gauges, ``device_loop_trials_per_sec`` (since ``t0``, default the
+    adapter's construction), and the ``obs_device_events_total``
+    counter; ``recorder`` (optional) gets a ``device.chunk`` span per
+    row."""
+    start = time.perf_counter() if t0 is None else t0
+    best = registry.gauge(
+        "device_loop_best_loss", "best finite loss so far (per chunk)"
+    )
+    done_g = registry.gauge(
+        "device_loop_trials_done", "trials completed so far"
+    )
+    rate = registry.gauge(
+        "device_loop_trials_per_sec", "trials/sec since the run started"
+    )
+    events = registry.counter(
+        "obs_device_events_total",
+        "device->host metric rows received via declared io_callback",
+    )
+
+    def callback(row):
+        best.set(row["best_loss"])
+        done_g.set(row["trials_done"])
+        dt = time.perf_counter() - start  # graftlint: disable=GL307 elapsed-run denominator for the trials/sec gauge (the gauge IS the registry sink)
+        if dt > 0:
+            rate.set(row["trials_done"] / dt)
+        events.inc()
+        if recorder is not None:
+            recorder.event("device.chunk", **row)
+
+    return callback
+
+
+# ---------------------------------------------------------------------------
+# graftir registration (hyperopt-tpu-lint --ir)
+# ---------------------------------------------------------------------------
+
+
+@register_program(
+    "obs.device_metrics",
+    families=("hyperopt_tpu.obs.device:build_device_metrics_fn",),
+)
+def _registry_device_metrics(p):
+    """The serve metrics twin over the stacked study axis: read-only
+    reduction + one DECLARED ordered io_callback, no donation."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = build_device_metrics_fn(lambda row: None)
+    s, n = p.n_studies, p.n_obs
+    return ProgramCapture(
+        fn=fn,
+        args=(
+            jax.ShapeDtypeStruct((s, n), jnp.float32),
+            jax.ShapeDtypeStruct((s, n), jnp.bool_),
+            jax.ShapeDtypeStruct((s,), jnp.bool_),
+        ),
+        allowed_callbacks=("io_callback",),
+    )

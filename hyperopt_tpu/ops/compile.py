@@ -473,6 +473,7 @@ _PROGRAM_MODULES = (
     "hyperopt_tpu.serve.batched",
     "hyperopt_tpu.pbt",
     "hyperopt_tpu.hyperband",
+    "hyperopt_tpu.obs.device",
 )
 
 

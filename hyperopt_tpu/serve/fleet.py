@@ -50,6 +50,8 @@ import uuid
 from ..distributed import _common
 from ..distributed.faults import REAL_FS
 from ..exceptions import OwnershipLost, ReplicaDead
+from ..obs.expo import tag_rows
+from ..obs.registry import GaugeAttr, MetricsRegistry
 from .router import HashRing
 from .service import SuggestService, _study_guard
 
@@ -288,9 +290,18 @@ class Fleet:
     keep them identical across replicas or streams stop being
     placement-independent)."""
 
+    #: last failover's re-materialization time (ms) -- a graftscope
+    #: gauge behind the historic attribute name (None until the first
+    #: failover, exactly as before)
+    recovery_ms = GaugeAttr(
+        "fleet_recovery_ms",
+        "last failover's study re-materialization time",
+    )
+
     def __init__(self, space, root, n_replicas=3, algo="tpe",
                  replica_ids=None, plans=None, fs=REAL_FS, vnodes=64,
                  **service_kw):
+        self.metrics = MetricsRegistry("fleet")
         self.space = space
         self.root = str(root)
         self.algo = str(algo)
@@ -301,7 +312,6 @@ class Fleet:
         self.replicas = {}
         self.registry = set()  # studies created through the router
         self._moved = {}  # name -> rid: migration repoints ahead of ring
-        self.recovery_ms = None  # last failover's re-materialization time
         plans = plans or {}
         for rid in replica_ids or [f"r{i}" for i in range(n_replicas)]:
             plan = plans.get(rid)
@@ -393,7 +403,13 @@ class Fleet:
                 "failover: study %r re-materialized on %r (was %r)",
                 name, new_rid, rid,
             )
-        self.recovery_ms = 1000.0 * (time.perf_counter() - t0)
+        self.metrics.gauge(
+            "fleet_recovery_ms",
+            "last failover's study re-materialization time",
+        ).set_duration_ms(t0)
+        self.metrics.counter(
+            "fleet_failovers_total", "replica failovers executed"
+        ).inc()
         return owned
 
     # -- planned migration (the drain protocol) ----------------------------
@@ -453,6 +469,16 @@ class Fleet:
             )
             for rid, r in sorted(self.replicas.items())
         }
+
+    def metrics_rows(self):
+        """graftscope exposition for the whole (in-process) fleet: the
+        control plane's own series plus every live replica's, each
+        tagged with its replica id."""
+        rows = list(self.metrics.collect())
+        for rid, r in sorted(self.replicas.items()):
+            if not r.dead:
+                rows.extend(tag_rows(r.service.metrics_rows(), replica=rid))
+        return rows
 
     def counters(self):
         """Fleet-aggregate deterministic counters (summed)."""

@@ -34,9 +34,12 @@ import json
 import logging
 import socket
 import threading
+import time
 
 from ..distributed.faults import REAL_FS, SimulatedCrash
 from ..exceptions import OwnershipLost, ReplicaDead
+from ..obs.expo import merge_rows, render_prometheus, tag_rows
+from ..obs.registry import LATENCY_BUCKETS_S, MetricsRegistry
 
 logger = logging.getLogger(__name__)
 
@@ -259,15 +262,40 @@ class RouterServer:
     restores it), then the original op with ``recover`` set for asks.
     """
 
-    def __init__(self, backends, salt="", vnodes=64):
+    def __init__(self, backends, salt="", vnodes=64,
+                 probe_timeout=5.0):
         self.backends = {b.rid: b for b in backends}
         self.ring = HashRing(self.backends, salt=salt, vnodes=vnodes)
         self._lock = threading.Lock()
         self._dead = set()
+        # graftscope: the router's own series (probe health/latency,
+        # failovers observed) -- merged into the fleet-wide scrape
+        self.metrics = MetricsRegistry("router")
+        self._up_gauge = self.metrics.gauge(
+            "router_backend_up",
+            "1 = the last health probe (or forward) succeeded",
+            labels=("backend",),
+        )
+        self._probe_hist = self.metrics.histogram(
+            "router_probe_seconds", "health-probe round-trip time",
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self._probe_failures = self.metrics.counter(
+            "router_probe_failures_total", "failed health probes",
+        )
+        self._rejoins = self.metrics.counter(
+            "router_backend_rejoins_total",
+            "dead backends revived by a succeeding probe",
+        )
+        self.probe_timeout = float(probe_timeout)
+        self._probe_conns = {}  # the probe loop's OWN connection cache
+        self._probe_thread = None
+        self._probing = False
 
     def _mark_dead(self, rid):
         with self._lock:
             self._dead.add(rid)
+        self._up_gauge.labels(backend=rid).set(0)
 
     def _alive_excluded(self):
         with self._lock:
@@ -293,6 +321,10 @@ class RouterServer:
             return {"ok": True, "pong": True, "router": True}
         if op in ("health", "ready", "studies"):
             return self._aggregate(op, conns)
+        if op == "metrics":
+            return self._aggregate_metrics(conns)
+        if op == "trace":
+            return self._aggregate_trace(conns, req.get("tail"))
         name = req.get("name") or req.get("study")
         if not name:
             return {"ok": False, "error": f"op {op!r} needs a study name"}
@@ -307,12 +339,17 @@ class RouterServer:
                 reply = self._rpc(conns, rid, req)
                 if (
                     not reply.get("ok")
-                    and reply.get("error_type") == "UnknownStudy"
+                    and reply.get("error_type") in (
+                        "UnknownStudy", "OwnershipLost"
+                    )
                     and op != "create_study"
                 ):
                     # failover adoption: the ring owner has not loaded
-                    # this study yet -- restore it from the shared
-                    # root, then retry the op on the same backend
+                    # this study yet (UnknownStudy), or it is a
+                    # probe-recovered rejoiner still holding a stale
+                    # claim (OwnershipLost) -- restore/re-claim it from
+                    # the shared root, then retry the op on the same
+                    # backend
                     adopt = self._rpc(conns, rid, {
                         "op": "create_study", "name": name,
                         "takeover": True,
@@ -364,6 +401,130 @@ class RouterServer:
             })
             return {"ok": True, "studies": studies}
         return {"ok": True, "replicas": replies}
+
+    def _aggregate_metrics(self, conns):
+        """The fleet-wide scrape: every live replica's collected rows
+        (tagged with its replica id) plus the router's own, rendered
+        as ONE Prometheus text document -- one call scrapes the
+        fleet."""
+        row_lists = [tag_rows(self.metrics.collect(), component="router")]
+        scraped = []
+        for rid in sorted(self.backends):
+            if rid in self._alive_excluded():
+                continue
+            try:
+                reply = self._rpc(conns, rid, {"op": "metrics"})
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                conns.pop(rid, None)
+                continue
+            if reply.get("ok"):
+                row_lists.append(
+                    tag_rows(reply.get("metrics", []), replica=rid)
+                )
+                scraped.append(rid)
+        rows = merge_rows(*row_lists)
+        return {
+            "ok": True, "metrics": rows,
+            "text": render_prometheus(rows), "replicas": scraped,
+        }
+
+    def _aggregate_trace(self, conns, tail=None):
+        """Fleet-wide span tail: every live replica's recent spans
+        (each already stamped with its replica id at record time),
+        time-ordered."""
+        spans = []
+        for rid in sorted(self.backends):
+            if rid in self._alive_excluded():
+                continue
+            try:
+                reply = self._rpc(
+                    conns, rid, {"op": "trace", "tail": tail}
+                )
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                conns.pop(rid, None)
+                continue
+            if reply.get("ok"):
+                for s in reply.get("spans", []):
+                    s.setdefault("replica", rid)
+                    spans.append(s)
+        spans.sort(key=lambda s: s.get("ts", 0))
+        if tail is not None:
+            spans = spans[-int(tail):]
+        return {"ok": True, "spans": spans}
+
+    # -- health probing (graftscope satellite) -----------------------------
+    def probe_backends(self):
+        """One probe sweep over every backend, on the probe loop's OWN
+        reused connections: a failing backend is marked dead BEFORE any
+        client ask eats its connection failure; a dead backend whose
+        probe succeeds again rejoins the ring (its studies were adopted
+        elsewhere -- the lazy-adoption path hands them back request by
+        request, with no client-visible error either way)."""
+        for rid in sorted(self.backends):
+            t0 = time.perf_counter()
+            try:
+                reply = self._rpc(
+                    self._probe_conns, rid, {"op": "health"},
+                    timeout=self.probe_timeout,
+                )
+                ok = bool(reply.get("ok"))
+            except (OSError, ConnectionError, json.JSONDecodeError):
+                self._probe_conns.pop(rid, None)
+                ok = False
+            self._probe_hist.observe_since(t0)
+            if ok:
+                with self._lock:
+                    rejoined = rid in self._dead
+                    self._dead.discard(rid)
+                if rejoined:
+                    self._rejoins.inc()
+                    logger.info(
+                        "router: backend %s probe-recovered; rejoining "
+                        "the ring", rid,
+                    )
+                self._up_gauge.labels(backend=rid).set(1)
+            else:
+                self._probe_failures.inc()
+                already = rid in self._alive_excluded()
+                self._mark_dead(rid)
+                if not already:
+                    logger.warning(
+                        "router: backend %s failed its health probe; "
+                        "marked suspect before any client ask hit it",
+                        rid,
+                    )
+
+    def start_probes(self, interval=1.0):
+        """Run :meth:`probe_backends` on a background thread every
+        ``interval`` seconds (the production liveness loop; tests call
+        ``probe_backends`` directly for determinism)."""
+        if self._probe_thread is not None:
+            return
+        self._probing = True
+        interval = float(interval)
+
+        def _probe_loop():
+            while self._probing:
+                self.probe_backends()
+                time.sleep(interval)
+
+        self._probe_thread = threading.Thread(
+            target=_probe_loop, name="graftscope-router-probe", daemon=True
+        )
+        self._probe_thread.start()
+
+    def stop_probes(self):
+        self._probing = False
+        t = self._probe_thread
+        self._probe_thread = None
+        if t is not None:
+            t.join(timeout=5.0)
+        for f in self._probe_conns.values():
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._probe_conns.clear()
 
     def serve_forever(self, host="127.0.0.1", port=0):
         """Bind the JSON-line front; returns the (not yet serving)
@@ -441,6 +602,13 @@ def main(argv=None):
     parser.add_argument("--vnodes", type=int, default=64)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=7076)
+    parser.add_argument(
+        "--probe-interval", type=float, default=1.0,
+        help="seconds between background health probes of every "
+        "backend (graftscope: per-backend connection reuse, suspect "
+        "marking before client asks fail, probe-recovered backends "
+        "rejoin the ring); 0 disables probing",
+    )
     args = parser.parse_args(argv)
 
     backends = []
@@ -452,6 +620,8 @@ def main(argv=None):
         backends.append(_Backend(rid, host, int(port)))
     router = RouterServer(backends, salt=args.salt, vnodes=args.vnodes)
     server = router.serve_forever(host=args.host, port=args.port)
+    if args.probe_interval > 0:
+        router.start_probes(interval=args.probe_interval)
     host, port = server.server_address[:2]
     print(
         f"hyperopt-tpu-router listening on {host}:{port} "
@@ -462,6 +632,7 @@ def main(argv=None):
     except KeyboardInterrupt:
         pass
     finally:
+        router.stop_probes()
         server.server_close()
     return 0
 

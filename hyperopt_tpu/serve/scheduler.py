@@ -50,6 +50,14 @@ from ..exceptions import (
     StudyQuarantined,
 )
 from ..jax_trials import MAX_PENDING_DELTAS, MIN_CAPACITY, ObsBuffer
+from ..obs.flightrec import NULL_RECORDER
+from ..obs.registry import (
+    LATENCY_BUCKETS_MS,
+    RATIO_BUCKETS,
+    CounterAttr,
+    HistogramAttr,
+    MetricsRegistry,
+)
 from .batched import (
     StudyBatchState,
     _dummy_delta,
@@ -225,12 +233,71 @@ class BatchScheduler:
       (``tests/test_serve_guard.py``) drives all of the above with it.
     """
 
+    # graftscope: every deterministic counter and timing window lives
+    # on the scheduler's MetricsRegistry, exposed BEHIND its historic
+    # attribute name (CounterAttr/HistogramAttr descriptors), so bench,
+    # tests, and the counters dict read exactly what they always did
+    # while the metrics op / router scrape get typed, bounded series
+    dispatch_count = CounterAttr(
+        "serve_dispatch_total", "batched step programs run")
+    delta_drain_dispatches = CounterAttr(
+        "serve_delta_drain_dispatches_total",
+        "backlog-drain delta programs (included in serve_dispatch_total)")
+    upload_events = CounterAttr(
+        "serve_upload_events_total", "stacked re-materializations")
+    upload_bytes = CounterAttr(
+        "serve_upload_bytes_total", "bytes re-uploaded to device")
+    joins = CounterAttr("serve_joins_total", "studies joined")
+    rebuckets = CounterAttr(
+        "serve_rebuckets_total", "slot/obs geometry growth events")
+    shard_restacks = CounterAttr(
+        "serve_shard_restacks_total",
+        "graftmesh shard-local re-materializations")
+    admitted_count = CounterAttr(
+        "serve_admitted_total", "asks admitted past admission control")
+    shed_count = CounterAttr(
+        "serve_shed_total", "Overloaded + DeadlineExpired refusals")
+    guard_checks = CounterAttr(
+        "serve_guard_checks_total", "finite-check programs run")
+    quarantine_count = CounterAttr(
+        "serve_quarantine_trips_total",
+        "finite-check trips (per slot-round)")
+    evictions = CounterAttr(
+        "serve_evictions_total", "studies evicted after K trips")
+    watchdog_timeouts = CounterAttr(
+        "serve_watchdog_timeouts_total", "dispatch watchdog deadline hits")
+    watchdog_retries = CounterAttr(
+        "serve_watchdog_retries_total", "watchdog retry rounds")
+    watchdog_recoveries = CounterAttr(
+        "serve_watchdog_recoveries_total", "watchdog retries that healed")
+    device_metric_dispatches = CounterAttr(
+        "serve_device_metric_dispatches_total",
+        "obs.device_metrics twin dispatches (cadence-gated; NOT part "
+        "of serve_dispatch_total)")
+    ask_latencies = HistogramAttr(
+        "serve_ask_latency_seconds", "submit-to-ack ask latency",
+        window=METRICS_WINDOW)
+    occupancy = HistogramAttr(
+        "serve_batch_occupancy", "filled-slot fraction per round",
+        buckets=RATIO_BUCKETS, window=METRICS_WINDOW)
+    watchdog_recovery_ms = HistogramAttr(
+        "serve_watchdog_recovery_ms", "watchdog retry-to-heal latency",
+        buckets=LATENCY_BUCKETS_MS, window=METRICS_WINDOW)
+
     def __init__(self, ps, algo="tpe", max_batch=64, max_wait=0.002,
                  n_startup_jobs=20, fs=REAL_FS, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
                  finite_check=True, quarantine_trips=QUARANTINE_TRIPS,
                  circuit_threshold=CIRCUIT_THRESHOLD, mesh=None,
+                 recorder=None, device_metrics_every=0,
                  **algo_kw):
+        # graftscope wiring first: the descriptors above resolve
+        # through this registry from the first counter touch on
+        self.metrics = MetricsRegistry("serve")
+        self.recorder = recorder if recorder is not None else NULL_RECORDER
+        self.span_ids = {}  # correlation ids stamped on every span
+        self.device_metrics_every = int(device_metrics_every)
+        self._device_metrics_fn = None  # built lazily iff cadence on
         self.ps = ps
         self.algo = str(algo)
         self.max_batch = int(max_batch)
@@ -315,30 +382,21 @@ class BatchScheduler:
         self._round_failures = 0  # CONSECUTIVE failed dispatch rounds
         self._queued_per_study = collections.Counter()
 
-        # deterministic accounting
-        self.dispatch_count = 0
-        self.delta_drain_dispatches = 0
-        self.upload_events = 0
-        self.upload_bytes = 0
-        self.joins = 0
-        self.rebuckets = 0
-        self.shard_restacks = 0  # graftmesh shard-local re-uploads
-        # graftguard accounting (deterministic, except the _ms timings)
-        self.admitted_count = 0
-        self.shed_count = 0  # Overloaded + DeadlineExpired refusals
-        self.guard_checks = 0  # finite-check programs run
-        self.quarantine_count = 0  # finite-check trips (per slot-round)
-        self.evictions = 0  # studies evicted after K trips
-        self.watchdog_timeouts = 0
-        self.watchdog_retries = 0
-        self.watchdog_recoveries = 0
-        self.watchdog_recovery_ms = collections.deque(
-            maxlen=METRICS_WINDOW
-        )
-        # bounded: bench metrics on a long-running service must not
-        # grow one entry per ask forever (slow leak at scale)
-        self.ask_latencies = collections.deque(maxlen=METRICS_WINDOW)
-        self.occupancy = collections.deque(maxlen=METRICS_WINDOW)
+        # deterministic accounting + bounded timing windows: all
+        # graftscope registry series now (see the descriptor block at
+        # the top of the class); touching each one here materializes
+        # the full series set so a scrape of an idle scheduler is
+        # already schema-complete
+        for attr in (
+            "dispatch_count", "delta_drain_dispatches", "upload_events",
+            "upload_bytes", "joins", "rebuckets", "shard_restacks",
+            "admitted_count", "shed_count", "guard_checks",
+            "quarantine_count", "evictions", "watchdog_timeouts",
+            "watchdog_retries", "watchdog_recoveries",
+            "device_metric_dispatches", "ask_latencies", "occupancy",
+            "watchdog_recovery_ms",
+        ):
+            getattr(self, attr)
 
     # -- tenancy -----------------------------------------------------------
     def _alloc_slot(self):
@@ -417,6 +475,7 @@ class BatchScheduler:
         Idempotent by tid: a client re-telling work whose ack a
         crashed service lost (the tell may already have been WAL-
         replayed on restore) is absorbed exactly once."""
+        rec = self.recorder
         with self._lock:
             if study.quarantined:
                 raise StudyQuarantined(
@@ -427,12 +486,29 @@ class BatchScheduler:
             if (buf.tids[: buf.count] == int(tid)).any():
                 study.outstanding.pop(tid, None)
                 return
+            t0 = time.perf_counter() if rec.enabled else 0.0
             if study.persist is not None:
                 study.persist.log_tell(tid, vals, loss)
+            if rec.enabled:
+                t1 = time.perf_counter()
+                rec.record(
+                    "tell.wal_append", t0, t1, study=study.name,
+                    tid=int(tid), **self.span_ids,
+                )
             self.fs.crashpoint("serve_after_wal_before_dispatch")
             self._apply_tell(study, tid, vals, loss)
             study.outstanding.pop(tid, None)
             study.pending_asks.pop(int(tid), None)
+            if rec.enabled:
+                t2 = time.perf_counter()
+                rec.record(
+                    "tell.applied", t1, t2, study=study.name,
+                    tid=int(tid), **self.span_ids,
+                )
+                rec.record(
+                    "tell", t0, t2, study=study.name, tid=int(tid),
+                    **self.span_ids,
+                )
 
     def _apply_tell(self, study, tid, vals, loss):
         """Host-side tell application (shared with WAL replay, which
@@ -483,7 +559,7 @@ class BatchScheduler:
         floor = self.retry_after()
         if self.drain_deadline is None:
             return floor
-        left = self.drain_deadline - time.perf_counter()
+        left = self.drain_deadline - time.perf_counter()  # graftlint: disable=GL307 deadline arithmetic (time left until the published drain deadline), not an ad-hoc latency metric
         return round(max(left, floor, 0.001), 6)
 
     def _dec_queue(self, req):
@@ -587,6 +663,11 @@ class BatchScheduler:
             req = _AskRequest(study, tid, seed, deadline=deadline)
             self._asks.append(req)
             self._queued_per_study[study.name] += 1
+            if self.recorder.enabled:
+                self.recorder.event(
+                    "ask.submit", study=study.name, tid=tid,
+                    queue_depth=len(self._asks), **self.span_ids,
+                )
             self._cond.notify_all()
             return req
 
@@ -753,6 +834,13 @@ class BatchScheduler:
             self._dec_queue(req)
             picked.append(req)
         self._asks = leftover
+        if self.recorder.enabled:
+            rec, now2 = self.recorder, time.perf_counter()
+            for req in picked:
+                rec.record(
+                    "ask.queued", req.t_submit, now2,
+                    study=req.study.name, tid=req.tid, **self.span_ids,
+                )
         return picked
 
     def step(self):  # graftlint: disable=GL505 the BaseException path fails picked futures before re-raising a simulated/real process death -- reordering outside the lock would let a racing submit observe a dying batcher; no done-callbacks exist (see _pick_round)
@@ -937,11 +1025,19 @@ class BatchScheduler:
                 ))
             return new_state, new_v, new_a, poisoned
 
+        t_disp = time.perf_counter() if self.recorder.enabled else 0.0
         new_state, new_v, new_a, poisoned = self._run_dispatch(run)
         self._state = new_state
         self.dispatch_count += 1
         if self.finite_check:
             self.guard_checks += 1
+        if self.recorder.enabled:
+            self.recorder.record(
+                "serve.dispatch", t_disp, time.perf_counter(),
+                n_picked=len(picked), slots=s, shards=self._n_shards,
+                **self.span_ids,
+            )
+        self._dispatch_device_metrics(new_state)
         bad_slots = self._quarantine(poisoned)
         self.fs.crashpoint("serve_after_dispatch_before_ack")
         now = time.perf_counter()
@@ -976,13 +1072,71 @@ class BatchScheduler:
         # acks last: a crash above leaves every pick un-acked and
         # replayable, never half-acked
         served = 0
+        rec = self.recorder
+        blk = max(1, s // self._n_shards)
         for req, vals in results:
             if isinstance(vals, Exception):
                 req.future.set_exception(vals)
             else:
                 req.future.set_result((req.tid, vals))
                 served += 1
+                if rec.enabled:
+                    slot = req.study.slot
+                    rec.record(
+                        "ask.delivered", req.t_submit, now,
+                        study=req.study.name, tid=req.tid, slot=slot,
+                        shard=(slot // blk if slot is not None else None),
+                        **self.span_ids,
+                    )
         return served
+
+    def _dispatch_device_metrics(self, state):  # graftlint: disable=GL503 the metrics twin runs inside the round serialization point by design (one dispatch in flight, ever -- see _run_dispatch); its cost is cadence-bounded
+        """The graftscope device twin (lock held): on cadence, run the
+        read-only ``obs.device_metrics`` program over the fresh stacked
+        state -- one declared io_callback row lands per-round
+        occupancy / trials-done / best-loss on the registry.  Cadence
+        off (the default) never builds the program: exactly zero extra
+        dispatches (the test_obs pin)."""
+        every = self.device_metrics_every
+        if every <= 0 or self.dispatch_count % every:
+            return
+        if self._device_metrics_fn is None:
+            from ..obs.device import build_device_metrics_fn
+
+            m = self.metrics
+            best = m.gauge(
+                "serve_device_best_loss",
+                "best finite loss across the stacked batch (device twin)",
+            )
+            done = m.gauge(
+                "serve_device_trials_done",
+                "valid observations across the stacked batch (device twin)",
+            )
+            active_g = m.gauge(
+                "serve_device_active_slots",
+                "occupied slots this round (device twin)",
+            )
+            events = m.counter(
+                "obs_device_events_total",
+                "device->host metric rows received via declared "
+                "io_callback",
+            )
+            rec = self.recorder
+
+            def sink(row):
+                best.set(row["best_loss"])
+                done.set(row["trials_done"])
+                active_g.set(row["active_slots"])
+                events.inc()
+                if rec.enabled:
+                    rec.event("device.metrics", **row)
+
+            self._device_metrics_fn = build_device_metrics_fn(sink)
+        active = np.zeros(self._slot_cap, dtype=bool)
+        for slot in self._slots:
+            active[slot] = True
+        self._device_metrics_fn(state.losses, state.valid, active)
+        self.device_metric_dispatches += 1
 
     def _quarantine(self, poisoned):
         """Apply one round's finite-check verdicts (lock held): trip
@@ -1097,7 +1251,7 @@ class BatchScheduler:
                 while (
                     not self._stopping
                     and not self._ready()
-                    and (remaining := deadline - time.perf_counter()) > 0
+                    and (remaining := deadline - time.perf_counter()) > 0  # graftlint: disable=GL307 max_wait budget arithmetic (how long to keep coalescing), not a metric
                 ):
                     self._cond.wait(timeout=min(remaining, 0.05))
                 if self._stopping:

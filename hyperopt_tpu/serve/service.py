@@ -355,7 +355,8 @@ class SuggestService:
                  max_wait_ms=2.0, n_startup_jobs=20, background=True,
                  fs=REAL_FS, snapshot_cadence=256, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
-                 finite_check=True, mesh=None, owner=None, **algo_kw):
+                 finite_check=True, mesh=None, owner=None, recorder=None,
+                 device_metrics_every=0, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -377,8 +378,16 @@ class SuggestService:
             n_startup_jobs=n_startup_jobs, fs=fs, max_queue=max_queue,
             study_queue_cap=study_queue_cap,
             dispatch_timeout=dispatch_timeout,
-            finite_check=finite_check, mesh=mesh, **algo_kw,
+            finite_check=finite_check, mesh=mesh, recorder=recorder,
+            device_metrics_every=device_metrics_every, **algo_kw,
         )
+        # graftscope identity: every series and span a fleet replica
+        # emits carries its owner id, so the router-side merge can
+        # tell replicas apart without re-tagging
+        self.recorder = self.scheduler.recorder
+        if self.owner is not None:
+            self.scheduler.metrics.const_labels["replica"] = self.owner
+            self.scheduler.span_ids["replica"] = self.owner
         if self._background:
             self.scheduler.start()
 
@@ -397,7 +406,24 @@ class SuggestService:
             )
         with self._lock:
             if name in self._handles:
-                return self._handles[name]
+                handle = self._handles[name]
+                stale = handle._study.claim
+                if not (
+                    takeover and stale is not None and not stale.is_live()
+                ):
+                    return handle
+                # probe-recovered rejoin (graftscope): this replica
+                # held the study, lost its claim while it was marked
+                # dead (a survivor took it over), and the router is now
+                # re-adopting it here.  Every local mutation since the
+                # takeover was fenced off (OwnershipLost), so the
+                # shared root is the truth: discard the stale resident
+                # state and fall through to a fresh claim + restore --
+                # the client never sees an error
+                self._handles.pop(name, None)
+                self.scheduler.close_study(name)
+                if handle._study.persist is not None:
+                    handle._study.persist.close()
             claim = None
             if self.owner is not None and self.root is not None:
                 from .fleet import StudyClaim
@@ -593,6 +619,32 @@ class SuggestService:
             "watchdog_recoveries": s.watchdog_recoveries,
         }
 
+    def metrics_rows(self):
+        """graftscope exposition: refresh the point-in-time gauges,
+        then one snapshot-consistent collect of the scheduler registry
+        (every series already carries ``replica=<owner>`` on a fleet
+        member)."""
+        s = self.scheduler
+        m = s.metrics
+        with self._lock:
+            n_studies = len(self._handles)
+        m.gauge("serve_studies", "open studies").set(n_studies)
+        m.gauge("serve_queue_depth", "asks queued").set(len(s._asks))
+        m.gauge(
+            "serve_ready", "1 = accepting asks (health/ready protocol)"
+        ).set(1 if self.ready() else 0)
+        return m.collect()
+
+    def metrics_text(self):
+        from ..obs import render_prometheus
+
+        return render_prometheus(self.metrics_rows())
+
+    def trace_tail(self, n=None):
+        """The most recent flight-recorder spans (empty when no
+        recorder is armed)."""
+        return self.recorder.tail(n)
+
     def ready(self):
         """Readiness for traffic: False while draining, circuit-broken,
         or stopped -- the load balancer's drain signal."""
@@ -650,6 +702,8 @@ class SuggestService:
         with self._lock:
             for name in list(self._handles):
                 self.close_study(name)
+        self.recorder.flush()  # orderly exit: span export durable
+        self.recorder.close()
 
 
 # ---------------------------------------------------------------------------
@@ -691,6 +745,22 @@ def _handle_request(service, req):
             return {"ok": True, **service.health()}
         if op == "ready":
             return {"ok": True, "ready": service.ready()}
+        if op == "metrics":
+            rows = service.metrics_rows()
+            from ..obs import render_prometheus
+
+            return {
+                "ok": True, "metrics": rows,
+                "text": render_prometheus(rows),
+            }
+        if op == "trace":
+            tail = req.get("tail")
+            return {
+                "ok": True,
+                "spans": service.trace_tail(
+                    None if tail is None else int(tail)
+                ),
+            }
         if op == "create_study":
             h = service.create_study(
                 req["name"], seed=int(req.get("seed", 0)),
@@ -830,6 +900,23 @@ def main(argv=None):
         "against double-serving a study another replica took over "
         "(graftfleet; front replicas with hyperopt-tpu-router)",
     )
+    parser.add_argument(
+        "--flight-log", default=None, metavar="PATH",
+        help="arm the graftscope flight recorder with a WAL-style "
+        "durable span export at PATH (scrape live with "
+        "hyperopt-tpu-scope trace, post-mortem with "
+        "hyperopt-tpu-scope flight PATH)",
+    )
+    parser.add_argument(
+        "--trace-cadence", type=int, default=1,
+        help="flight-recorder sampling cadence (1 = record every "
+        "span; k keeps every k-th); only meaningful with --flight-log",
+    )
+    parser.add_argument(
+        "--device-metrics-every", type=int, default=0,
+        help="dispatch the obs.device_metrics io_callback twin every "
+        "N rounds (0 = off: exactly zero extra dispatches)",
+    )
     args = parser.parse_args(argv)
 
     mesh = None
@@ -839,12 +926,20 @@ def main(argv=None):
         mesh = study_mesh(
             None if args.mesh_devices < 0 else args.mesh_devices
         )
+    recorder = None
+    if args.flight_log:
+        from ..obs import FlightRecorder
+
+        recorder = FlightRecorder(
+            path=args.flight_log, cadence=args.trace_cadence
+        )
     service = SuggestService(
         _load_space(args.space), algo=args.algo, root=args.root,
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         n_startup_jobs=args.n_startup_jobs, max_queue=args.max_queue,
         dispatch_timeout=args.dispatch_timeout or None, mesh=mesh,
-        owner=args.owner,
+        owner=args.owner, recorder=recorder,
+        device_metrics_every=args.device_metrics_every,
     )
     server = serve_forever(service, host=args.host, port=args.port)
     host, port = server.server_address[:2]

@@ -1,0 +1,24 @@
+"""GL307 true positives: ad-hoc timing/metric state in library code --
+hand-rolled counter attributes accumulated outside the graftscope
+registry, and inline time deltas that never land on a registry sink
+(the pre-graftscope serve/scheduler idiom this rule retires)."""
+
+import time
+
+
+class DispatchLoop:
+    def __init__(self):
+        self.dispatches = 0          # counter-shaped: literal init...
+        self.shed = 0
+        self.last_latency = 0.0
+        self._rounds = 0             # private control state: exempt
+
+    def step(self, batch):
+        t0 = time.perf_counter()
+        self.dispatches += 1         # GL307: hand-rolled counter
+        self._rounds += 1            # exempt (underscore)
+        if not batch:
+            self.shed += 1           # GL307: hand-rolled counter
+        # GL307: the delta lives on a plain attribute, not a registry
+        self.last_latency = time.perf_counter() - t0
+        return batch

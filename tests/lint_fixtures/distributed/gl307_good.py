@@ -1,0 +1,40 @@
+"""GL307 near-misses: the migrated idioms -- registry descriptors
+behind the historic attribute names, deltas computed en route to a
+registry sink (observe/observe_since), deadline arithmetic that is
+control flow rather than a metric, and private control state."""
+
+import time
+
+from hyperopt_tpu.obs.registry import (
+    CounterAttr,
+    HistogramAttr,
+    MetricsRegistry,
+)
+
+
+class DispatchLoop:
+    dispatches = CounterAttr("dispatch_total", "rounds dispatched")
+    shed = CounterAttr("shed_total", "requests refused")
+    latencies = HistogramAttr("dispatch_seconds", "round latency")
+
+    def __init__(self, deadline=None):
+        self.metrics = MetricsRegistry("loop")
+        self.deadline = deadline
+        self._rounds = 0  # private control state, not a metric
+
+    def step(self, batch):
+        t0 = time.perf_counter()
+        self.dispatches += 1          # registry-backed descriptor
+        self._rounds += 1
+        if not batch:
+            self.shed += 1            # registry-backed descriptor
+        # the delta feeds a registry sink directly
+        self.latencies.append(time.perf_counter() - t0)
+        self.metrics.histogram("dispatch_seconds").observe_since(t0)
+        return batch
+
+    def time_left(self):
+        if self.deadline is None:
+            return None
+        # comparison/budget arithmetic is control flow, not a metric
+        return max(0.0, self.deadline - time.monotonic())

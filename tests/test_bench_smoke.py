@@ -128,6 +128,14 @@ def test_bench_prints_one_json_line():
     assert d["fleet_ask_p99_ms_failover"] > 0
     assert d["fleet_recovery_ms"] > 0
     assert d["fleet_replicas"] == 3
+    # round-19 graftscope rows: tracing-armed overhead fractions
+    # (deterministic zero-extra-dispatch half pinned in test_obs.py;
+    # these are the measured wall-clock halves), span throughput, and
+    # one fleet-wide /metrics scrape through a live TCP router
+    assert d["obs_overhead_frac_serve"] >= 0
+    assert d["obs_overhead_frac_fused"] >= 0
+    assert d["obs_events_per_sec"] > 0
+    assert d["metrics_scrape_ms_fleet"] > 0
     # round-17: graftmesh rows -- per-mesh-shape throughput of the
     # study-sharded serve engine and the shard_map PBT schedule, keyed
     # by mesh shape, plus the scaling-efficiency diagnostic per family

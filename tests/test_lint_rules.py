@@ -18,7 +18,7 @@ FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 PACK_RULES = [
     "GL101", "GL102", "GL103", "GL104",
     "GL201", "GL202", "GL203",
-    "GL301", "GL302", "GL303", "GL304", "GL305", "GL306",
+    "GL301", "GL302", "GL303", "GL304", "GL305", "GL306", "GL307",
 ]
 
 
@@ -66,6 +66,9 @@ def test_known_finding_counts():
     # two leaking attrs (latencies + trace), one finding per append
     # site; the rebound queue attr must contribute none
     assert len(_lint(_fixture_path("GL306", "bad"))) == 2
+    # two hand-rolled counter bumps + one ad-hoc timing delta; the
+    # underscore-private control attr must contribute none
+    assert len(_lint(_fixture_path("GL307", "bad"))) == 3
 
 
 def test_partial_wrapped_functions_resolve_as_jitted():

@@ -1,0 +1,664 @@
+"""graftscope (ISSUE 14): the unified observability subsystem.
+
+The acceptance contract:
+
+* BITWISE INVISIBILITY: the PR-8 multi-study parity scenario and the
+  PR-13 fleet kill-mid-batch chaos scenario, run with a flight
+  recorder armed at FULL cadence (and the device-metrics twin at
+  cadence 1), produce suggestion streams identical to the untracked
+  runs -- observability observes, it never perturbs;
+* ZERO COST WHEN OFF: with device metrics disabled (the default), the
+  dispatch count is exactly the untracked run's -- no extra programs;
+* BOUNDED BY CONSTRUCTION: registries cap label cardinality at
+  registration, histograms are fixed buckets + a maxlen ring, the
+  flight recorder is a maxlen ring;
+* RECOVERABLE EXPORT: a crash mid-span-export (the
+  ``obs_flight_export_mid_append`` point) leaves a torn tail that
+  ``hyperopt-tpu-fsck --obs`` truncates, with every span before the
+  tear intact;
+* BACK-COMPAT: every pre-graftscope attribute read path -- counters
+  dicts, ``ask_latencies`` slicing, ObsBuffer traffic counters,
+  ``fleet.recovery_ms`` -- reads exactly what it always did.
+"""
+
+import json
+import pickle
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.distributed.faults import (
+    OBS_CRASH_POINTS,
+    ALL_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.obs import (
+    NULL_RECORDER,
+    FlightRecorder,
+    MetricsRegistry,
+    audit_flight_log,
+    read_flight_log,
+    render_prometheus,
+)
+from hyperopt_tpu.obs.registry import CounterAttr, HistogramAttr
+from hyperopt_tpu.serve import SuggestService
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # every scheduler this suite builds runs under the graftrace
+    # lockdep sanitizer -- tracing must not introduce an inversion
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
+# ---------------------------------------------------------------------------
+# registry units
+# ---------------------------------------------------------------------------
+
+
+def test_registry_types_and_snapshot():
+    r = MetricsRegistry("t", const_labels={"replica": "r9"})
+    c = r.counter("ops_total", "ops")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = r.gauge("depth")
+    assert g.value is None  # unambiguous "never set"
+    g.set(3)
+    g.inc()
+    assert g.value == 4
+    h = r.histogram("lat_seconds", buckets=(0.01, 0.1))
+    h.observe(0.005)
+    h.observe_since(time.perf_counter() - 0.05)
+    rows = {row["name"]: row for row in r.collect()}
+    assert rows["ops_total"]["value"] == 5
+    assert rows["ops_total"]["labels"] == {"replica": "r9"}
+    assert rows["lat_seconds"]["count"] == 2
+    assert rows["lat_seconds"]["buckets"][0]["count"] == 1
+    # get-or-create is type-checked, never a silent shadow
+    with pytest.raises(TypeError):
+        r.gauge("ops_total")
+
+
+def test_registry_label_cardinality_capped():
+    r = MetricsRegistry(label_cap=8)
+    fam = r.gauge("up", labels=("backend",))
+    for i in range(50):
+        fam.labels(backend=f"b{i}").set(1)
+    # 8 real children + the shared overflow series: bounded forever
+    assert len(fam._children) <= 9
+    names = {row["labels"]["backend"] for row in r.collect()}
+    assert "_overflow" in names
+
+
+def test_histogram_ring_bounded_and_back_compat_append():
+    r = MetricsRegistry()
+    h = r.histogram("w", buckets=(1.0,), window=16)
+    for i in range(100):
+        h.ring.append(0.5)  # the pre-graftscope deque write path
+    assert len(h.ring) == 16  # ring bounded
+    assert h.count == 100  # buckets saw every append
+    assert sorted(h.ring)[0] == 0.5  # deque reads still work
+
+
+def test_registry_pickles_and_heals_old_objects():
+    r = MetricsRegistry("p")
+    r.counter("a_total").inc(3)
+    r.histogram("h").observe(1.0)
+    r2 = pickle.loads(pickle.dumps(r))
+    assert r2.counter("a_total").value == 3
+    assert r2.histogram("h").count == 1
+    r2.counter("a_total").inc()  # fresh lock works
+
+    class Thing:
+        n = CounterAttr("n_total")
+        lats = HistogramAttr("lats")
+
+    t = Thing()
+    t.n += 2
+    t.lats.append(0.5)
+    assert t.n == 2
+    # an object unpickled from a pre-graftscope artifact has no
+    # .metrics attr: the descriptor heals it lazily
+    t2 = Thing()
+    assert t2.n == 0
+
+
+def test_prometheus_rendering_shape():
+    r = MetricsRegistry()
+    r.counter("x_total", "things").inc(2)
+    r.histogram("d_seconds", buckets=(0.1,)).observe(0.05)
+    text = render_prometheus(r.collect())
+    assert "# TYPE x_total counter" in text
+    assert "x_total 2" in text
+    assert 'd_seconds_bucket{le="0.1"} 1' in text
+    assert 'd_seconds_bucket{le="+Inf"} 1' in text
+    assert "d_seconds_count 1" in text
+
+
+# ---------------------------------------------------------------------------
+# flight recorder units + torn-export recovery
+# ---------------------------------------------------------------------------
+
+
+def test_recorder_ring_cadence_and_null():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        rec.record("e", tid=i)
+    assert rec.recorded_total == 10
+    assert [s["tid"] for s in rec.tail()] == [6, 7, 8, 9]  # bounded
+    assert [s["tid"] for s in rec.tail(2)] == [8, 9]
+    sampled = FlightRecorder(cadence=3)
+    for i in range(9):
+        sampled.record("e", tid=i)
+    assert [s["tid"] for s in sampled.tail()] == [0, 3, 6]
+    assert not NULL_RECORDER.enabled
+    assert NULL_RECORDER.record("x") is None and NULL_RECORDER.tail() == []
+
+
+def test_flight_export_roundtrip(tmp_path):
+    path = str(tmp_path / "flight.wal")
+    rec = FlightRecorder(path=path)
+    t0 = time.perf_counter()
+    rec.record("ask.delivered", t0, t0 + 0.001, study="s0", tid=1)
+    rec.record("tell", study="s0", tid=1)
+    rec.flush()
+    rec.close()
+    spans = read_flight_log(path)
+    assert [s["name"] for s in spans] == ["ask.delivered", "tell"]
+    assert spans[0]["study"] == "s0" and spans[0]["dur_ms"] > 0
+    assert audit_flight_log(path) == []  # clean log, clean audit
+
+
+def test_flight_export_torn_tail_recovered_via_fsck(tmp_path):
+    """THE flight-recorder crash pin: die mid-export, prove the torn
+    tail recoverable via fsck --obs with every prior span intact, and
+    a restarted recorder appending onto the repaired prefix."""
+    from hyperopt_tpu.distributed import fsck
+
+    assert OBS_CRASH_POINTS[0] in ALL_CRASH_POINTS
+    path = str(tmp_path / "flight.wal")
+    plan = FaultPlan(seed=3)
+    plan.arm("obs_flight_export_mid_append", at=4)
+    rec = FlightRecorder(path=path, fs=plan.fs())
+    with pytest.raises(SimulatedCrash):
+        for i in range(10):
+            rec.record("span", tid=i)
+    issues = fsck.audit_obs(path)
+    assert [i.kind for i in issues] == ["obs_torn_tail"]
+    # the CLI contract: audit reports (rc 1), --repair heals (rc 0)
+    assert fsck.main(["--obs", path]) == 1
+    assert fsck.main(["--obs", path, "--repair"]) == 0
+    assert fsck.audit_obs(path) == []
+    spans = read_flight_log(path)
+    assert [s["tid"] for s in spans] == [0, 1, 2]  # pre-crash intact
+    # a restarted recorder appends cleanly onto the valid prefix
+    rec2 = FlightRecorder(path=path)
+    rec2.record("span", tid=99)
+    rec2.close()
+    assert [s["tid"] for s in read_flight_log(path)] == [0, 1, 2, 99]
+
+
+def test_flight_reopen_self_heals_torn_tail(tmp_path):
+    """A restarted recorder that reopens a torn log truncates the tail
+    itself (the fsck-less crash-restart path)."""
+    path = str(tmp_path / "flight.wal")
+    plan = FaultPlan(seed=5)
+    plan.arm("obs_flight_export_mid_append", at=2)
+    rec = FlightRecorder(path=path, fs=plan.fs())
+    with pytest.raises(SimulatedCrash):
+        for i in range(5):
+            rec.record("span", tid=i)
+    rec2 = FlightRecorder(path=path)
+    rec2.record("span", tid=7)
+    rec2.close()
+    assert audit_flight_log(path) == []
+    assert [s["tid"] for s in read_flight_log(path)] == [0, 7]
+
+
+# ---------------------------------------------------------------------------
+# back-compat: the migrated counters read exactly as before
+# ---------------------------------------------------------------------------
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+ALGO_KW = dict(n_cand=8, n_cand_cat=4)
+
+
+def _loss(vals):
+    return (vals["x"]) ** 2 / 10 + abs(float(np.log(vals["lr"])) + 2) / 3
+
+
+def _drive(svc, handles, rounds, streams=None):
+    for _ in range(rounds):
+        futs = [(h, h.ask_async()) for h in handles]
+        svc.pump()
+        for h, f in futs:
+            tid, vals = f.result(timeout=30)
+            if streams is not None:
+                streams.setdefault(h.name, []).append(vals)
+            h.tell(tid, _loss(vals))
+
+
+def test_scheduler_counters_back_compat_and_exposition():
+    svc = SuggestService(
+        SPACE, max_batch=4, background=False, n_startup_jobs=2, **ALGO_KW
+    )
+    handles = [svc.create_study(f"s{i}", seed=i) for i in range(3)]
+    _drive(svc, handles, 3)
+    s = svc.scheduler
+    # the historic read paths: plain ints, sliceable deques, the
+    # counters dict -- all now registry-backed
+    assert s.dispatch_count == 3
+    assert isinstance(s.dispatch_count, int)
+    assert svc.counters["dispatch_count"] == 3
+    assert len(list(s.ask_latencies)) == 9
+    assert sorted(s.ask_latencies)[0] >= 0
+    assert list(s.occupancy) == [0.75] * 3
+    # and the same numbers come out of the registry, typed
+    rows = {r["name"]: r for r in svc.metrics_rows()}
+    assert rows["serve_dispatch_total"]["value"] == 3
+    assert rows["serve_ask_latency_seconds"]["count"] == 9
+    assert rows["serve_studies"]["value"] == 3
+    text = svc.metrics_text()
+    assert "serve_dispatch_total 3" in text
+    svc.shutdown()
+
+
+def test_obs_buffer_counters_back_compat_and_pickle():
+    from hyperopt_tpu.jax_trials import ObsBuffer
+    from hyperopt_tpu.ops.compile import compile_space
+
+    ps = compile_space(SPACE)
+    buf = ObsBuffer(ps, resident=True)
+    for i in range(4):
+        buf.add({"x": 0.5, "lr": 0.1, "c": 0}, 0.1 * i)
+    buf.device_arrays()
+    assert buf.full_uploads == 1
+    assert buf.transfer_bytes_total > 0
+    before = (buf.transfer_bytes_total, buf.delta_tells, buf.full_uploads)
+    buf2 = pickle.loads(pickle.dumps(buf))
+    assert (
+        buf2.transfer_bytes_total, buf2.delta_tells, buf2.full_uploads
+    ) == before
+    rows = {r["name"]: r for r in buf.metrics.collect()}
+    assert rows["obs_full_uploads_total"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# THE invisibility pins
+# ---------------------------------------------------------------------------
+
+
+def test_invisibility_64_study_parity_with_tracing_armed():
+    """The PR-8 64-study bitwise-parity scenario with a flight
+    recorder at FULL cadence and the device-metrics twin at cadence 1:
+    every stream identical to the untracked run AND to its solo
+    fused-path reference; the untracked run dispatches exactly zero
+    extra programs."""
+    import test_serve
+
+    def run(recorder=None, device_metrics_every=0):
+        svc = SuggestService(
+            test_serve.SPACE, max_batch=64, background=False,
+            n_startup_jobs=test_serve.N_STARTUP, recorder=recorder,
+            device_metrics_every=device_metrics_every,
+            **test_serve.ALGO_KW,
+        )
+        handles = [
+            svc.create_study(f"s{i:02d}", seed=100 + i) for i in range(64)
+        ]
+        streams = {}
+        test_serve.drive_rounds(svc, handles, streams, 3)
+        counts = (
+            svc.scheduler.dispatch_count,
+            svc.scheduler.device_metric_dispatches,
+        )
+        ps = svc.ps
+        svc.shutdown()
+        return streams, counts, ps
+
+    plain_streams, plain_counts, ps = run()
+    rec = FlightRecorder(capacity=65536)
+    traced_streams, traced_counts, _ = run(
+        recorder=rec, device_metrics_every=1
+    )
+    # bitwise invisibility: tracing changed NOTHING in any stream
+    assert traced_streams == plain_streams
+    # and both match the solo fused-path references
+    for i in range(0, 64, 16):
+        assert plain_streams[f"s{i:02d}"] == test_serve.solo_stream(
+            ps, 100 + i, 3
+        )
+    # the armed run really traced at full cadence...
+    names = {s["name"] for s in rec.tail()}
+    assert {
+        "ask.submit", "ask.queued", "serve.dispatch", "ask.delivered",
+        "tell.wal_append", "tell.applied", "tell",
+    } <= names
+    assert rec.recorded_total > 64 * 3 * 4
+    # ...dispatched its twin every round, while the untracked run
+    # dispatched exactly zero extra programs (the off-cost pin)
+    assert traced_counts == (plain_counts[0], plain_counts[0])
+    assert plain_counts[1] == 0
+
+
+@pytest.mark.chaos
+def test_invisibility_fleet_kill_mid_batch_with_tracing_armed(tmp_path):
+    """The PR-13 fleet failover chaos shape -- replica killed
+    mid-batch under a 10% transient storm -- with a fleet-shared
+    flight recorder at full cadence: zero lost / zero duplicate tells,
+    and every stream (including the killed replica's) bitwise the
+    untracked same-seed run's."""
+    import test_fleet_chaos as tfc
+    from hyperopt_tpu.serve import Fleet
+
+    names = tfc.NAMES[:6]
+    rounds = 3
+
+    def run(root, recorder=None):
+        plans = {
+            rid: FaultPlan(seed=700 + i, rate=0.10)
+            for i, rid in enumerate(tfc.REPLICAS)
+        }
+        plans[tfc.victim_rid()].arm("serve_mid_batch", at=2)
+        kw = dict(tfc.KW)
+        if recorder is not None:
+            kw["recorder"] = recorder
+            kw["device_metrics_every"] = 1
+        fleet = Fleet(
+            tfc.SPACE, str(root), replica_ids=list(tfc.REPLICAS),
+            plans=plans, fs=FaultPlan(seed=7).fs(), **kw,
+        )
+        client = tfc.Client(fleet)
+        for i, n in enumerate(names):
+            client.create(n, seed=100 + i)
+        streams = {n: [] for n in names}
+        tfc.drive(client, streams, rounds, names=names)
+        assert fleet.replicas[tfc.victim_rid()].dead
+        assert fleet.recovery_ms is not None and fleet.recovery_ms > 0
+        state = {
+            n: tfc.final_state(fleet, [n])[n] for n in names
+        }
+        fleet.shutdown()
+        return streams, state
+
+    plain_streams, plain_state = run(tmp_path / "plain")
+    rec = FlightRecorder(capacity=65536)
+    traced_streams, traced_state = run(tmp_path / "traced", recorder=rec)
+
+    # bitwise invisibility under failover chaos
+    assert traced_streams == plain_streams
+    for n in names:
+        assert traced_state[n]["tids"] == plain_state[n]["tids"]
+        np.testing.assert_array_equal(
+            traced_state[n]["values"], plain_state[n]["values"]
+        )
+        # zero lost / zero duplicate (live counters)
+        assert traced_state[n]["count"] == rounds
+        assert len(set(traced_state[n]["tids"])) == rounds
+        assert traced_state[n]["wal_total_tells"] == rounds
+    # spans carry the fleet correlation ids end to end
+    delivered = [
+        s for s in rec.tail() if s["name"] == "ask.delivered"
+    ]
+    assert delivered and all("replica" in s for s in delivered)
+    assert {s["replica"] for s in delivered} <= set(tfc.REPLICAS)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide scrape: router aggregation, probes, the scope CLI
+# ---------------------------------------------------------------------------
+
+
+def _start_replica(owner, root=None):
+    from hyperopt_tpu.serve.service import serve_forever
+
+    svc = SuggestService(
+        SPACE, background=True, max_wait_ms=1.0, n_startup_jobs=2,
+        owner=owner, root=root, recorder=FlightRecorder(), **ALGO_KW,
+    )
+    server = serve_forever(svc, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return svc, server, server.server_address[1]
+
+
+def test_fleet_wide_scrape_probes_and_scope_cli(tmp_path, capsys):
+    from hyperopt_tpu.obs import cli as scope_cli
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+
+    root = str(tmp_path / "root")
+    svcs, servers, ports = {}, {}, {}
+    for rid in ("r0", "r1"):
+        svcs[rid], servers[rid], ports[rid] = _start_replica(
+            rid, root=root
+        )
+    router = RouterServer([
+        _Backend("r0", "127.0.0.1", ports["r0"]),
+        _Backend("r1", "127.0.0.1", ports["r1"]),
+    ])
+    rserver = router.serve_forever(port=0)
+    threading.Thread(target=rserver.serve_forever, daemon=True).start()
+    rport = rserver.server_address[1]
+    try:
+        with socket.create_connection(("127.0.0.1", rport), 10) as sock:
+            f = sock.makefile("rw")
+
+            def rpc(**req):
+                f.write(json.dumps(req) + "\n")
+                f.flush()
+                return json.loads(f.readline())
+
+            r = rpc(op="create_study", name="demo", seed=3)
+            assert r["ok"], r
+            for _ in range(2):
+                a = rpc(op="ask", study="demo", name="demo")
+                assert a["ok"], a
+                assert rpc(
+                    op="tell", study="demo", name="demo",
+                    tid=a["tid"], loss=_loss(a["vals"]),
+                )["ok"]
+
+            # ONE call scrapes the whole fleet: both replicas' series,
+            # replica-tagged, plus the router's own
+            m = rpc(op="metrics")
+            assert m["ok"] and sorted(m["replicas"]) == ["r0", "r1"]
+            by_replica = {
+                row["labels"].get("replica")
+                for row in m["metrics"]
+                if row["name"] == "serve_dispatch_total"
+            }
+            assert by_replica == {"r0", "r1"}
+            assert "serve_dispatch_total" in m["text"]
+            assert 'replica="r0"' in m["text"]
+            # fleet-wide span tail, replica-tagged
+            t = rpc(op="trace", tail=200)
+            assert t["ok"]
+            assert any(
+                s["name"] == "ask.delivered" for s in t["spans"]
+            )
+            assert {s.get("replica") for s in t["spans"]} <= {"r0", "r1"}
+
+        # the console script against the live router
+        assert scope_cli.main(
+            ["metrics", "--port", str(rport)]
+        ) == 0
+        out = capsys.readouterr().out
+        assert "serve_dispatch_total" in out and 'replica="r1"' in out
+        assert scope_cli.main(
+            ["trace", "--port", str(rport), "--tail", "5", "--json"]
+        ) == 0
+        spans = json.loads(capsys.readouterr().out)
+        assert isinstance(spans, list)
+
+        # health probing: kill the backend that OWNS the study -- the
+        # probe marks it suspect BEFORE any client ask eats the
+        # connection failure...
+        victim = router.ring.owner("demo")
+        other = "r0" if victim == "r1" else "r1"
+        servers[victim].shutdown()
+        servers[victim].server_close()
+        router.probe_backends()
+        assert victim in router._alive_excluded()
+        rows = {
+            (r["name"], r["labels"].get("backend")): r
+            for r in router.metrics.collect()
+        }
+        assert rows[("router_backend_up", other)]["value"] == 1
+        assert rows[("router_backend_up", victim)]["value"] == 0
+        assert router.metrics.histogram("router_probe_seconds").count >= 2
+
+        def ask_ok():
+            with socket.create_connection(
+                ("127.0.0.1", rport), 10
+            ) as sock:
+                f = sock.makefile("rw")
+                f.write(json.dumps(
+                    {"op": "ask", "study": "demo", "name": "demo"}
+                ) + "\n")
+                f.flush()
+                return json.loads(f.readline())
+
+        # ...asks fail over to the survivor (shared-root adoption),
+        # with no client-visible error
+        a = ask_ok()
+        assert a["ok"], a
+
+        # ...and a probe-recovered backend rejoins the ring: the next
+        # ask routed to it re-adopts the study past its stale claim
+        # (OwnershipLost -> takeover -> retry), again with no
+        # client-visible error
+        from hyperopt_tpu.serve.service import serve_forever
+
+        revived = serve_forever(
+            svcs[victim], host="127.0.0.1", port=ports[victim]
+        )
+        threading.Thread(
+            target=revived.serve_forever, daemon=True
+        ).start()
+        servers[victim] = revived
+        router.probe_backends()
+        assert victim not in router._alive_excluded()
+        rows = {
+            (r["name"], r["labels"].get("backend")): r
+            for r in router.metrics.collect()
+        }
+        assert rows[("router_backend_up", victim)]["value"] == 1
+        assert router.metrics.counter(
+            "router_backend_rejoins_total"
+        ).value == 1
+        a = ask_ok()
+        assert a["ok"], a
+    finally:
+        router.stop_probes()
+        rserver.shutdown()
+        rserver.server_close()
+        for rid in ("r0", "r1"):
+            servers[rid].shutdown()
+            servers[rid].server_close()
+            svcs[rid].shutdown()
+
+
+def test_scope_cli_flight_file(tmp_path, capsys):
+    from hyperopt_tpu.obs import cli as scope_cli
+
+    path = str(tmp_path / "f.wal")
+    rec = FlightRecorder(path=path)
+    for i in range(5):
+        rec.record("e", tid=i)
+    rec.close()
+    assert scope_cli.main(["flight", path, "--tail", "3"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 3 and "tid=4" in out[-1]
+    assert scope_cli.main(["flight", path, "--json"]) == 0
+    assert len(json.loads(capsys.readouterr().out)) == 5
+
+
+# ---------------------------------------------------------------------------
+# device-side streaming (the declared io_callback twin)
+# ---------------------------------------------------------------------------
+
+
+def test_device_metrics_twin_cadence_and_zero_when_off():
+    def run(every):
+        svc = SuggestService(
+            SPACE, max_batch=4, background=False, n_startup_jobs=2,
+            device_metrics_every=every, **ALGO_KW,
+        )
+        handles = [svc.create_study(f"s{i}", seed=i) for i in range(3)]
+        _drive(svc, handles, 4)
+        s = svc.scheduler
+        out = (
+            s.dispatch_count, s.device_metric_dispatches,
+            {r["name"]: r for r in svc.metrics_rows()},
+        )
+        svc.shutdown()
+        return out
+
+    d_off, twin_off, rows_off = run(0)
+    assert (d_off, twin_off) == (4, 0)  # off = zero extra dispatches
+    assert "serve_device_best_loss" not in rows_off
+    d_on, twin_on, rows = run(2)
+    assert d_on == 4 and twin_on == 2  # cadence 2: rounds 2 and 4
+    assert rows["obs_device_events_total"]["value"] == 2
+    assert rows["serve_device_active_slots"]["value"] == 3
+    assert rows["serve_device_trials_done"]["value"] > 0
+    assert np.isfinite(rows["serve_device_best_loss"]["value"])
+
+
+def test_device_loop_metrics_registry_adapter():
+    from hyperopt_tpu.device_loop import compile_fmin
+
+    space = {"x": hp.uniform("x", -5.0, 5.0)}
+    reg = MetricsRegistry("dl")
+    runner = compile_fmin(
+        lambda cfg: (cfg["x"] - 1.0) ** 2, space, max_evals=16,
+        batch_size=4, n_startup_jobs=2, n_EI_candidates=4,
+        chunk_size=8, metrics_registry=reg,
+    )
+    out = runner(seed=3)
+    rows = {r["name"]: r for r in reg.collect()}
+    # 16 evals / batch 4 = 4 steps; chunk_size 8 -> 2-step chunks -> 2
+    # declared io_callback rows landed on the registry
+    assert rows["obs_device_events_total"]["value"] == 2
+    assert rows["device_loop_trials_done"]["value"] == 16
+    assert rows["device_loop_best_loss"]["value"] == pytest.approx(
+        float(np.min(out["losses"]))
+    )
+    assert rows["device_loop_trials_per_sec"]["value"] > 0
+
+
+def test_fmin_driver_recorder_invisible():
+    from hyperopt_tpu import Trials, fmin, tpe
+
+    space = {"x": hp.uniform("x", -3, 3)}
+
+    def run(recorder=None):
+        trials = Trials()
+        fmin(
+            lambda cfg: (cfg["x"] - 1) ** 2, space, algo=tpe.suggest,
+            max_evals=8, trials=trials,
+            rstate=np.random.default_rng(7), show_progressbar=False,
+            recorder=recorder,
+        )
+        return trials.losses()
+
+    plain = run()
+    rec = FlightRecorder()
+    traced = run(recorder=rec)
+    assert traced == plain  # invisibility on the host driver too
+    spans = [s for s in rec.tail() if s["name"] == "driver.trial"]
+    assert len(spans) == 8
+    assert all(s["study"] == "driver" for s in spans)
