@@ -257,12 +257,15 @@ def bench_obs_scaling(space, batch, n_cand, sizes):
 def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     """Single-suggest (B=1) latency path.
 
-    Returns (pipelined_rate, sync_rate): pipelined enqueues all calls
-    then blocks once (device-compute bound; the SAME semantics as round
-    1's single_suggest_per_sec, kept for round-over-round comparison);
-    sync blocks on every call (what a sequential fmin pays per ask --
-    dispatch RTT + compute).  The gap between the two IS the
-    dispatch-vs-compute decomposition.
+    Returns the PIPELINED rate: every call enqueued, one block at the
+    end (device-compute bound; the SAME semantics as round 1's
+    single_suggest_per_sec, kept for round-over-round comparison).
+    The old companion ``single_suggest_sync_per_sec`` -- blocking on
+    every call, what the RETIRED solo sync driver paid per ask -- is
+    gone with its regime (round 20): a sequential ``fmin`` now rides
+    the serve engine (``fmin_client_asks_per_sec``), so the 8.7/s
+    two-round-trips-per-trial floor is no longer a path any driver
+    takes.
 
     The device view is bucketed with the round-6 compaction default
     (``pow2_cap``), exactly the path ``suggest()`` runs -- an uncapped
@@ -287,13 +290,7 @@ def bench_jax_latency(domain, trials, n_cand=128, n_calls=30):
     for i in range(n_calls):
         out = fn(keys[i], *arrays, batch=1)
     jax.block_until_ready(out)
-    pipelined_rate = n_calls / (time.perf_counter() - t0)
-    t0 = time.perf_counter()
-    for i in range(n_calls):
-        out = fn(keys[i], *arrays, batch=1)
-        jax.block_until_ready(out)
-    sync_rate = n_calls / (time.perf_counter() - t0)
-    return pipelined_rate, sync_rate
+    return n_calls / (time.perf_counter() - t0)
 
 
 def bench_spec_latency(domain, trials, n_cand=128, k=32, n_calls=64):
@@ -1147,6 +1144,42 @@ def bench_best_at_1k(n_trials=1000, seed=7, speculative=0):
     return dt, float(min(trials.losses())), n_trials
 
 
+def bench_fmin_client(n_trials=1000, seed=7, ask_ahead=4):
+    """The round-20 sequential headline: the SAME 1k-trial experiment
+    as ``bench_best_at_1k``, with ``fmin`` routed through the serve
+    engine (``fmin(ask_ahead=k)`` -- graftclient).  The suggestion
+    stream is BITWISE the solo driver's at any depth (submit-time
+    seeds + the fresh_window gate), so ``best_loss_at_1k_client``
+    equals ``best_loss_at_1k`` by construction; the wall-clock is the
+    number that moves -- the engine's resident stacked state replaces
+    the per-ask history re-upload, the depth-k window keeps the
+    pipeline primed, and the client loop sheds the algo-seam's
+    per-trial full-store scans.
+
+    Returns (seconds, best_loss, asks_per_sec).
+    """
+    import numpy as np
+
+    from hyperopt_tpu import fmin
+    from hyperopt_tpu.jax_trials import JaxTrials
+    from hyperopt_tpu.models.synthetic import mixed_space, mixed_space_fn
+
+    trials = JaxTrials()
+    t0 = time.perf_counter()
+    fmin(
+        mixed_space_fn,
+        mixed_space(),
+        max_evals=n_trials,
+        trials=trials,
+        rstate=np.random.default_rng(seed),
+        show_progressbar=False,
+        return_argmin=False,
+        ask_ahead=ask_ahead,
+    )
+    dt = time.perf_counter() - t0
+    return dt, float(min(trials.losses())), n_trials / dt
+
+
 def bench_best_at_1k_device_loop(n_trials=1000, n_cand=128, seed=7,
                                  batch_size=32):
     """The same 1k-trial experiment as ONE on-device program
@@ -1352,7 +1385,7 @@ def main():
     ]
     obs_scaling = bench_obs_scaling(space, batch, n_cand, obs_sweep_sizes)
     from hyperopt_tpu.ops.kernels import DEFAULT_ABOVE_CAP as above_cap_default
-    latency_rate, latency_sync_rate = bench_jax_latency(
+    latency_rate = bench_jax_latency(
         domain, trials, n_cand=n_cand
     )
     fused_sync_rate = bench_fused_latency(domain, trials, n_cand=n_cand)
@@ -1422,6 +1455,13 @@ def main():
     spec_sec_1k, spec_best_1k, _ = bench_best_at_1k(
         n_trials=n_trials_1k, speculative=8
     )
+    # round-20 graftclient rows: the same experiment with fmin routed
+    # through the serve engine (bitwise stream, so the quality row is
+    # an invariant check and the wall-clock row is the story)
+    ask_ahead_depth = int(os.environ.get("BENCH_ASK_AHEAD", "4"))
+    client_sec_1k, client_best_1k, client_asks_per_sec = (
+        bench_fmin_client(n_trials=n_trials_1k, ask_ahead=ask_ahead_depth)
+    )
     dl_sec_1k, dl_best_1k, dl_n = bench_best_at_1k_device_loop(
         n_trials=n_trials_1k, n_cand=n_cand
     )
@@ -1473,7 +1513,10 @@ def main():
                     round(native_rate, 1) if native_rate else None
                 ),
                 "single_suggest_per_sec": round(latency_rate, 1),
-                "single_suggest_sync_per_sec": round(latency_sync_rate, 1),
+                # single_suggest_sync_per_sec RETIRED (round 20): the
+                # solo sync dispatch regime it measured no longer
+                # exists -- fmin rides the serve engine; see
+                # fmin_client_asks_per_sec
                 "single_suggest_fused_sync_per_sec": round(
                     fused_sync_rate, 1
                 ),
@@ -1549,6 +1592,15 @@ def main():
                 "best_loss_at_1k": round(best_1k, 5),
                 "seconds_to_best_at_1k_spec8": round(spec_sec_1k, 2),
                 "best_loss_at_1k_spec8": round(spec_best_1k, 5),
+                # round-20 graftclient rows (bench_fmin_client): fmin
+                # as a serve client with the depth-k ask-ahead window;
+                # the stream is bitwise the solo driver's, so
+                # best_loss_at_1k_client == best_loss_at_1k is an
+                # invariant, not a coincidence
+                "seconds_to_best_at_1k_client": round(client_sec_1k, 2),
+                "best_loss_at_1k_client": round(client_best_1k, 5),
+                "fmin_client_asks_per_sec": round(client_asks_per_sec, 1),
+                "fmin_ask_ahead_depth": ask_ahead_depth,
                 "n_trials_1k": n_trials_1k,
                 "device_loop_seconds_at_1k": (
                     round(dl_sec_1k, 3) if dl_sec_1k is not None else None

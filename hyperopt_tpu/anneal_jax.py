@@ -227,6 +227,12 @@ def suggest(
     ``state_io`` program variant -- same one-dispatch semantics and
     bitwise-identical suggestions as :func:`tpe_jax.suggest`'s resident
     path (shared :func:`tpe_jax._state_dispatch` engine).
+
+    COMPATIBILITY STATUS (round 20, graftclient): the solo resident /
+    speculative modes are the parity reference; a sequential ``fmin``
+    routes this same anneal body through the serve engine
+    (``fmin(engine=True)`` / ``ask_ahead=k`` -- bitwise this stream at
+    any depth, with the serve tier's durability and protection).
     """
     ps = packed_space_for(domain)
     if resident is not None:

@@ -435,6 +435,14 @@ def suggest(
     ``tpe_jax.suggest_dense``, so its warm draws inherit the O(D)
     delta-tell / fused-dispatch state engine unchanged (the host-side
     restart/lock rolls are posterior-independent and unaffected).
+
+    COMPATIBILITY STATUS (round 20, graftclient): under
+    ``fmin(engine=True)`` / ``ask_ahead=k`` this adaptive driver is
+    served as a per-study ``host_algo`` hook inside the serve
+    engine's rounds (the host decision layer cannot vmap across
+    studies; the hook runs :func:`_dense_draw` verbatim, so the
+    stream is bitwise this solo path's) -- with the serve tier's
+    admission control, WAL durability, and tracing on top.
     """
     from . import tpe_jax
 

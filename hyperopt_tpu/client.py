@@ -1,0 +1,676 @@
+"""graftclient: ``fmin`` as a serve-engine client (ISSUE 15).
+
+The sequential host driver was the last code path with its own dispatch
+regime: one ``state_io`` fused program per trial, its own write-ahead
+log (``utils.checkpoint.DriverRecovery``), its own ask-ahead seam.  The
+study-batched serve engine built in PRs 8-14 runs the SAME per-study
+math (the solo fused closure, vmapped) behind admission control,
+quarantine, a watchdog, WAL durability, mesh sharding, and
+observability -- so this module deletes the solo regime instead of
+continuing to shave it: ``fmin(engine=True)`` opens a study on an
+in-process :class:`~hyperopt_tpu.serve.SuggestService` (no TCP, no
+background thread by default) and drives every trial through
+``StudyHandle.ask`` / ``tell``.  This is the Vizier-service posture --
+every client, including a single-user ``fmin``, speaks to the one
+engine -- and it means every engine improvement (graftmesh, graftguard,
+graftscope, graftfleet) accrues to single-user ``fmin`` for free.
+
+Correctness story (the reason the collapse is safe):
+
+* **Submit-time seeds.**  The scheduler draws each ask's seed from the
+  study's own rstate stream at SUBMIT time -- and the client wires the
+  study's rstate to ``fmin``'s own ``rstate``, so the seed sequence is
+  exactly what the solo driver's ``_take_seed`` would have drawn.
+* **Depth-k ask-ahead window** (``fmin(ask_ahead=k)``): the client
+  keeps up to ``k`` asks submitted ahead; the study's ``fresh_window``
+  gate holds a queued ask back until every previously served
+  suggestion has its tell, so every dispatch sees the full posterior.
+  Together the two make the suggestion stream *bitwise identical at
+  any depth* -- k=1 degenerates to one fused dispatch per trial, the
+  old solo regime, and k>1 keeps the pipeline primed (the dispatch for
+  trial i+1 is queued, seeded, and -- on a background-mode service --
+  already in flight while the driver finishes trial i's host-side
+  bookkeeping) without ever trading staleness for it.
+* **One durability story.**  ``trials_save_file`` / ``resume_from``
+  become a serve study root: the per-study ``TellWAL`` + snapshot
+  bundle (PR 8) absorb the driver WAL's job -- ask records carry the
+  post-draw rstate cursor, tell records carry the full SONified result
+  dict, ``fail`` records make failed/errored trials durable before
+  their docs finalize (a resumed run never re-runs a known-bad trial),
+  and the snapshot bundle carries the client's Trials docs.  Audit and
+  repair with ``hyperopt-tpu-fsck --serve ROOT`` (the ``--driver`` role
+  now covers only legacy solo-driver checkpoint files).
+* **Backpressure is a pace signal.**  A typed
+  :class:`~hyperopt_tpu.exceptions.Overloaded` refusal becomes bounded
+  retry-with-backoff under the client deadline
+  (:meth:`EngineClient._submit_one`), escalating to
+  :class:`~hyperopt_tpu.exceptions.DeadlineExpired` -- never a stuck
+  full-timeout hang, never a lost trial.
+
+Algorithm routing: ``tpe_jax.suggest`` and ``anneal_jax.suggest``
+(partials included) map onto the engine's vmapped program bodies;
+``atpe_jax.suggest`` keeps its host decision layer as a per-study
+``host_algo`` dispatch hook served inside the same rounds (adaptive
+settings cannot vmap across studies).  Anything else -- host-parity
+algos, ``joint_ei``, ``speculative=k`` -- raises with a pointer at the
+solo compatibility path.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import logging
+import time
+
+import numpy as np
+
+from .base import (
+    JOB_STATE_DONE,
+    JOB_STATE_ERROR,
+    JOB_STATE_NEW,
+    STATUS_OK,
+    SONify,
+    Trials,
+)
+from .exceptions import CheckpointError, DeadlineExpired, Overloaded
+from .rand import docs_from_idxs_vals
+
+logger = logging.getLogger(__name__)
+
+__all__ = [
+    "CLIENT_STUDY",
+    "EngineClient",
+    "EngineSpec",
+    "connect",
+    "resolve_engine_algo",
+]
+
+#: the study name a solo ``fmin`` client opens on its service: the
+#: durable root then holds ``fmin.wal`` / ``fmin.snap`` -- one study
+#: family per root, exactly one tenant
+CLIENT_STUDY = "fmin"
+
+#: snapshot cadence of a client study (tells per bundle publish) --
+#: the DriverRecovery default, so the durability granularity of the
+#: unified layout matches the driver WAL it replaces
+CLIENT_SNAPSHOT_CADENCE = 25
+
+
+class EngineSpec:
+    """How one plugin-seam ``algo`` maps onto the serve engine."""
+
+    __slots__ = ("name", "algo_kw", "n_startup_jobs", "hook_kw", "resident")
+
+    def __init__(self, name, algo_kw, n_startup_jobs, hook_kw=None,
+                 resident=None):
+        self.name = name
+        self.algo_kw = dict(algo_kw)
+        self.n_startup_jobs = int(n_startup_jobs)
+        self.hook_kw = hook_kw
+        self.resident = resident
+
+
+def _unwrap_algo(algo):
+    """Peel partial layers; outermost keywords win (call semantics)."""
+    kw = {}
+    a = algo
+    while isinstance(a, functools.partial):
+        merged = dict(a.keywords or {})
+        merged.update(kw)
+        kw = merged
+        a = a.func
+    return a, kw
+
+
+def resolve_engine_algo(algo):
+    """Map the plugin-seam ``algo`` onto an :class:`EngineSpec`.
+
+    Raises ``ValueError`` (naming the offender and the fallback) for
+    anything the engine cannot serve bitwise: host-parity algos,
+    ``joint_ei``, ``speculative=k`` (the solo driver's staleness-based
+    amortization -- the engine's fresh ask-ahead window replaces it),
+    or unknown keywords.
+    """
+    a, kw = _unwrap_algo(algo)
+    mod = getattr(a, "__module__", "") or ""
+    short = mod.rsplit(".", 1)[-1]
+    if short not in ("tpe_jax", "anneal_jax", "atpe_jax") or getattr(
+        a, "__name__", ""
+    ) != "suggest":
+        raise ValueError(
+            f"fmin(engine=...) cannot route algo {algo!r} through the "
+            "serve engine: supported are tpe_jax.suggest, "
+            "anneal_jax.suggest and atpe_jax.suggest (partials "
+            "included); pass engine=False for the solo compatibility "
+            "path"
+        )
+    if kw.pop("speculative", 0):
+        raise ValueError(
+            "algo speculative=k is the solo driver's staleness-based "
+            "dispatch amortization; the engine client's ask_ahead=k "
+            "window replaces it without trading posterior freshness -- "
+            "drop speculative= (or pass engine=False)"
+        )
+    kw.pop("max_stale", None)  # only meaningful with speculative
+    # solo dispatch-shape knobs: the engine's stacked state is
+    # inherently resident (fused=True's whole point), so these are
+    # satisfied by construction rather than contradicted
+    kw.pop("fused", None)
+    kw.pop("ask_ahead", None)
+    resident = kw.pop("resident", None)
+    if short == "tpe_jax":
+        from . import tpe_jax as m
+
+        if kw.pop("joint_ei", False):
+            raise ValueError(
+                "joint_ei=True has no batched engine body (measured "
+                "quality-neutral, kept for its structural property "
+                "only); pass engine=False to use it"
+            )
+        algo_kw = dict(
+            n_cand=int(kw.pop("n_EI_candidates",
+                              m._default_n_EI_candidates)),
+            gamma=float(kw.pop("gamma", m._default_gamma)),
+            lf=float(kw.pop("linear_forgetting",
+                            m._default_linear_forgetting)),
+            prior_weight=float(kw.pop("prior_weight",
+                                      m._default_prior_weight)),
+            n_cand_cat=kw.pop("n_EI_candidates_cat",
+                              m._default_n_EI_candidates_cat),
+            above_cap=kw.pop("above_cap", None),
+        )
+        n_startup = int(kw.pop("n_startup_jobs",
+                               m._default_n_startup_jobs))
+        spec = EngineSpec("tpe", algo_kw, n_startup, resident=resident)
+    elif short == "anneal_jax":
+        from . import anneal_jax as m
+
+        algo_kw = dict(
+            avg_best_idx=float(kw.pop("avg_best_idx",
+                                      m._default_avg_best_idx)),
+            shrink_coef=float(kw.pop("shrink_coef",
+                                     m._default_shrink_coef)),
+        )
+        # anneal warms at the first observation regardless (the
+        # scheduler's algo-aware warm mask); n_startup_jobs is unused
+        spec = EngineSpec("anneal", algo_kw, 1, resident=resident)
+    else:
+        if kw.pop("mesh", None) is not None:
+            raise ValueError(
+                "atpe mesh= shards the candidate sweep of the SOLO "
+                "dispatch; unsupported on the client path (pass "
+                "engine=False)"
+            )
+        hook_kw = dict(
+            n_startup_jobs=int(kw.pop("n_startup_jobs", 20)),
+            linear_forgetting=int(kw.pop("linear_forgetting", 25)),
+            lock_fraction=float(kw.pop("lock_fraction", 0.5)),
+            elite_count=int(kw.pop("elite_count", 8)),
+        )
+        spec = EngineSpec(
+            "atpe", {}, hook_kw["n_startup_jobs"], hook_kw=hook_kw,
+            resident=resident,
+        )
+    if kw:
+        raise ValueError(
+            f"fmin(engine=...) cannot map algo keyword(s) {sorted(kw)} "
+            "onto the serve engine; pass engine=False for the solo "
+            "compatibility path"
+        )
+    return spec
+
+
+def _make_host_hook(spec, domain, trials):
+    """The atpe ``host_algo`` hook: the solo host-adaptive dispatch
+    verbatim -- host decision layer (``ATPEOptimizer`` settings + lock
+    rolls) over the client's live Trials, device sweep through the
+    shared ``suggest_dense`` engine -- minus the doc building the
+    client now owns.  Bitwise the solo ``atpe_jax.suggest`` stream."""
+    from . import atpe_jax
+    from .pyll.stochastic import ensure_rng
+
+    hk = spec.hook_kw
+    if spec.resident is not None:
+        from .jax_trials import obs_buffer_for
+
+        obs_buffer_for(domain, trials, resident=bool(spec.resident))
+
+    def hook(seed):
+        opt = atpe_jax._optimizer_for(
+            domain, hk["lock_fraction"], hk["elite_count"]
+        )
+        rng = ensure_rng(int(seed))
+        return atpe_jax._dense_draw(
+            domain, trials, opt, rng, 1, hk["n_startup_jobs"],
+            hk["linear_forgetting"],
+        )
+
+    return hook
+
+
+def _misc_vals(trial):
+    """{label: value} of one doc -- the ``ObsBuffer._add_doc``
+    extraction, so what the client tells is bitwise what the solo
+    buffer would have ingested from the same doc."""
+    return {
+        k: v[0] for k, v in trial["misc"]["vals"].items() if len(v) == 1
+    }
+
+
+def _client_guard(base_guard, fn):
+    """The study guard of a client-owned service: the serve guard
+    (algo + space fingerprint) extended with the OBJECTIVE identity --
+    resuming a root under a different objective silently changes the
+    experiment and must be refused (the PR-6 driver-guard posture)."""
+    from .hyperband import _algo_identity
+
+    return list(base_guard) + ["fmin-client", _algo_identity(fn)]
+
+
+class EngineClient:
+    """``FMinIter``'s view of the engine: one study, one window.
+
+    Built by :func:`connect`; driven by ``FMinIter`` (which owns the
+    evaluation machinery -- ``catch=`` / ``trial_timeout=`` / recorder
+    spans).  The client owns the serve-side half: the depth-k submit
+    window with Overloaded backoff, doc building from served vals,
+    tells/fails with their durable payloads, and restore."""
+
+    def __init__(self, service, handle, spec, domain, trials, rstate,
+                 ask_ahead=1, owns_service=True, max_submits=None,
+                 restored=False):
+        self.service = service
+        self.handle = handle
+        self.study = handle._study
+        self.spec = spec
+        self.domain = domain
+        self.trials = trials
+        self.rstate = rstate
+        self.ps = service.ps
+        self.ask_ahead = max(1, int(ask_ahead))
+        self.owns_service = owns_service
+        #: total ask budget (max_evals); submits stop at it so the
+        #: rstate cursor ends exactly where the solo driver's would
+        self.max_submits = (
+            float("inf") if max_submits is None else max_submits
+        )
+        self.restored = restored
+        self._queue = collections.deque()  # submitted-ahead requests
+        self._recovering = bool(
+            self.study.pending_asks or self.study.outstanding
+        )
+        self.durable = self.study.persist is not None
+        self.closed = False
+
+    @property
+    def study_name(self):
+        return self.study.name
+
+    # -- the ask window ----------------------------------------------------
+    def budget_left(self):
+        return self.study.next_tid < self.max_submits or bool(
+            self._queue
+        ) or self._recovering
+
+    def _submit_one(self, deadline):
+        """Submit one ask, turning :class:`Overloaded` into bounded
+        retry-with-backoff under ``deadline`` (the satellite-3
+        contract: backpressure paces the client, it never strands it
+        in a full-timeout hang -- the typed escalation is
+        :class:`DeadlineExpired`)."""
+        while True:
+            remaining = deadline - time.perf_counter()
+            if remaining <= 0:
+                raise DeadlineExpired(
+                    f"client study {self.study_name!r}: ask window "
+                    "submit deadline exhausted"
+                )
+            try:
+                req = self.service._submit(
+                    self.study, timeout=remaining
+                )
+            except Overloaded as e:
+                wait = e.retry_after if e.retry_after else 0.05
+                if time.perf_counter() + wait >= deadline:
+                    raise DeadlineExpired(
+                        f"client study {self.study_name!r}: the engine "
+                        f"stayed overloaded ({e.reason}) past the "
+                        "client deadline; last retry_after hint was "
+                        f"{wait}s"
+                    ) from e
+                time.sleep(wait)  # graftlint: disable=GL303 the backoff IS the server's typed retry_after hint, bounded by the client deadline above -- not an unbounded retry loop
+                continue
+            self._queue.append(req)
+            return
+
+    def next_suggestion(self, timeout=60.0):
+        """The next (tid, vals) of the stream: re-delivered exactly
+        once for asks a crashed run left undelivered, else from the
+        depth-k submit-ahead window."""
+        if self._recovering:
+            if self.study.pending_asks or self.study.outstanding:
+                return self.handle.ask(
+                    timeout=timeout, recover=True, backoff=True
+                )
+            self._recovering = False
+        deadline = time.perf_counter() + float(timeout)
+        while (
+            len(self._queue) < self.ask_ahead
+            and self.study.next_tid < self.max_submits
+        ):
+            self._submit_one(deadline)
+        if not self._queue:
+            raise RuntimeError(
+                f"client study {self.study_name!r}: ask budget "
+                f"({self.max_submits}) exhausted"
+            )
+        req = self._queue.popleft()
+        return self.service._await(
+            req, max(deadline - time.perf_counter(), 0.001)
+        )
+
+    # -- docs --------------------------------------------------------------
+    def insert_new_doc(self, tid, vals):
+        """One NEW trial doc from served vals -- byte-for-byte what the
+        solo algo seam would have inserted (same ``docs_from_idxs_vals``
+        path over the same label set)."""
+        tid = int(tid)
+        idxs = {
+            label: ([tid] if label in vals else [])
+            for label in self.ps.labels
+        }
+        vv = {
+            label: ([vals[label]] if label in vals else [])
+            for label in self.ps.labels
+        }
+        docs = docs_from_idxs_vals(
+            [tid], self.domain, self.trials, idxs, vv
+        )
+        self.trials.insert_trial_docs(docs)
+        self.trials.refresh()
+        for doc in reversed(self.trials._dynamic_trials):
+            if doc["tid"] == tid:
+                return doc
+        raise RuntimeError(f"inserted doc for tid {tid} not found")
+
+    # -- tells -------------------------------------------------------------
+    def record_tell(self, trial, result=None):
+        """Report one evaluation outcome to the engine, write-ahead of
+        the doc finalizing (the PR-6 ordering, now through the ONE
+        serve WAL): a posterior-ok result tells (vals + loss + the full
+        SONified result dict for doc rebuild); anything dead -- failed
+        status, non-finite/missing loss, or an ERROR doc -- fails the
+        tid durably so resume never re-runs or re-serves it."""
+        tid = int(trial["tid"])
+        ok = False
+        loss = None
+        if result is not None and result.get("status") == STATUS_OK:
+            loss = result.get("loss")
+            ok = loss is not None and np.isfinite(float(loss))
+        if ok:
+            self.handle.tell(
+                tid, float(loss), vals=_misc_vals(trial),
+                result=SONify(result) if self.durable else None,
+            )
+            return
+        doc = None
+        if self.durable:
+            doc = SONify({
+                "state": (
+                    JOB_STATE_ERROR if result is None else JOB_STATE_DONE
+                ),
+                "misc": trial["misc"],
+                "result": result,
+            })
+        self.handle.fail(tid, doc=doc)
+
+    # -- restore -----------------------------------------------------------
+    def rebuild_trials(self, store=None):
+        """The client half of restore: Trials docs from the snapshot
+        bundle's client blob plus the WAL-suffix replay (tell records
+        finalize exactly once, fail records rebuild their durable doc
+        payloads, served-but-untold asks are NOT materialized -- the
+        recover path re-delivers them and the normal loop rebuilds
+        their docs).  Rebuilds INTO ``store`` when it is an empty
+        sequential store (the caller keeps their handle), else into a
+        fresh one of the same class."""
+        st = self.study
+        blob = st.client_blob or {}
+        docs_by_tid = {}
+        for d in blob.get("docs", ()):
+            docs_by_tid[int(d["tid"])] = d
+        for rec in st.restore_records or ():
+            kind = rec.get("kind")
+            if kind == "tell":
+                tid = int(rec["tid"])
+                have = docs_by_tid.get(tid)
+                if have is not None and have["state"] == JOB_STATE_DONE:
+                    continue  # bundle already carries the final doc
+                result = rec.get("result") or {
+                    "status": STATUS_OK, "loss": float(rec["loss"]),
+                }
+                docs_by_tid[tid] = self._rebuild_doc(
+                    tid, dict(rec["vals"]), result, JOB_STATE_DONE
+                )
+            elif kind == "fail":
+                tid = int(rec["tid"])
+                have = docs_by_tid.get(tid)
+                if have is not None and have["state"] in (
+                    JOB_STATE_DONE, JOB_STATE_ERROR
+                ):
+                    continue
+                payload = rec.get("doc") or {}
+                docs_by_tid[tid] = self._rebuild_fail_doc(tid, payload)
+        st.client_blob = None
+        st.restore_records = None
+        if store is not None and not store._dynamic_trials:
+            trials = store
+        else:
+            trials = (type(store) if store is not None else Trials)()
+        docs = [docs_by_tid[t] for t in sorted(docs_by_tid)]
+        if docs:
+            trials.insert_trial_docs(docs)
+            trials.refresh()
+        self.trials = trials
+        return trials
+
+    def _rebuild_doc(self, tid, vals, result, state):
+        doc = self.insert_doc_shape(tid, vals, result)
+        doc["state"] = state
+        return doc
+
+    def insert_doc_shape(self, tid, vals, result):
+        """A doc dict (NOT inserted) from (tid, vals) -- deterministic,
+        so WAL replay and the live loop produce identical misc."""
+        labels = self.ps.labels
+        misc = {
+            "tid": tid,
+            "cmd": self.domain.cmd,
+            "workdir": self.domain.workdir,
+            "idxs": {
+                label: ([tid] if label in vals else [])
+                for label in sorted(labels)
+            },
+            "vals": {
+                label: ([vals[label]] if label in vals else [])
+                for label in sorted(labels)
+            },
+        }
+        store = self.trials if self.trials is not None else Trials()
+        return store.new_trial_docs([tid], [None], [result], [misc])[0]
+
+    def _rebuild_fail_doc(self, tid, payload):
+        misc = payload.get("misc")
+        state = payload.get("state", JOB_STATE_ERROR)
+        result = payload.get("result")
+        if misc is not None:
+            store = self.trials if self.trials is not None else Trials()
+            doc = store.new_trial_docs(
+                [tid], [None],
+                [result if result is not None else {"status": "new"}],
+                [dict(misc)],
+            )[0]
+        else:  # a bare fail record (non-durable client wrote none)
+            doc = self.insert_doc_shape(
+                tid, {}, result if result is not None else {"status": "new"}
+            )
+        doc["state"] = state
+        return doc
+
+    # -- durability seams --------------------------------------------------
+    def maybe_snapshot(self):
+        """Trial-boundary snapshot cadence: the service defers client
+        studies' snapshots to here, so the bundled doc blob can never
+        capture a trial mid-finalize (tell WAL-durable, doc not yet
+        DONE -- compacting that window away would strand the doc)."""
+        if self.durable:
+            self.study.persist.maybe_snapshot(self.study)
+
+    def arm_durability(self):
+        """Wire the client blob into the study's snapshot bundle and
+        publish the anchor snapshot (fresh durable studies only):
+        points_to_evaluate docs must survive a crash before the first
+        cadence boundary, and WAL replay needs a bundle to be relative
+        to -- exactly the PR-6 anchor-checkpoint rule."""
+        if not self.durable:
+            return
+        st = self.study
+        st.client_state_fn = lambda: {
+            "format": 1,
+            "docs": SONify(list(self.trials._dynamic_trials)),
+        }
+        if not self.restored:
+            from .distributed import _common
+
+            _common.with_retries(
+                lambda: st.persist.snapshot(st), label="client anchor"
+            )
+
+    def finalize(self):
+        """Orderly end of the run: drop the still-queued window tail,
+        publish the final snapshot, close the study (and the service,
+        when this client owns it).  Crashes never come here -- the WAL
+        stays the truth."""
+        if self.closed:
+            return
+        self.closed = True
+        while self._queue:
+            self.service.scheduler.drop_request(self._queue.popleft())
+        if self.owns_service:
+            self.service.shutdown()  # close_study snapshots inside
+        else:
+            self.service.close_study(self.study_name)
+
+
+def connect(engine, algo, domain, trials, rstate, fn=None, ask_ahead=1,
+            root=None, require_existing=False, max_submits=None,
+            recorder=None, fs=None):
+    """Build the :class:`EngineClient` for one ``fmin`` call.
+
+    ``engine`` is ``True`` (own an in-process service) or a caller's
+    :class:`~hyperopt_tpu.serve.SuggestService` (chaos harnesses pass
+    one with crash points armed on its ``fs`` seam).  ``root`` enables
+    the unified durability layout; ``require_existing`` is the
+    ``resume_from=`` posture (a missing root is refused).  Returns
+    ``(client, trials, rstate, restored)`` -- on restore, the rebuilt
+    Trials store and the study's restored rstate supersede the passed
+    ones, exactly the PR-6 driver semantics.
+    """
+    from .serve import SuggestService
+
+    spec = resolve_engine_algo(algo)
+    owns = not isinstance(engine, SuggestService)
+    if owns:
+        kw = {}
+        if fs is not None:
+            kw["fs"] = fs
+        service = SuggestService(
+            domain.expr, algo=spec.name, root=root,
+            max_batch=1, background=False,
+            n_startup_jobs=spec.n_startup_jobs,
+            snapshot_cadence=CLIENT_SNAPSHOT_CADENCE,
+            finite_check=False,
+            study_queue_cap=max(2, int(ask_ahead)),
+            max_queue=max(8, 2 * int(ask_ahead)),
+            recorder=recorder, **dict(spec.algo_kw, **kw),
+        )
+        if fn is not None:
+            # objective identity joins the study guard: resuming this
+            # root under a different objective is refused
+            service._guard = _client_guard(service._guard, fn)
+    else:
+        service = engine
+        if CLIENT_STUDY in service.studies():
+            raise ValueError(
+                f"the provided engine already hosts a {CLIENT_STUDY!r} "
+                "client study (one fmin per service at a time; close "
+                "it first, or use a fresh engine)"
+            )
+        if service.scheduler.algo != spec.name:
+            raise ValueError(
+                f"the provided engine serves algo "
+                f"{service.scheduler.algo!r} but fmin's algo maps to "
+                f"{spec.name!r}"
+            )
+        if root is not None and service.root != str(root):
+            raise ValueError(
+                "pass durability through the provided engine's root= "
+                f"(engine root {service.root!r} != {root!r})"
+            )
+    if require_existing:
+        from .serve.service import StudyPersistence
+
+        probe = StudyPersistence(
+            service.root, CLIENT_STUDY, None, fs=service.fs
+        )
+        if not probe.exists():
+            probe.close()
+            raise CheckpointError(
+                f"resume_from root {service.root!r} holds no "
+                f"{CLIENT_STUDY!r} study artifacts; pass "
+                "trials_save_file= to start a fresh recoverable run "
+                "instead"
+            )
+        probe.close()
+
+    host_algo = None
+    if spec.name == "atpe":
+        # the hook closes over the LIVE trials store; on restore it is
+        # rebound below once the rebuilt store exists
+        host_algo = _make_host_hook(spec, domain, trials)
+    handle = service.create_study(CLIENT_STUDY, seed=0,
+                                  host_algo=host_algo)
+    study = handle._study
+    restored = bool(
+        study.n_tells or study.pending_asks or study.outstanding
+        or study.client_blob or study.n_asks
+    )
+    client = EngineClient(
+        service, handle, spec, domain, trials, rstate,
+        ask_ahead=ask_ahead, owns_service=owns,
+        max_submits=max_submits, restored=restored,
+    )
+    if restored:
+        trials = client.rebuild_trials(trials)
+        rstate = study.rstate  # the post-draw cursor of the last ask
+        client.rstate = rstate
+        if spec.name == "atpe":
+            study.host_algo = _make_host_hook(spec, domain, trials)
+        logger.info(
+            "resumed %d trial doc(s) from %r (study %r); rstate cursor "
+            "restored -- the suggestion stream continues exactly where "
+            "the previous run stopped",
+            len(trials), service.root, CLIENT_STUDY,
+        )
+    else:
+        if trials is None:
+            trials = Trials()
+        client.trials = trials
+        # the study's stream IS fmin's stream: submit-time seeds come
+        # off the driver's own rstate
+        study.rstate = rstate
+    # depth-k window, posterior-fresh by construction
+    study.fresh_window = 1
+    client.arm_durability()
+    return client, trials, rstate, restored

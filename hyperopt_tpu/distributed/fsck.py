@@ -16,6 +16,15 @@ completed_claim     crash between DONE publish and claim release     release (un
 duplicate_tid       completed job recycled back into new/running     retire the shadowed copy
 ==================  ==============================================  ===========================
 
+ROLES under the round-20 unified durability layout: ``--serve`` is
+the audit for everything the serve persistence writes -- fleet study
+roots AND the engine-routed ``fmin`` client's
+``trials_save_file``/``resume_from`` directory (``<root>/fmin.wal`` +
+``fmin.snap``; graftclient rides the same per-study WAL/snapshot
+machinery).  ``--driver`` remains for LEGACY solo-driver checkpoint
+FILES only (``fmin(engine=False, trials_save_file="ckpt")``'s
+``PATH``/``.meta``/``.wal`` family).
+
 ``--serve ROOT`` audits a SERVE study root -- the shared directory a
 fleet of ``SuggestService`` replicas keeps one ``<name>.wal`` /
 ``<name>.snap`` / ``<name>.claim`` family per study in.  Every family

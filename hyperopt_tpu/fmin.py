@@ -140,6 +140,7 @@ class FMinIter:
         trial_timeout=None,
         catch=(),
         recorder=None,
+        client=None,
     ):
         # graftscope: the driver's trace spans (driver.trial /
         # tell.wal_append / tell.applied) -- observation only, never
@@ -180,6 +181,12 @@ class FMinIter:
         # ask so an algo's result hook can pre-dispatch it -- see
         # _notify_result
         self._ask_ahead_seed = None
+        # graftclient: with a client, the driver stops being its own
+        # dispatch regime -- asks/tells route through the in-process
+        # serve engine (client.py), durability through the study WAL,
+        # and every driver.trial span carries the client-path study id
+        self._client = client
+        self._span_study = "driver" if client is None else client.study_name
 
         if self.asynchronous:
             # async workers fetch the Domain by attachment (SURVEY.md SS3.4)
@@ -331,65 +338,84 @@ class FMinIter:
             raise box["error"]
         return box["result"]
 
+    def _record_tell(self, trial, result=None):
+        """The write-ahead seam shared by both dispatch regimes: the
+        legacy solo driver logs to its ``DriverRecovery`` WAL, the
+        engine client tells/fails through the study's serve WAL -- in
+        both, the outcome is durable BEFORE the doc finalizes, so a
+        resumed run never re-runs or double-applies a trial."""
+        if self._client is not None:
+            self._client.record_tell(trial, result)
+        else:
+            self._log_tell(trial, result=result)
+
+    def _evaluate_trial(self, trial):
+        """Evaluate ONE queued trial doc in place -- containment
+        (``catch=`` / ``trial_timeout=``), durability write-ahead,
+        recorder spans, and the ask-ahead notification -- shared by
+        :meth:`serial_evaluate` and the engine-client loop so both
+        regimes contain failures and record outcomes identically."""
+        trial["state"] = JOB_STATE_RUNNING
+        trial["book_time"] = coarse_utcnow()
+        trial["owner"] = "serial"
+        spec = spec_from_misc(trial["misc"])
+        ctrl = Ctrl(self.trials, current_trial=trial)
+        result = failure = None
+        t_eval = (
+            timeit.default_timer() if self.recorder.enabled else 0.0
+        )
+        try:
+            result = self._evaluate_one(spec, ctrl)
+        except TrialTimeout as e:
+            failure = ("TrialTimeout", str(e), None)
+        except self.catch as e:
+            failure = (type(e).__name__, str(e), traceback.format_exc())
+        except Exception as e:
+            logger.error("job exception: %s", e)
+            trial["state"] = JOB_STATE_ERROR
+            trial["misc"]["error"] = (str(type(e)), str(e))
+            trial["misc"]["traceback"] = traceback.format_exc()
+            trial["refresh_time"] = coarse_utcnow()
+            # the failure is durable before any (re)raise: a
+            # resumed driver must not re-run a crashing objective
+            self._record_tell(trial)
+            if not self.catch_eval_exceptions:
+                self.trials.refresh()
+                raise
+        if result is not None or failure is not None:
+            if failure is not None:
+                kind, msg, tb = failure
+                logger.warning(
+                    "trial %s recorded as failed (%s): %s",
+                    trial["tid"], kind, msg,
+                )
+                result = {
+                    "status": STATUS_FAIL,
+                    "loss": None,
+                    "failure": f"{kind}: {msg}",
+                }
+                if tb is not None:
+                    result["traceback"] = tb
+            result = base.SONify(result)
+            # write-ahead: the tell is on disk before it is applied
+            self._record_tell(trial, result=result)
+            trial["state"] = JOB_STATE_DONE
+            trial["result"] = result
+            trial["refresh_time"] = coarse_utcnow()
+            if self.recorder.enabled:
+                self.recorder.record(
+                    "driver.trial", t_eval, timeit.default_timer(),
+                    study=self._span_study, tid=int(trial["tid"]),
+                    status=result.get("status"),
+                )
+            self._crashpoint("after_tell_before_ask_ahead")
+            self._notify_result()
+
     def serial_evaluate(self, N=-1):
         for trial in self.trials._dynamic_trials:
             if trial["state"] != JOB_STATE_NEW:
                 continue
-            trial["state"] = JOB_STATE_RUNNING
-            trial["book_time"] = coarse_utcnow()
-            trial["owner"] = "serial"
-            spec = spec_from_misc(trial["misc"])
-            ctrl = Ctrl(self.trials, current_trial=trial)
-            result = failure = None
-            t_eval = (
-                timeit.default_timer() if self.recorder.enabled else 0.0
-            )
-            try:
-                result = self._evaluate_one(spec, ctrl)
-            except TrialTimeout as e:
-                failure = ("TrialTimeout", str(e), None)
-            except self.catch as e:
-                failure = (type(e).__name__, str(e), traceback.format_exc())
-            except Exception as e:
-                logger.error("job exception: %s", e)
-                trial["state"] = JOB_STATE_ERROR
-                trial["misc"]["error"] = (str(type(e)), str(e))
-                trial["misc"]["traceback"] = traceback.format_exc()
-                trial["refresh_time"] = coarse_utcnow()
-                # the failure is durable before any (re)raise: a
-                # resumed driver must not re-run a crashing objective
-                self._log_tell(trial)
-                if not self.catch_eval_exceptions:
-                    self.trials.refresh()
-                    raise
-            if result is not None or failure is not None:
-                if failure is not None:
-                    kind, msg, tb = failure
-                    logger.warning(
-                        "trial %s recorded as failed (%s): %s",
-                        trial["tid"], kind, msg,
-                    )
-                    result = {
-                        "status": STATUS_FAIL,
-                        "loss": None,
-                        "failure": f"{kind}: {msg}",
-                    }
-                    if tb is not None:
-                        result["traceback"] = tb
-                result = base.SONify(result)
-                # write-ahead: the tell is on disk before it is applied
-                self._log_tell(trial, result=result)
-                trial["state"] = JOB_STATE_DONE
-                trial["result"] = result
-                trial["refresh_time"] = coarse_utcnow()
-                if self.recorder.enabled:
-                    self.recorder.record(
-                        "driver.trial", t_eval, timeit.default_timer(),
-                        study="driver", tid=int(trial["tid"]),
-                        status=result.get("status"),
-                    )
-                self._crashpoint("after_tell_before_ask_ahead")
-                self._notify_result()
+            self._evaluate_trial(trial)
             N -= 1
             if N == 0:
                 break
@@ -434,8 +460,51 @@ class FMinIter:
             self._save_trials()
 
     # -- main loop ---------------------------------------------------------
+    def _run_client(self, N):
+        """The engine-client loop (graftclient): evaluate any already-
+        queued docs first (``points_to_evaluate``, restored NEW docs),
+        then drive up to N trials through the study's depth-k ask/tell
+        window.  One trial = await the window head (its dispatch has
+        been submitted -- and on a background engine, in flight --
+        since before the previous trial's bookkeeping), insert the doc,
+        evaluate under the shared containment machinery, tell.  The
+        stopping rules, progress protocol, and per-trial containment
+        are exactly the solo loop's."""
+        trials = self.trials
+        client = self._client
+        n_new = 0
+        initial_n_done = trials.count_by_state_unsynced(JOB_STATE_DONE)
+        with self._progress_ctx(initial=0, total=N) as progress:
+            if trials.count_by_state_unsynced(JOB_STATE_NEW):
+                self.serial_evaluate()
+                client.maybe_snapshot()
+            while n_new < N:
+                trials.refresh()
+                if self.should_stop() or not client.budget_left():
+                    break
+                tid, vals = client.next_suggestion()
+                doc = client.insert_new_doc(tid, vals)
+                n_new += 1
+                self._evaluate_trial(doc)
+                client.maybe_snapshot()
+                n_done = trials.count_by_state_unsynced(JOB_STATE_DONE)
+                n_new_done = n_done - initial_n_done
+                if n_new_done > 0:
+                    try:
+                        best_loss = trials.best_trial["result"]["loss"]
+                    except AllTrialsFailed:
+                        best_loss = None
+                    progress.update(
+                        n_done - (initial_n_done + progress_done(progress)),
+                        best_loss=best_loss,
+                    )
+                    set_progress_done(progress, n_new_done)
+        trials.refresh()
+
     def run(self, N, block_until_done=True):
         """Enqueue and evaluate up to N new trials."""
+        if self._client is not None:
+            return self._run_client(N)
         trials = self.trials
         algo = self.algo
         n_queued = 0
@@ -634,6 +703,122 @@ def _run_compiled(fn, space, algo, max_evals, loss_threshold, trials,
         return None
 
 
+def _fmin_result(trials, return_argmin):
+    """The shared fmin return contract (argmin or best loss)."""
+    if return_argmin:
+        if len(trials.trials) == 0:
+            raise InvalidAnnotatedParameter(
+                "There are no evaluation tasks, cannot return argmin of task losses."
+            )
+        return trials.argmin
+    if len(trials) > 0:
+        try:
+            return trials.best_trial["result"]["loss"]
+        except AllTrialsFailed:
+            return None
+    return None
+
+
+def _run_engine_client(fn, space, algo, max_evals, timeout,
+                       loss_threshold, trials, rstate,
+                       pass_expr_memo_ctrl, catch_eval_exceptions,
+                       verbose, return_argmin, points_to_evaluate,
+                       max_queue_len, show_progressbar, early_stop_fn,
+                       trials_save_file, resume_from, trial_timeout,
+                       catch, recorder, engine, ask_ahead):
+    """The ``fmin(engine=...)`` body (graftclient): open a study on an
+    in-process serve engine and drive the sequential loop through
+    ``StudyHandle.ask``/``tell`` with a depth-k ask-ahead window --
+    the solo fused path's job, done by the one engine (ISSUE 15)."""
+    from .client import connect
+
+    if max_queue_len != 1:
+        raise ValueError(
+            "engine routing drives one ask at a time -- use "
+            "ask_ahead=k for pipelining (max_queue_len applies to the "
+            "solo/async drivers)"
+        )
+    if trials is not None and (
+        type(trials).fmin is not Trials.fmin
+        or getattr(trials, "asynchronous", False)
+    ):
+        raise ValueError(
+            "engine routing supports sequential Trials stores; async "
+            "backends (ThreadTrials / FileTrials / SparkTrials...) "
+            "dispatch their own fmin"
+        )
+    domain = Domain(fn, space, pass_expr_memo_ctrl=pass_expr_memo_ctrl)
+
+    root = None
+    require_existing = False
+    eng = True if engine is None or isinstance(engine, bool) else engine
+    if eng is True:
+        if resume_from is not None:
+            root = str(resume_from)
+            require_existing = True
+        elif trials_save_file:
+            root = str(trials_save_file)
+        if root is not None and os.path.isfile(root):
+            raise CheckpointError(
+                f"{root!r} is a FILE -- a legacy solo-driver "
+                "checkpoint; engine-client durability uses a "
+                "study-root DIRECTORY (<root>/fmin.wal + fmin.snap, "
+                "audited by hyperopt-tpu-fsck --serve).  Resume legacy "
+                "checkpoints with engine=False, or start a fresh "
+                "recoverable run against a directory (MIGRATION.md)"
+            )
+    elif trials_save_file or resume_from is not None:
+        raise ValueError(
+            "with a provided engine service, durability rides its "
+            "root=; drop trials_save_file/resume_from (restore is "
+            "implicit when the root holds study artifacts)"
+        )
+
+    if trials is None and points_to_evaluate is not None:
+        assert isinstance(points_to_evaluate, list)
+        trials = generate_trials_to_calculate(points_to_evaluate)
+    elif (
+        trials is not None
+        and points_to_evaluate is not None
+        and len(trials) == 0
+    ):
+        assert isinstance(points_to_evaluate, list)
+        seeded = generate_trials_to_calculate(points_to_evaluate)
+        trials._ids.update(t["tid"] for t in seeded._dynamic_trials)
+        trials._insert_trial_docs(seeded._dynamic_trials)
+        trials.refresh()
+
+    client, trials, rstate, restored = connect(
+        eng, algo, domain, trials, rstate, fn=fn,
+        ask_ahead=1 if ask_ahead is None else int(ask_ahead),
+        root=root, require_existing=require_existing,
+        max_submits=max_evals, recorder=recorder,
+    )
+    rval = FMinIter(
+        algo,
+        domain,
+        trials,
+        max_evals=max_evals,
+        timeout=timeout,
+        loss_threshold=loss_threshold,
+        rstate=rstate,
+        verbose=verbose,
+        max_queue_len=1,
+        show_progressbar=show_progressbar,
+        early_stop_fn=early_stop_fn,
+        trial_timeout=trial_timeout,
+        catch=catch,
+        recorder=recorder,
+        client=client,
+    )
+    rval.catch_eval_exceptions = catch_eval_exceptions
+    rval.exhaust()
+    # orderly completion only: a crash (SimulatedCrash, uncaught
+    # objective error) must leave the WAL as the truth, un-compacted
+    client.finalize()
+    return _fmin_result(trials, return_argmin)
+
+
 def fmin(
     fn,
     space,
@@ -659,8 +844,28 @@ def fmin(
     compiled=False,
     compiled_options=None,
     recorder=None,
+    engine=None,
+    ask_ahead=None,
 ):
     """Minimize ``fn`` over ``space`` using ``algo``.
+
+    Engine routing (graftclient): ``engine=True`` (or any
+    ``ask_ahead=``) routes the sequential driver through an in-process
+    :class:`~hyperopt_tpu.serve.SuggestService` -- ``fmin`` becomes a
+    client of the same study-batched engine that serves multi-tenant
+    traffic, so admission control, quarantine, the dispatch watchdog,
+    WAL durability, mesh sharding, and graftscope all apply to a solo
+    run.  ``ask_ahead=k`` keeps k asks submitted ahead (seeds drawn at
+    submit time, dispatch gated on posterior freshness), so the stream
+    is bitwise the solo fused driver's AT ANY DEPTH; ``k=1`` is the
+    exact one-dispatch-per-trial degenerate.  ``engine`` may also be a
+    caller-built ``SuggestService`` (chaos harnesses arm crash points
+    on its ``fs`` seam).  In this mode ``trials_save_file`` /
+    ``resume_from`` name a study-root DIRECTORY (``<root>/fmin.wal`` /
+    ``.snap`` -- audit with ``hyperopt-tpu-fsck --serve``), one
+    durability story shared with the serve tier.  ``algo`` must map
+    onto an engine body (``tpe_jax`` / ``anneal_jax`` /
+    ``atpe_jax`` ``.suggest``, partials included).
 
     Observability (graftscope): ``recorder`` (a
     :class:`~hyperopt_tpu.obs.FlightRecorder`) arms driver trace spans
@@ -704,10 +909,21 @@ def fmin(
     ``runner=`` for compile reuse across calls).
     """
     if algo is None:
-        from . import tpe
+        if bool(engine) or ask_ahead is not None:
+            from . import tpe_jax
 
-        algo = tpe.suggest
-        logger.warning("fmin: algo not specified, defaulting to tpe.suggest")
+            algo = tpe_jax.suggest
+            logger.warning(
+                "fmin: algo not specified, defaulting to "
+                "tpe_jax.suggest (the engine routing's native body)"
+            )
+        else:
+            from . import tpe
+
+            algo = tpe.suggest
+            logger.warning(
+                "fmin: algo not specified, defaulting to tpe.suggest"
+            )
 
     if max_evals is None:
         max_evals = float("inf")
@@ -724,6 +940,23 @@ def fmin(
     validate_timeout(timeout)
     validate_loss_threshold(loss_threshold)
     validate_timeout(trial_timeout)
+
+    use_engine = bool(engine) or ask_ahead is not None
+    if use_engine and compiled:
+        raise ValueError(
+            "engine=/ask_ahead= route the sequential driver through "
+            "the serve engine; compiled=True is the on-device regime "
+            "-- pick one"
+        )
+    if use_engine:
+        return _run_engine_client(
+            fn, space, algo, max_evals, timeout, loss_threshold,
+            trials, rstate, pass_expr_memo_ctrl, catch_eval_exceptions,
+            verbose, return_argmin, points_to_evaluate, max_queue_len,
+            show_progressbar, early_stop_fn, trials_save_file,
+            resume_from, trial_timeout, catch, recorder, engine,
+            ask_ahead,
+        )
 
     if compiled:
         # the RTT-floor bypass: the WHOLE ask-evaluate-tell loop runs
@@ -859,18 +1092,7 @@ def fmin(
         recovery.checkpoint(trials, rstate)
     rval.exhaust()
 
-    if return_argmin:
-        if len(trials.trials) == 0:
-            raise InvalidAnnotatedParameter(
-                "There are no evaluation tasks, cannot return argmin of task losses."
-            )
-        return trials.argmin
-    if len(trials) > 0:
-        try:
-            return trials.best_trial["result"]["loss"]
-        except AllTrialsFailed:
-            return None
-    return None
+    return _fmin_result(trials, return_argmin)
 
 
 def validate_timeout(timeout):

@@ -20,6 +20,11 @@ the way LLM serving batches requests (continuous batching):
   durability (PR-6 :class:`~hyperopt_tpu.utils.wal.TellWAL` machinery,
   exactly-once tells across a service crash), and a stdlib JSON-line
   socket transport behind the ``hyperopt-tpu-serve`` console script.
+
+Since round 20 this engine is ALSO the sequential driver: a solo
+``fmin(engine=True / ask_ahead=k)`` is a batch-of-one tenant driven
+through :mod:`hyperopt_tpu.client` (graftclient) -- there is no
+separate solo dispatch regime anymore (DESIGN.md §3b/§3g).
 """
 
 __all__ = [

@@ -117,6 +117,17 @@ def _cache_interlock():
     jax.config.update("jax_enable_compilation_cache", False)
 
 
+def draw_seed(rstate):
+    """One per-ask seed off ``rstate``'s stream -- the submit-time draw.
+    Works for both ``np.random.Generator`` and the legacy
+    ``RandomState`` (the two stream types ``fmin`` accepts), so a
+    client study wired to the driver's own rstate draws exactly the
+    seeds the solo driver's ``_take_seed`` would."""
+    if hasattr(rstate, "integers"):
+        return int(rstate.integers(2**31 - 1))
+    return int(rstate.randint(2**31 - 1))
+
+
 def dense_to_vals(ps, col_v, col_a):
     """One dense suggestion column -> the {label: value} config dict at
     API types (ints for categorical-family dims, inactive conditional
@@ -156,6 +167,26 @@ class ServeStudy:
         self.pending_asks = {}  # tid -> seed: WAL-logged, never served
         self.persist = None  # durability hooks (service wires them)
         self.claim = None  # fleet ownership token (service wires it)
+        # graftclient (the fmin-as-client path):
+        # host_algo: per-study host-adaptive dispatch hook
+        #   ``hook(seed) -> (values [D, 1], active [D, 1])`` -- serves
+        #   this study's picks instead of the shared vmapped program
+        #   (atpe's host decision layer cannot vmap across studies);
+        #   the study then never occupies a batch slot.
+        # fresh_window: depth-k outstanding-ask gate -- with it set, a
+        #   queued ask is only picked while fewer than this many served
+        #   suggestions await their tell, so an ask-ahead client's
+        #   every dispatch sees the full posterior (the bitwise-at-any-
+        #   depth construction; None = no gate, the multi-tenant
+        #   default).
+        # client_state_fn / client_blob / restore_records: the client's
+        #   snapshot seam -- extra durable state rides the study bundle
+        #   and comes back (with the replayed WAL suffix) on restore.
+        self.host_algo = None
+        self.fresh_window = None
+        self.client_state_fn = None
+        self.client_blob = None
+        self.restore_records = None
 
     def best(self):
         """(loss, vals) of the best finite completed trial, or None --
@@ -274,6 +305,11 @@ class BatchScheduler:
         "serve_device_metric_dispatches_total",
         "obs.device_metrics twin dispatches (cadence-gated; NOT part "
         "of serve_dispatch_total)")
+    host_algo_served = CounterAttr(
+        "serve_host_algo_served_total",
+        "asks served by a per-study host_algo hook (graftclient atpe; "
+        "NOT part of serve_dispatch_total -- the hook's own device "
+        "dispatches are counted on its ObsBuffer)")
     ask_latencies = HistogramAttr(
         "serve_ask_latency_seconds", "submit-to-ack ask latency",
         window=METRICS_WINDOW)
@@ -343,7 +379,12 @@ class BatchScheduler:
         )
         self.algo_kw = dict(algo_kw)
         _cache_interlock()  # before any serve program builds/loads
-        if self.algo == "tpe":
+        # "atpe" studies are served by their per-study host_algo hook
+        # (graftclient), never by the shared vmapped program -- the
+        # engine program family stays the TPE body (jit is lazy, so an
+        # all-hook service never compiles it)
+        self._engine_algo = "tpe" if self.algo == "atpe" else self.algo
+        if self._engine_algo == "tpe":
             from ..tpe_jax import _resolve_above_cap
 
             self._pow2_cap = _resolve_above_cap(
@@ -351,9 +392,13 @@ class BatchScheduler:
             )
         else:
             self._pow2_cap = None
+        engine_kw = {
+            k: v for k, v in self.algo_kw.items()
+            if self.algo != "atpe"
+        }
         self._step_fn = build_batched_step_fn(
-            ps, algo=self.algo, mesh=self.mesh,
-            mesh_axis=self._mesh_axis, **self.algo_kw
+            ps, algo=self._engine_algo, mesh=self.mesh,
+            mesh_axis=self._mesh_axis, **engine_kw
         )
         self._delta_fn = build_batched_delta_fn(
             mesh=self.mesh, mesh_axis=self._mesh_axis
@@ -393,8 +438,8 @@ class BatchScheduler:
             "admitted_count", "shed_count", "guard_checks",
             "quarantine_count", "evictions", "watchdog_timeouts",
             "watchdog_retries", "watchdog_recoveries",
-            "device_metric_dispatches", "ask_latencies", "occupancy",
-            "watchdog_recovery_ms",
+            "device_metric_dispatches", "host_algo_served",
+            "ask_latencies", "occupancy", "watchdog_recovery_ms",
         ):
             getattr(self, attr)
 
@@ -440,10 +485,15 @@ class BatchScheduler:
             st = study if study is not None else ServeStudy(
                 name, seed, self.ps
             )
-            st.slot = self._alloc_slot()
-            st.dirty = True  # _maintain re-materializes its shard
+            if st.host_algo is None:
+                st.slot = self._alloc_slot()
+                st.dirty = True  # _maintain re-materializes its shard
+                self._slots[st.slot] = st
+            else:
+                # host-hook studies (graftclient atpe) are served
+                # outside the slotted batch: no slot, no stacked state
+                st.slot = None
             self._studies[name] = st
-            self._slots[st.slot] = st
             self.joins += 1
             return st
 
@@ -467,7 +517,7 @@ class BatchScheduler:
             return self._studies[name]
 
     # -- tell --------------------------------------------------------------
-    def tell(self, study, tid, vals, loss):  # graftlint: disable=GL503 the WAL append IS the tell's durability barrier and must be ordered inside the study's tell linearization (write-ahead-then-apply, PR-6/PR-8); moving it outside the lock reorders tells against dedup and delta staging
+    def tell(self, study, tid, vals, loss, result=None):  # graftlint: disable=GL503 the WAL append IS the tell's durability barrier and must be ordered inside the study's tell linearization (write-ahead-then-apply, PR-6/PR-8); moving it outside the lock reorders tells against dedup and delta staging
         """Absorb one completed trial: WAL first, host buffer second,
         device delta staged third.  Synchronous -- the durability
         barrier is the WAL append, and the host add is O(D).
@@ -488,7 +538,7 @@ class BatchScheduler:
                 return
             t0 = time.perf_counter() if rec.enabled else 0.0
             if study.persist is not None:
-                study.persist.log_tell(tid, vals, loss)
+                study.persist.log_tell(tid, vals, loss, result=result)
             if rec.enabled:
                 t1 = time.perf_counter()
                 rec.record(
@@ -509,6 +559,28 @@ class BatchScheduler:
                     "tell", t0, t2, study=study.name, tid=int(tid),
                     **self.span_ids,
                 )
+            # a tell can open a study's fresh_window gate: wake the
+            # background loop so the unblocked ask dispatches now
+            self._cond.notify_all()
+
+    def tell_failure(self, study, tid, doc=None):
+        """Absorb one FAILED trial (graftclient): the evaluation ended
+        in STATUS_FAIL / JOB_STATE_ERROR, so nothing enters the
+        posterior -- exactly the solo driver's behavior, where failed
+        docs never pass ``posterior_state`` -- but the outcome is made
+        durable (WAL ``fail`` record) BEFORE the outstanding ask is
+        retired, so a resumed client never re-runs a known-bad trial
+        and never re-serves its suggestion."""
+        with self._lock:
+            buf = study.buf
+            if (buf.tids[: buf.count] == int(tid)).any():
+                return  # already told ok earlier: nothing to fail
+            if study.persist is not None:
+                study.persist.log_fail(tid, doc=doc)
+            study.next_tid = max(study.next_tid, int(tid) + 1)
+            study.outstanding.pop(int(tid), None)
+            study.pending_asks.pop(int(tid), None)
+            self._cond.notify_all()
 
     def _apply_tell(self, study, tid, vals, loss):
         """Host-side tell application (shared with WAL replay, which
@@ -653,13 +725,19 @@ class BatchScheduler:
                 study.next_tid = max(study.next_tid, tid + 1)
                 self.admitted_count += 1
             else:
-                seed = int(study.rstate.integers(2**31 - 1))
+                seed = draw_seed(study.rstate)
                 tid = study.next_tid
                 study.next_tid = tid + 1
                 study.n_asks += 1
                 self.admitted_count += 1
                 if study.persist is not None:
                     study.persist.log_ask(tid, seed, study.rstate)
+                # the live twin of the WAL ask record: queued-but-
+                # unserved asks survive a snapshot that compacts their
+                # records away (the bundle carries pending_asks), so a
+                # restored service re-serves a crashed client's ask
+                # window bitwise no matter where the cadence fell
+                study.pending_asks[int(tid)] = int(seed)
             req = _AskRequest(study, tid, seed, deadline=deadline)
             self._asks.append(req)
             self._queued_per_study[study.name] += 1
@@ -827,6 +905,17 @@ class BatchScheduler:
                     "expired while queued; shed before dispatch"
                 ))
                 continue
+            if (
+                req.study.fresh_window is not None
+                and len(req.study.outstanding) >= req.study.fresh_window
+            ):
+                # depth-k ask-ahead gate (graftclient): the study still
+                # owes tells for previously served suggestions, so this
+                # ask stays queued -- its submit-time seed is already
+                # fixed, and the later dispatch will see the full
+                # posterior (bitwise-at-any-depth by construction)
+                leftover.append(req)
+                continue
             if id(req.study) in seen or len(picked) >= self.max_batch:
                 leftover.append(req)
                 continue
@@ -964,8 +1053,84 @@ class BatchScheduler:
             ) from None
 
     def _dispatch_round(self, picked):  # graftlint: disable=GL503,GL505,GL507 the round (flush-only served record, acks) is atomic under the lock by design -- acks-last keeps crashes replayable, no done-callbacks exist (see _pick_round), and a daemon-torn served record is flush-only: replay re-derives it from the ask cursor (PR-6/PR-8 recovery contract)
-        """Serve one picked round (lock held): maintain the stacked
-        state, run the batched program, ack every pick."""
+        """Serve one picked round (lock held): the batched program for
+        slot-resident studies, the per-study ``host_algo`` hook for
+        host-adaptive ones (graftclient atpe), then ack every pick --
+        acks last, so a crash anywhere above leaves the round fully
+        replayable, never half-acked."""
+        host_picked = [r for r in picked if r.study.host_algo is not None]
+        eng_picked = [r for r in picked if r.study.host_algo is None]
+        if eng_picked:
+            results = self._dispatch_engine(eng_picked)
+            results.extend(self._serve_host_picks(host_picked, False))
+        else:
+            # host-only round: same crash windows as an engine round
+            # (mid-batch before the draw, after-dispatch before the
+            # served record), so the client chaos suite exercises
+            # identical seams on the hook path
+            self.fs.crashpoint("serve_mid_batch")
+            results = self._serve_host_picks(host_picked, True)
+        served = 0
+        rec = self.recorder
+        s = max(self._slot_cap, 1)
+        blk = max(1, s // self._n_shards)
+        now = time.perf_counter()
+        for req, vals in results:
+            if isinstance(vals, Exception):
+                req.future.set_exception(vals)
+            else:
+                req.future.set_result((req.tid, vals))
+                served += 1
+                if rec.enabled:
+                    slot = req.study.slot
+                    rec.record(
+                        "ask.delivered", req.t_submit, now,
+                        study=req.study.name, tid=req.tid, slot=slot,
+                        shard=(slot // blk if slot is not None else None),
+                        **self.span_ids,
+                    )
+        return served
+
+    def _serve_host_picks(self, host_picked, fire_crashpoint):  # graftlint: disable=GL503,GL507 same contract as _dispatch_round: the flush-only served record is part of the atomic round under the lock, and a daemon-torn record is re-derived on replay from the ask cursor (PR-6/PR-8 recovery contract)
+        """Serve the host-hook picks of one round (lock held): each
+        study's ``host_algo(seed)`` draws its suggestion -- the hook
+        is the solo host-adaptive dispatch verbatim, so the stream is
+        bitwise the solo driver's.  A raising hook fails only ITS
+        client (the typed error rides the ack), exactly like a
+        poisoned slot; ``SimulatedCrash`` (a BaseException) keeps
+        propagating."""
+        draws = []
+        for req in host_picked:
+            try:
+                draws.append((req, req.study.host_algo(req.seed)))
+            except Exception as e:
+                draws.append((req, e))
+        if fire_crashpoint:
+            self.fs.crashpoint("serve_after_dispatch_before_ack")
+        results = []
+        now = time.perf_counter()
+        for req, out in draws:
+            if isinstance(out, Exception):
+                results.append((req, out))
+                continue
+            st = req.study
+            v, a = out
+            vals = dense_to_vals(
+                self.ps, np.asarray(v)[:, 0], np.asarray(a)[:, 0]
+            )
+            if st.persist is not None:
+                st.persist.log_served(req.tid, vals)
+            st.outstanding[req.tid] = vals
+            st.pending_asks.pop(req.tid, None)
+            self.ask_latencies.append(now - req.t_submit)
+            self.host_algo_served += 1
+            results.append((req, vals))
+        return results
+
+    def _dispatch_engine(self, picked):  # graftlint: disable=GL503,GL505,GL507 see _dispatch_round -- this is its engine half, same round-atomicity contract
+        """The engine half of one round (lock held): maintain the
+        stacked state, run the batched program, build (req, vals)
+        results for the ack phase."""
         import jax
         import jax.numpy as jnp
 
@@ -987,7 +1152,7 @@ class BatchScheduler:
                 dapply[st.slot] = True
             warm[st.slot] = (
                 st.buf.count > 0
-                if self.algo == "anneal"
+                if self._engine_algo == "anneal"
                 else st.buf.count >= self.n_startup_jobs
             )
         for req in picked:
@@ -1069,26 +1234,9 @@ class BatchScheduler:
             st.pending_asks.pop(req.tid, None)  # replayed ask served
             self.ask_latencies.append(now - req.t_submit)
             results.append((req, vals))
-        # acks last: a crash above leaves every pick un-acked and
-        # replayable, never half-acked
-        served = 0
-        rec = self.recorder
-        blk = max(1, s // self._n_shards)
-        for req, vals in results:
-            if isinstance(vals, Exception):
-                req.future.set_exception(vals)
-            else:
-                req.future.set_result((req.tid, vals))
-                served += 1
-                if rec.enabled:
-                    slot = req.study.slot
-                    rec.record(
-                        "ask.delivered", req.t_submit, now,
-                        study=req.study.name, tid=req.tid, slot=slot,
-                        shard=(slot // blk if slot is not None else None),
-                        **self.span_ids,
-                    )
-        return served
+        # acks happen in _dispatch_round, last: a crash above leaves
+        # every pick un-acked and replayable, never half-acked
+        return results
 
     def _dispatch_device_metrics(self, state):  # graftlint: disable=GL503 the metrics twin runs inside the round serialization point by design (one dispatch in flight, ever -- see _run_dispatch); its cost is cadence-bounded
         """The graftscope device twin (lock held): on cadence, run the
@@ -1257,7 +1405,14 @@ class BatchScheduler:
                 if self._stopping:
                     return
             try:
-                self.step()
+                served = self.step()
+                if served == 0:
+                    # every queued ask is gated (a fresh_window study
+                    # still owes tells): park until a tell notifies
+                    # instead of spinning the round loop dry
+                    with self._cond:
+                        if self._asks and not self._stopping:
+                            self._cond.wait(timeout=0.005)
             except BaseException:
                 # a dying batcher must not strand blocked clients
                 # (contained dispatch failures no longer land here --

@@ -31,7 +31,7 @@ import threading
 import numpy as np
 
 from ..distributed.faults import REAL_FS
-from ..exceptions import Overloaded, ServeError
+from ..exceptions import DeadlineExpired, Overloaded, ServeError
 from ..ops.compile import compile_space
 from ..utils.wal import TellWAL
 from .scheduler import BatchScheduler, ServeStudy
@@ -129,10 +129,26 @@ class StudyPersistence:
             "served", {"tid": int(tid), "vals": dict(vals)}, sync=False
         )
 
-    def log_tell(self, tid, vals, loss):
-        self.wal.append("tell", {
-            "tid": int(tid), "vals": dict(vals), "loss": float(loss),
-        })
+    def log_tell(self, tid, vals, loss, result=None):
+        body = {"tid": int(tid), "vals": dict(vals), "loss": float(loss)}
+        if result is not None:
+            # graftclient: the full SONified result dict rides the tell
+            # record, so a resumed fmin client rebuilds its Trials docs
+            # (arbitrary objective-returned keys included) from the one
+            # unified WAL instead of a driver-WAL twin
+            body["result"] = result
+        self.wal.append("tell", body)
+        self._tells_since_snap += 1
+
+    def log_fail(self, tid, doc=None):
+        """One FAILED evaluation, durable before the doc finalizes
+        (graftclient): nothing enters the posterior, but the outcome --
+        including the client's error/traceback payload -- survives a
+        crash, so a resumed run never re-runs a known-bad trial."""
+        body = {"tid": int(tid)}
+        if doc is not None:
+            body["doc"] = doc
+        self.wal.append("fail", body)
         self._tells_since_snap += 1
 
     # -- snapshot bundles --------------------------------------------------
@@ -172,6 +188,11 @@ class StudyPersistence:
                 int(t): int(s) for t, s in study.pending_asks.items()
             },
         }
+        if study.client_state_fn is not None:
+            # graftclient: the fmin client's durable state (its SONified
+            # Trials docs) rides the SAME bundle -- one snapshot, one
+            # WAL, one durability story for engine and driver state
+            bundle["client"] = study.client_state_fn()
         _common.with_retries(
             lambda: durable_pickle(bundle, self.snap_path, fs=self.fs),
             label="serve snapshot",
@@ -255,8 +276,20 @@ class StudyPersistence:
                 study.next_tid = max(study.next_tid, tid + 1)
                 study.outstanding.pop(tid, None)
                 study.pending_asks.pop(tid, None)
+            elif kind == "fail":
+                # a durably-failed evaluation: nothing entered the
+                # posterior, but the ask is settled -- never re-served,
+                # never re-run (graftclient exactly-once contract)
+                tid = int(rec["tid"])
+                study.next_tid = max(study.next_tid, tid + 1)
+                study.outstanding.pop(tid, None)
+                study.pending_asks.pop(tid, None)
         if last_cursor is not None:
             study.rstate = decode_rstate(last_cursor)
+        # the client's restore seam: its bundle blob plus the replayed
+        # WAL suffix (doc rebuild needs the served/tell/fail payloads)
+        study.client_blob = bundle.get("client") if bundle else None
+        study.restore_records = records
         study.dirty = True
         return study
 
@@ -286,7 +319,7 @@ class StudyHandle:
         submit."""
         return self._service._ask_async(self._study)
 
-    def ask(self, timeout=60.0, recover=False):
+    def ask(self, timeout=60.0, recover=False, backoff=False):
         """One suggestion, blocking until its batch is served.
 
         ``timeout`` doubles as the CLIENT DEADLINE the scheduler
@@ -295,6 +328,16 @@ class StudyHandle:
         raises :class:`~hyperopt_tpu.exceptions.DeadlineExpired`; one
         already picked into an in-flight dispatch is awaited a short
         grace period instead.
+
+        ``backoff=True`` turns an admission refusal
+        (:class:`~hyperopt_tpu.exceptions.Overloaded`) into bounded
+        retry-with-backoff UNDER THE SAME DEADLINE: the client sleeps
+        the refusal's ``retry_after`` hint (never past the deadline)
+        and resubmits; when the deadline cannot fit another retry the
+        typed escalation is :class:`~hyperopt_tpu.exceptions.
+        DeadlineExpired`, never a silent full-timeout hang.  This is
+        what a waiting ``fmin`` client uses -- backpressure is a pace
+        signal, not a failure.
 
         ``recover=True`` is the retrying client's declaration that its
         PREVIOUS ask's reply was lost (replica failover, router crash
@@ -305,18 +348,50 @@ class StudyHandle:
         one returns its recorded vals directly.  With one logical
         client per study this gives exactly-once delivery; concurrent
         clients of one study should not pass it casually."""
+        import time as _time
+
         if recover:
             got = self._service._recover_ask(self._study, timeout)
             if got is not None:
                 return got
-        req = self._service._submit(self._study, timeout=timeout)
-        return self._service._await(req, timeout)
+        deadline = _time.perf_counter() + float(timeout)
+        while True:
+            remaining = deadline - _time.perf_counter()
+            try:
+                req = self._service._submit(
+                    self._study, timeout=max(remaining, 0.0)
+                )
+            except Overloaded as e:
+                if not backoff:
+                    raise
+                wait = e.retry_after if e.retry_after else 0.05
+                if _time.perf_counter() + wait >= deadline:
+                    raise DeadlineExpired(
+                        f"study {self._study.name!r}: the service stayed "
+                        f"overloaded ({e.reason}) past the client "
+                        f"deadline ({timeout}s); last retry_after hint "
+                        f"was {wait}s"
+                    ) from e
+                _time.sleep(wait)
+                continue
+            return self._service._await(req, max(remaining, 0.0))
 
-    def tell(self, tid, loss, vals=None):
+    def tell(self, tid, loss, vals=None, result=None):
         """Report one evaluation.  ``vals`` defaults to what the
         service served for ``tid``; pass it explicitly when re-telling
-        work whose ack a crashed service lost."""
-        self._service._tell(self._study, tid, loss, vals)
+        work whose ack a crashed service lost.  ``result`` (optional,
+        JSON-able) is stored on the durable tell record -- the fmin
+        client rides its full result dict along so resume can rebuild
+        Trials docs from the one WAL."""
+        self._service._tell(self._study, tid, loss, vals, result=result)
+
+    def fail(self, tid, doc=None):
+        """Report one FAILED evaluation: the suggestion for ``tid`` is
+        retired (never re-served, nothing enters the posterior) and
+        the failure -- with the optional JSON-able ``doc`` payload
+        (error, traceback) -- is WAL-durable first, so a resumed
+        client never re-runs a known-bad trial."""
+        self._service._fail(self._study, tid, doc)
 
     def best(self):
         """``{"loss", "vals"}`` of the best completed trial, or None."""
@@ -392,14 +467,21 @@ class SuggestService:
             self.scheduler.start()
 
     # -- tenancy -----------------------------------------------------------
-    def create_study(self, name, seed=0, takeover=False):  # graftlint: disable=GL503 the durable open record must be atomic with the registry insert -- two racing creates of one name must serialize restore-or-create, and an unrecorded-but-registered study would lose its seed on crash
+    def create_study(self, name, seed=0, takeover=False, host_algo=None):  # graftlint: disable=GL503 the durable open record must be atomic with the registry insert -- two racing creates of one name must serialize restore-or-create, and an unrecorded-but-registered study would lose its seed on crash
         """Open (or re-attach to, or restore) a study by name.
 
         With a fleet identity (``owner=``) the study's claim token is
         acquired first: a study live-owned by another replica is
         refused with :class:`~hyperopt_tpu.exceptions.OwnershipLost`
         unless ``takeover=True`` (the failover/migration path, which
-        bumps the claim epoch and fences the previous owner out)."""
+        bumps the claim epoch and fences the previous owner out).
+
+        ``host_algo`` (in-process clients only -- graftclient) attaches
+        a per-study host-adaptive dispatch hook ``hook(seed) ->
+        (values [D, 1], active [D, 1])``: the study is served by the
+        hook instead of the shared vmapped program (atpe's host
+        decision layer cannot vmap across studies) and never occupies
+        a batch slot.  Not expressible over the socket transport."""
         if not _NAME_RE.fullmatch(name):
             raise ValueError(
                 f"study name {name!r} must match {_NAME_RE.pattern}"
@@ -446,6 +528,7 @@ class SuggestService:
                     persist.log_open(seed)
             study.persist = persist
             study.claim = claim
+            study.host_algo = host_algo  # before open: decides slotting
             self.scheduler.open_study(name, seed, study=study)
             handle = StudyHandle(self, study)
             self._handles[name] = handle
@@ -573,7 +656,7 @@ class SuggestService:
         grace = self.scheduler.dispatch_timeout or 5.0
         return fut.result(timeout=2.0 * grace + 1.0)
 
-    def _tell(self, study, tid, loss, vals=None):
+    def _tell(self, study, tid, loss, vals=None, result=None):
         if vals is None:
             vals = study.outstanding.get(tid)
         if vals is None:
@@ -586,8 +669,17 @@ class SuggestService:
         # whose claim was taken over must not write to a log the new
         # owner is appending to (the double-serve hazard)
         self._fence(study)
-        self.scheduler.tell(study, tid, vals, loss)
-        if study.persist is not None:
+        self.scheduler.tell(study, tid, vals, loss, result=result)
+        if study.persist is not None and study.client_state_fn is None:
+            # client studies snapshot at TRIAL boundaries instead (the
+            # blob must never capture a doc mid-finalize; the client
+            # drives the cadence after each doc settles)
+            study.persist.maybe_snapshot(study)
+
+    def _fail(self, study, tid, doc=None):
+        self._fence(study)
+        self.scheduler.tell_failure(study, tid, doc=doc)
+        if study.persist is not None and study.client_state_fn is None:
             study.persist.maybe_snapshot(study)
 
     # -- service-level controls --------------------------------------------
@@ -617,6 +709,8 @@ class SuggestService:
             "watchdog_timeouts": s.watchdog_timeouts,
             "watchdog_retries": s.watchdog_retries,
             "watchdog_recoveries": s.watchdog_recoveries,
+            # graftclient accounting
+            "host_algo_served": s.host_algo_served,
         }
 
     def metrics_rows(self):

@@ -626,6 +626,16 @@ def suggest(
     redraw rides the same delta/fused state engine) and keeps its own
     staleness semantics; the auto-degrade guard above is build-time
     space logic and behaves identically on resident state.
+
+    COMPATIBILITY STATUS (round 20, graftclient): the solo fused /
+    speculative dispatch modes above are maintained as the parity
+    reference, not the production path -- ``fmin(engine=True)`` /
+    ``fmin(ask_ahead=k)`` routes this same suggest body through the
+    serve engine (one fused dispatch per trial at batch 1, bitwise
+    this driver's stream at any depth, plus WAL durability, admission
+    control, and tracing).  The ``state_io`` builder stays load-
+    bearing either way: it IS the per-slot closure the serve engine
+    vmaps (DESIGN.md §3b).
     """
     kw = dict(
         prior_weight=prior_weight,

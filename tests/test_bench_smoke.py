@@ -42,7 +42,15 @@ def test_bench_prints_one_json_line():
     assert d["seconds_to_best_at_1k_spec8"] > 0
     assert d["n_trials_1k"] == 40
     assert d["speculative_suggest_per_sec"] > 0
-    assert d["single_suggest_sync_per_sec"] > 0
+    # round-20 graftclient rows: fmin-as-serve-client replaces the
+    # retired solo sync regime (single_suggest_sync_per_sec is GONE),
+    # and the client stream is bitwise the solo driver's -- same seed,
+    # same experiment, so the quality row must MATCH exactly
+    assert "single_suggest_sync_per_sec" not in d
+    assert d["seconds_to_best_at_1k_client"] > 0
+    assert d["fmin_client_asks_per_sec"] > 0
+    assert d["fmin_ask_ahead_depth"] == 4
+    assert d["best_loss_at_1k_client"] == d["best_loss_at_1k"]
     # round-14: the device-loop family is stamped on EVERY backend,
     # keyed by backend so rounds stay comparable within one
     assert d["device_loop_trials_per_sec"] > 0

@@ -1,0 +1,460 @@
+"""graftclient: fmin as a serve client (ISSUE 15).
+
+The acceptance contract, pinned deterministically:
+
+* K=1 BITWISE PARITY: ``fmin(engine=True)`` produces exactly the trial
+  stream (tids, misc vals, losses, doc shapes) of the solo fused
+  driver -- for tpe, anneal, AND atpe (host-hook dispatch);
+* DEPTH INVISIBILITY: ``ask_ahead=k`` for any k>1 produces the SAME
+  stream as k=1 (submit-time seeds + the study's fresh_window gate --
+  the bitwise-at-any-depth construction);
+* BACKPRESSURE IS A PACE SIGNAL: a typed ``Overloaded`` at submit
+  becomes bounded retry-with-backoff under the client deadline, with a
+  typed ``DeadlineExpired`` escalation -- never a full-timeout hang;
+* CRASH-RECOVERY PARITY: kill-and-resume at every serve crash point
+  reproduces the PR-6 driver guarantees through the ONE unified WAL
+  (resume bitwise, zero lost / zero duplicate tells, durable failures
+  never re-run);
+* OBSERVABILITY: ``driver.trial`` spans carry the client-path study id
+  end to end (they correlate with the serve ``ask.*`` spans).
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import anneal_jax, atpe_jax, fmin, hp, tpe_jax
+from hyperopt_tpu.base import STATUS_FAIL, Trials
+from hyperopt_tpu.client import CLIENT_STUDY, resolve_engine_algo
+from hyperopt_tpu.distributed.faults import (
+    SERVE_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import (
+    CheckpointError,
+    DeadlineExpired,
+    Overloaded,
+)
+from hyperopt_tpu.fmin import partial
+from hyperopt_tpu.serve import SuggestService
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    # the lockdep sanitizer rides every client scenario: each fmin
+    # builds a scheduler, each instrumented; an observed lock-order
+    # inversion raises at acquisition time
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "q": hp.quniform("q", 0, 10, 1),
+    "c": hp.choice("c", [0, 1, 2]),
+}
+
+# the serve test-suite algo parameters, expressed at the plugin seam
+TPE_KW = dict(n_EI_candidates=16, n_EI_candidates_cat=8,
+              n_startup_jobs=3)
+N_FAST = 44  # past the warm boundary (3) and atpe's judged-at-20 gate
+
+
+def objective(cfg):
+    return (
+        (cfg["x"] - 1) ** 2 / 10
+        + abs(float(np.log(cfg["lr"])) + 2) / 3
+        + abs(cfg["q"] - 4) / 5
+        + 0.1 * cfg["c"]
+    )
+
+
+def run_fmin(algo, n=N_FAST, seed=7, obj=objective, trials=None, **kw):
+    trials = Trials() if trials is None else trials
+    fmin(
+        obj, SPACE, algo=algo, max_evals=n, trials=trials,
+        rstate=np.random.default_rng(seed), show_progressbar=False,
+        return_argmin=False, **kw,
+    )
+    return trials
+
+
+def stream(trials):
+    """The comparison stream: everything deterministic about a doc."""
+    return [
+        (
+            t["tid"], t["state"], t["misc"]["idxs"], t["misc"]["vals"],
+            t["result"],
+        )
+        for t in trials._dynamic_trials
+    ]
+
+
+_REF_CACHE = {}
+
+
+def solo_reference(key, algo, **kw):
+    """The solo-driver reference stream, computed once per config."""
+    if key not in _REF_CACHE:
+        _REF_CACHE[key] = stream(run_fmin(algo, **kw))
+    return _REF_CACHE[key]
+
+
+# ---------------------------------------------------------------------------
+# k=1 bitwise parity + depth invisibility
+# ---------------------------------------------------------------------------
+
+
+def test_tpe_client_k1_bitwise_vs_fused_solo():
+    """The degenerate contract: fmin-as-client at k=1 is bitwise the
+    solo fused one-dispatch-per-trial driver."""
+    ref = solo_reference(
+        "tpe-fused", partial(tpe_jax.suggest, fused=True, **TPE_KW)
+    )
+    got = stream(run_fmin(
+        partial(tpe_jax.suggest, **TPE_KW), engine=True
+    ))
+    assert got == ref
+
+
+def test_tpe_client_depth_is_invisible_to_the_stream():
+    """ask_ahead=k for k>1: submit-time seeds fix the seed sequence
+    and the fresh_window gate holds each dispatch until the posterior
+    is full -- so ANY depth produces the k=1 (= solo) stream."""
+    ref = solo_reference(
+        "tpe-fused", partial(tpe_jax.suggest, fused=True, **TPE_KW)
+    )
+    for k in (2, 5):
+        got = stream(run_fmin(
+            partial(tpe_jax.suggest, **TPE_KW), ask_ahead=k
+        ))
+        assert got == ref, f"ask_ahead={k} perturbed the stream"
+
+
+def test_tpe_client_k1_bitwise_vs_reupload_solo():
+    """The re-upload (non-resident) solo driver is bitwise the fused
+    one (PR-4 pin), so the client matches it too -- pinned directly."""
+    ref = solo_reference(
+        "tpe-plain", partial(tpe_jax.suggest, **TPE_KW)
+    )
+    got = stream(run_fmin(
+        partial(tpe_jax.suggest, **TPE_KW), engine=True
+    ))
+    assert got == ref
+
+
+def test_anneal_client_k1_and_depth_parity():
+    ref = solo_reference(
+        "anneal-res", partial(anneal_jax.suggest, resident=True)
+    )
+    assert stream(run_fmin(anneal_jax.suggest, engine=True)) == ref
+    assert stream(run_fmin(anneal_jax.suggest, ask_ahead=3)) == ref
+
+
+def test_atpe_client_k1_and_depth_parity():
+    """atpe rides the client API through its per-study host_algo hook
+    (the host decision layer cannot vmap across studies) -- stream
+    bitwise the solo adaptive driver, at any depth."""
+    ref = solo_reference(
+        "atpe", partial(atpe_jax.suggest, n_startup_jobs=3)
+    )
+    assert stream(run_fmin(
+        partial(atpe_jax.suggest, n_startup_jobs=3), engine=True
+    )) == ref
+    # depth >1 for atpe rides the generic gate already pinned above
+    # and in the slow 200-trial sweep (fast-tier wall-clock budget)
+
+
+def test_client_containment_matches_solo():
+    """catch= / trial_timeout containment and non-finite quarantine
+    behave identically through the client (the shared _evaluate_trial
+    machinery + fail records instead of posterior tells)."""
+
+    def flaky(cfg):
+        if cfg["c"] == 2:
+            raise ValueError("boom")
+        if cfg["q"] == 0.0:
+            return float("nan")
+        return objective(cfg)
+
+    kw = dict(obj=flaky, catch=(ValueError,))
+    ref = stream(run_fmin(
+        partial(tpe_jax.suggest, fused=True, **TPE_KW), **kw
+    ))
+    got = stream(run_fmin(
+        partial(tpe_jax.suggest, **TPE_KW), engine=True, **kw
+    ))
+    assert got == ref
+    assert any(t[4].get("status") == STATUS_FAIL for t in got)
+
+
+@pytest.mark.slow
+def test_client_parity_200_trials_all_algos():
+    """The 200-trial acceptance sweep: past the pow2 bucket crossing
+    and the _grow capacity boundary, for every engine algo, at two
+    depths, against BOTH solo variants (resident + re-upload)."""
+    cases = [
+        ("tpe", partial(tpe_jax.suggest, **TPE_KW),
+         partial(tpe_jax.suggest, fused=True, **TPE_KW)),
+        ("anneal", anneal_jax.suggest,
+         partial(anneal_jax.suggest, resident=True)),
+        ("atpe", partial(atpe_jax.suggest, n_startup_jobs=3),
+         partial(atpe_jax.suggest, resident=True, n_startup_jobs=3)),
+    ]
+    for name, plain_algo, resident_algo in cases:
+        ref_plain = stream(run_fmin(plain_algo, n=200))
+        ref_res = stream(run_fmin(resident_algo, n=200))
+        assert ref_plain == ref_res, f"{name}: solo variants diverged"
+        for k in (1, 4):
+            got = stream(run_fmin(plain_algo, n=200, ask_ahead=k))
+            assert got == ref_plain, f"{name} diverged at depth {k}"
+
+
+# ---------------------------------------------------------------------------
+# backpressure: Overloaded -> bounded retry -> DeadlineExpired
+# ---------------------------------------------------------------------------
+
+
+def _tiny_service(**kw):
+    return SuggestService(
+        SPACE, background=False, n_startup_jobs=2, n_cand=8,
+        n_cand_cat=8, **kw,
+    )
+
+
+def test_overloaded_backoff_retries_until_served():
+    """A full queue refuses the submit with Overloaded(retry_after);
+    ask(backoff=True) sleeps the hint and retries -- once a round
+    drains the queue, the ask is admitted and served."""
+    svc = _tiny_service(max_queue=1)
+    a = svc.create_study("a", seed=1)
+    b = svc.create_study("b", seed=2)
+    a.ask_async()  # fills the bounded queue
+    with pytest.raises(Overloaded):
+        b.ask(timeout=0.2)  # without backoff: the typed refusal
+
+    drained = threading.Event()
+
+    def drain():
+        time.sleep(0.1)
+        svc.pump()  # picks the queued ask -> queue has room again
+        drained.set()
+
+    t = threading.Thread(target=drain)
+    t.start()
+    tid, vals = b.ask(timeout=10.0, backoff=True)
+    t.join()
+    assert drained.is_set()
+    assert tid == 0 and isinstance(vals, dict) and vals
+    assert svc.scheduler.shed_count >= 1
+    svc.shutdown()
+
+
+def test_overloaded_backoff_escalates_to_deadline_expired():
+    """No drain ever comes: the bounded retry must escalate with the
+    typed DeadlineExpired at (not after) the client deadline -- never
+    a stuck full-timeout hang."""
+    svc = _tiny_service(max_queue=1)
+    a = svc.create_study("a", seed=1)
+    b = svc.create_study("b", seed=2)
+    a.ask_async()
+    t0 = time.perf_counter()
+    with pytest.raises(DeadlineExpired):
+        b.ask(timeout=0.3, backoff=True)
+    assert time.perf_counter() - t0 < 5.0  # escalated, did not hang
+    svc.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine-arg validation
+# ---------------------------------------------------------------------------
+
+
+def test_unmappable_algos_are_refused_loudly():
+    from hyperopt_tpu import tpe
+
+    with pytest.raises(ValueError, match="cannot route"):
+        resolve_engine_algo(tpe.suggest)
+    with pytest.raises(ValueError, match="speculative"):
+        resolve_engine_algo(partial(tpe_jax.suggest, speculative=8))
+    with pytest.raises(ValueError, match="joint_ei"):
+        resolve_engine_algo(partial(tpe_jax.suggest, joint_ei=True))
+    with pytest.raises(ValueError, match="max_queue_len"):
+        run_fmin(tpe_jax.suggest, n=2, engine=True, max_queue_len=4)
+
+
+def test_legacy_checkpoint_file_is_refused(tmp_path):
+    legacy = tmp_path / "ckpt.pkl"
+    legacy.write_bytes(b"not a study root")
+    with pytest.raises(CheckpointError, match="DIRECTORY"):
+        run_fmin(
+            tpe_jax.suggest, n=2, engine=True,
+            trials_save_file=str(legacy),
+        )
+
+
+# ---------------------------------------------------------------------------
+# unified durability: resume, crash points, fail records, fsck
+# ---------------------------------------------------------------------------
+
+
+def _client_service(root, fs, k=1):
+    return SuggestService(
+        SPACE, root=root, fs=fs, background=False, max_batch=1,
+        n_startup_jobs=3, snapshot_cadence=4, finite_check=False,
+        study_queue_cap=max(2, k), max_queue=max(8, 2 * k),
+        n_cand=16, n_cand_cat=8,
+    )
+
+
+CLIENT_ALGO = partial(tpe_jax.suggest, **TPE_KW)
+N_CHAOS = 14
+
+
+def _chaos_reference():
+    return solo_reference(
+        "chaos-ref",
+        partial(tpe_jax.suggest, fused=True, **TPE_KW),
+        n=N_CHAOS, seed=3,
+    )
+
+
+@pytest.mark.parametrize("point", SERVE_CRASH_POINTS)
+@pytest.mark.parametrize("depth", [1, 3])
+def test_kill_and_resume_at_serve_crash_points(tmp_path, point, depth):
+    """Kill the client at every serve crash point (tell durable but
+    unapplied / batch assembled / dispatched-unacked), resume over the
+    same root: the finished stream is bitwise the uninterrupted solo
+    run's, with zero lost and zero duplicate tells -- the PR-6 driver
+    guarantees through the unified serve WAL."""
+    ref = _chaos_reference()
+    root = str(tmp_path / f"{point}-{depth}")
+    plan = FaultPlan(seed=11)
+    plan.arm(point, at=5)
+    svc = _client_service(root, plan.fs(), k=depth)
+    n_crashes = 0
+    try:
+        run_fmin(CLIENT_ALGO, n=N_CHAOS, seed=3, engine=svc,
+                 ask_ahead=depth)
+    except SimulatedCrash:
+        n_crashes += 1
+    assert n_crashes == 1, f"{point} never fired"
+    # "restart the process": a fresh service over the same root
+    svc2 = _client_service(root, FaultPlan(seed=12).fs(), k=depth)
+    trials = run_fmin(CLIENT_ALGO, n=N_CHAOS, seed=3, engine=svc2,
+                      ask_ahead=depth)
+    got = stream(trials)
+    assert got == ref, f"resume after {point} diverged"
+    tids = [t[0] for t in got]
+    assert tids == sorted(set(tids)), "duplicate or lost tids"
+
+
+def test_resume_from_missing_root_is_refused(tmp_path):
+    with pytest.raises(CheckpointError, match="no .* study artifacts"):
+        run_fmin(
+            CLIENT_ALGO, n=4, engine=True,
+            resume_from=str(tmp_path / "nowhere"),
+        )
+
+
+def test_durable_failures_never_rerun_on_resume(tmp_path):
+    """A catch=-contained failure is WAL-durable (a ``fail`` record):
+    the resumed run restores the STATUS_FAIL doc and does not
+    re-evaluate that tid."""
+    root = str(tmp_path / "fails")
+    calls = []
+
+    def flaky(cfg):
+        calls.append(dict(cfg))
+        if len(calls) == 5:
+            raise ValueError("boom at call 5")
+        return objective(cfg)
+
+    t1 = run_fmin(
+        CLIENT_ALGO, n=10, seed=3, obj=flaky, catch=(ValueError,),
+        engine=True, trials_save_file=root,
+    )
+    fail_docs = [
+        t for t in t1._dynamic_trials
+        if t["result"].get("status") == STATUS_FAIL
+    ]
+    assert len(fail_docs) == 1
+    calls_before = len(calls)
+    # extend the run from the same root: restored docs (including the
+    # failed one) must not be re-evaluated
+    t2 = run_fmin(
+        CLIENT_ALGO, n=14, seed=0, obj=flaky, catch=(ValueError,),
+        engine=True, resume_from=root,
+    )
+    assert len(calls) == calls_before + 4  # only the 4 new trials ran
+    assert stream(t1) == stream(t2)[: len(stream(t1))]
+    restored_fail = [
+        t for t in t2._dynamic_trials
+        if t["result"].get("status") == STATUS_FAIL
+    ]
+    assert len(restored_fail) == 1
+    assert restored_fail[0]["tid"] == fail_docs[0]["tid"]
+
+
+def test_unified_layout_and_fsck_serve_role(tmp_path):
+    """The client root IS a serve study root: one WAL + snapshot
+    family under the study name, clean under ``fsck --serve``."""
+    from hyperopt_tpu.distributed import fsck
+
+    root = str(tmp_path / "layout")
+    run_fmin(CLIENT_ALGO, n=8, seed=3, engine=True,
+             trials_save_file=root)
+    names = sorted(os.listdir(root))
+    assert f"{CLIENT_STUDY}.snap" in names
+    assert f"{CLIENT_STUDY}.wal" in names
+    rc = fsck.main(["--serve", root])
+    assert rc == 0
+
+
+def test_points_to_evaluate_ride_the_client_path():
+    pts = [{"x": 1.0, "lr": 0.1, "q": 4.0, "c": 1}]
+    ref = stream(run_fmin(
+        partial(tpe_jax.suggest, fused=True, **TPE_KW), n=10,
+        points_to_evaluate=pts,
+    ))
+    got = stream(run_fmin(
+        CLIENT_ALGO, n=10, engine=True, points_to_evaluate=pts,
+    ))
+    assert got == ref
+    assert got[0][3]["x"] == [1.0]
+
+
+# ---------------------------------------------------------------------------
+# graftscope: client-path spans
+# ---------------------------------------------------------------------------
+
+
+def test_driver_trial_spans_carry_client_study_id():
+    """driver.trial spans on the client path carry the study id, and
+    the serve-side ask/tell spans of the SAME recorder carry it too --
+    one correlated trace, end to end."""
+    from hyperopt_tpu.obs import FlightRecorder
+
+    rec = FlightRecorder(capacity=4096)
+    run_fmin(CLIENT_ALGO, n=6, engine=True, recorder=rec)
+    spans = rec.tail()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    assert {"driver.trial", "ask.delivered", "tell"} <= set(by_name)
+    for name in ("driver.trial", "ask.delivered", "tell"):
+        assert all(
+            s.get("study") == CLIENT_STUDY for s in by_name[name]
+        ), f"{name} spans lost the client study id"
+    # correlation: every driver.trial tid has its ask.delivered twin
+    trial_tids = {s["tid"] for s in by_name["driver.trial"]}
+    ask_tids = {s["tid"] for s in by_name["ask.delivered"]}
+    assert trial_tids <= ask_tids
