@@ -844,6 +844,129 @@ def bench_fleet(space, n_replicas=3, n_studies=12, rounds=3, n_cand=128):
     }
 
 
+def bench_pilot(space, n_studies=12, rounds=3, n_cand=128):
+    """graftpilot rows (round 21): the self-driving fleet.
+
+    ``pilot_scale_out_ms`` / ``pilot_scale_in_ms``: wall-clock of one
+    pilot-driven membership actuation -- ``add_replica`` with live
+    study migration on the way out, drain + retire on the way in --
+    as timed by the controller's own gauges.
+    ``fleet_studies_per_sec_autoscaled``: asks served per second
+    while the fleet runs UNDER the control loop -- each wave is
+    submitted async so the pilot's scrape (the same
+    ``fleet.metrics_rows`` a /metrics poller reads; no side channel)
+    sees the real queue before the wave is pumped.  The 10^4-study
+    autoscaled soak in ``tests/test_fleet_chaos.py`` is this at full
+    scale.  ``replay_fidelity``: the flight log recorded during that
+    traffic, replayed through the graftreplay harness against a fresh
+    solo service, reproduces every suggestion stream bitwise (1.0 on
+    hash match -- the record-once-replay-bitwise contract).
+    """
+    import shutil
+    import tempfile
+
+    from hyperopt_tpu.obs.flightrec import FlightRecorder
+    from hyperopt_tpu.serve import (
+        Fleet,
+        FleetPilot,
+        FleetRouter,
+        PilotConfig,
+        SuggestService,
+    )
+    from hyperopt_tpu.serve.replay import (
+        ServiceTarget,
+        load_workload,
+        replay_fidelity,
+        replay_workload,
+    )
+
+    def loss(vals):
+        return sum(
+            float(v) for v in vals.values() if isinstance(v, (int, float))
+        )
+
+    root = tempfile.mkdtemp(prefix="bench-pilot-")
+    log = os.path.join(root, "flight.jsonl")
+    try:
+        recorder = FlightRecorder(path=log)
+        fleet = Fleet(
+            space, root, replica_ids=["r0", "r1"], max_batch=16,
+            n_startup_jobs=3, n_cand=n_cand, snapshot_cadence=64,
+            recorder=recorder,
+        )
+        router = FleetRouter(fleet)
+        pilot = FleetPilot(fleet, config=PilotConfig(
+            min_replicas=1, max_replicas=3, shed_high=0,
+            queue_high=max(2.0, n_studies / 2), breach_ticks=1,
+            clear_ticks=1, cooldown_ticks=0,
+        ))
+        names = [f"a{i:03d}" for i in range(n_studies)]
+        recorded = {n: [] for n in names}
+        for i, n in enumerate(names):
+            router.create_study(n, seed=i)
+        served = 0
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            by_rep = {}
+            for n in names:
+                by_rep.setdefault(fleet.route(n), []).append(n)
+            futs = {}
+            for rid, group in by_rep.items():
+                rep = fleet.replicas[rid]
+                for n in group:
+                    futs[n] = (rid, rep.ask_async(n))
+            pilot.tick()  # the scrape sees the queued wave
+            got, shed = {}, []
+            for rid in {r for r, _ in futs.values()}:
+                group = [
+                    (n, f) for n, (r2, f) in futs.items() if r2 == rid
+                ]
+                fleet.replicas[rid].pump_until(
+                    [f for _, f in group], timeout=120
+                )
+                for n, f in group:
+                    try:
+                        got[n] = f.result(timeout=0)
+                    except ValueError:
+                        # shed by the pilot's mid-wave migration: the
+                        # WAL-logged seed re-serves identically
+                        shed.append(n)
+            for n in shed:
+                got[n] = router.ask(n, timeout=120, recover=True)
+            for n in names:
+                tid, vals = got[n]
+                router.tell(n, tid, loss(vals), vals=vals)
+                recorded[n].append((int(tid), dict(vals)))
+                served += 1
+        dt = time.perf_counter() - t0
+        # the quiet tail: the pilot shrinks the fleet back down
+        for _ in range(4):
+            pilot.tick()
+        prow = {
+            r["name"]: r for r in pilot.metrics_rows()
+            if not r.get("labels")
+        }
+        out_ms = prow["pilot_scale_out_ms"]["value"]
+        in_ms = prow["pilot_scale_in_ms"]["value"]
+        fleet.shutdown()
+        recorder.flush()
+        target = ServiceTarget(SuggestService(
+            space, background=False, max_batch=16, n_startup_jobs=3,
+            n_cand=n_cand,
+        ))
+        replayed = replay_workload(load_workload(log), target, timeout=120)
+        target.service.shutdown()
+        fidelity = replay_fidelity(recorded, replayed)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return {
+        "pilot_scale_out_ms": round(float(out_ms), 3),
+        "pilot_scale_in_ms": round(float(in_ms), 3),
+        "fleet_studies_per_sec_autoscaled": round(served / dt, 1),
+        "replay_fidelity": fidelity,
+    }
+
+
 def bench_obs(space, n_cand=128, n_startup_jobs=3, n_studies=8,
               rounds=12):
     """graftscope rows (round 19): what observability costs, measured.
@@ -1424,6 +1547,14 @@ def main():
         n_replicas=int(os.environ.get("BENCH_FLEET_REPLICAS", "3")),
         n_cand=n_cand,
     )
+    # round-21 graftpilot rows: the self-driving fleet -- actuation
+    # latencies of pilot-driven scale-out/scale-in, throughput under
+    # the control loop, and the record-once-replay-bitwise fidelity
+    pilot_rows = bench_pilot(
+        space,
+        n_studies=int(os.environ.get("BENCH_PILOT_STUDIES", "12")),
+        n_cand=n_cand,
+    )
     # round-17 graftmesh rows: the study-sharded serve engine and the
     # shard_map PBT schedule per mesh shape (virtual CPU devices here;
     # the MULTICHIP dryrun runs the same programs on real meshes)
@@ -1542,6 +1673,7 @@ def main():
                 # replicas behind the consistent-hash router --
                 # aggregate studies/sec, failover-window p99, recovery
                 **fleet_rows,
+                **pilot_rows,
                 # round-19 graftscope rows (bench_obs): tracing-armed
                 # overhead fractions, span throughput, and the
                 # fleet-wide /metrics scrape latency

@@ -52,7 +52,7 @@ __all__ = [
     "DeviceFaultPlan",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
     "DEVICE_LOOP_CRASH_POINTS", "FLEET_CRASH_POINTS",
-    "OBS_CRASH_POINTS", "ALL_CRASH_POINTS",
+    "OBS_CRASH_POINTS", "PILOT_CRASH_POINTS", "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -170,9 +170,39 @@ OBS_CRASH_POINTS = (
     "obs_flight_export_mid_append",
 )
 
+#: crash points of the graftpilot autoscaler (hyperopt_tpu/serve/
+#: pilot.py): the controller is just another process that can die, and
+#: both windows must leave the fleet in a state the ordinary heal
+#: paths repair.  tests/test_pilot_chaos.py iterates these::
+#:
+#:     pilot_after_decision_before_actuate     the decision span is
+#:                                             recorded, no fleet
+#:                                             primitive has run -- a
+#:                                             restarted pilot simply
+#:                                             re-scrapes and re-decides
+#:                                             (decisions are stateless
+#:                                             functions of the metrics)
+#:     pilot_mid_scale_out                     fired on the FLEET's
+#:                                             plan inside
+#:                                             ``add_replica``'s
+#:                                             migration loop: the ring
+#:                                             already includes the new
+#:                                             replica but only some
+#:                                             remapped studies moved --
+#:                                             the rest heal via the
+#:                                             lazy-adoption path
+#:                                             (``create_study(
+#:                                             takeover=True)`` on first
+#:                                             routed request)
+PILOT_CRASH_POINTS = (
+    "pilot_after_decision_before_actuate",
+    "pilot_mid_scale_out",
+)
+
 ALL_CRASH_POINTS = (
     CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
     + DEVICE_LOOP_CRASH_POINTS + FLEET_CRASH_POINTS + OBS_CRASH_POINTS
+    + PILOT_CRASH_POINTS
 )
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError

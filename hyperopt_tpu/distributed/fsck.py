@@ -247,12 +247,36 @@ def repair(root, issues, fs=REAL_FS):
 # ---------------------------------------------------------------------------
 
 
-def audit_serve(root, fs=REAL_FS, tmp_grace=60.0):
+def audit_serve(root, fs=REAL_FS, tmp_grace=60.0, claim_grace=None,
+                live_owners=None):
     """Audit a serve study root: one ``<name>.wal`` / ``<name>.snap``
     / ``<name>.claim`` family per study, every crash mode a killed or
     failed-over replica can leave.  Returns the list of
     :class:`Issue` (kinds shared with :func:`audit_driver`, plus
-    ``claim_orphaned``)."""
+    ``claim_orphaned`` and the cross-host kinds below).
+
+    Cross-host checks (graftpilot): a shared NFS-style root is written
+    by replicas on MANY hosts, so fsck must also catch the residue one
+    host's crash leaves for another to trip over:
+
+    * ``claim_stale_foreign`` -- a LIVE claim held by an owner not in
+      ``live_owners`` (the operator-supplied set of replica ids that
+      are actually up).  Only checked when ``live_owners`` is given;
+      ``claim_grace`` (seconds) additionally requires the claim file
+      to be at least that old before it counts, absorbing another
+      host's skewed clock mid-handoff.  Repair tombstones the claim
+      with a monotone epoch bump so any survivor can adopt without
+      ``takeover``.
+    * ``study_half_migrated`` -- a handoff-marked tombstone whose
+      study was never adopted (the source released mid-migration and
+      the coordinator died before the target restored).  The
+      artifacts restore in place; repair clears the marker.
+    * ``wal_snap_divergent`` -- the snapshot bundle counts more tells
+      than the WAL has ever logged (``base_tells`` + records): the
+      log was replaced or rolled back relative to the bundle by a
+      host that had not seen its history.  Repair quarantines the
+      WAL; the bundle holds the superset.
+    """
     import pickle
 
     from ..exceptions import CheckpointError
@@ -287,11 +311,16 @@ def audit_serve(root, fs=REAL_FS, tmp_grace=60.0):
         kinds = families[fam]
         base = os.path.join(root, fam)
         wal_guard = None
+        wal_total = None
         if ".wal" in kinds:
             wal = TellWAL(base + ".wal", fs=fs)
             try:
-                header, _records, _good, torn = wal.scan()
+                header, records, _good, torn = wal.scan()
                 wal_guard = (header or {}).get("guard")
+                wal_total = (
+                    int((header or {}).get("base_tells", 0))
+                    + sum(1 for r in records if r.get("kind") == "tell")
+                )
                 if torn:
                     issues.append(Issue(
                         "wal_torn_tail", wal.path, f"{torn} torn byte(s)"
@@ -301,9 +330,13 @@ def audit_serve(root, fs=REAL_FS, tmp_grace=60.0):
         if ".snap" in kinds:
             snap = base + ".snap"
             snap_guard = None
+            snap_total = None
             try:
                 with fs.open(snap, "rb") as f:
-                    snap_guard = pickle.loads(f.read()).get("guard")
+                    bundle = pickle.loads(f.read())
+                snap_guard = bundle.get("guard")
+                if bundle.get("total_tells") is not None:
+                    snap_total = int(bundle["total_tells"])
             except Exception:  # graftlint: disable=GL302 an unreadable bundle is reported as an issue, not retried
                 issues.append(Issue(
                     "ckpt_fingerprint_mismatch", snap, "bundle unreadable"
@@ -318,27 +351,97 @@ def audit_serve(root, fs=REAL_FS, tmp_grace=60.0):
                     f"bundle guard {snap_guard!r} != WAL guard "
                     f"{wal_guard!r}",
                 ))
+            elif (
+                snap_total is not None
+                and wal_total is not None
+                and snap_total > wal_total
+            ):
+                issues.append(Issue(
+                    "wal_snap_divergent", base + ".wal",
+                    f"snapshot counts {snap_total} tell(s) but the WAL "
+                    f"has only ever logged {wal_total} -- the log was "
+                    "replaced or rolled back relative to the bundle",
+                ))
         if kinds == {".claim"}:
             issues.append(Issue(
                 "claim_orphaned", base + ".claim",
                 "claim token with no WAL or snapshot",
             ))
+            continue
+        if ".claim" in kinds:
+            doc = _valid_doc(base + ".claim", fs)
+            if (
+                doc is not None
+                and not doc.get("released")
+                and live_owners is not None
+                and doc.get("replica") not in set(live_owners)
+            ):
+                try:
+                    age = now - fs.getmtime(base + ".claim")
+                except OSError:
+                    age = None
+                if claim_grace is None or age is None or age >= claim_grace:
+                    issues.append(Issue(
+                        "claim_stale_foreign", base + ".claim",
+                        f"held by {doc.get('replica')!r} (epoch "
+                        f"{doc.get('epoch')}), not in the live owner set",
+                    ))
+            if (
+                doc is not None
+                and doc.get("released")
+                and doc.get("handoff")
+            ):
+                issues.append(Issue(
+                    "study_half_migrated", base + ".claim",
+                    f"handoff tombstone (epoch {doc.get('epoch')}) "
+                    "never adopted: the source released, no owner "
+                    "restored",
+                ))
     return issues
+
+
+def _republish_tombstone(path, fs):
+    """Overwrite a claim file with a released tombstone, epoch bumped
+    past whatever is on disk (the fsck repair for stale foreign claims
+    and unacknowledged handoffs): monotone for every observer, and any
+    survivor can then adopt the study without ``takeover``."""
+    doc = _valid_doc(path, fs) or {}
+    body = {
+        "replica": None, "token": None,
+        "epoch": int(doc.get("epoch", -1)) + 1, "released": True,
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with fs.open(tmp, "w") as f:
+        f.write(json.dumps(body, sort_keys=True))
+        fs.fsync(f)
+    fs.rename(tmp, path)
 
 
 def repair_serve(root, issues, fs=REAL_FS):
     """Fix every repairable serve-root :class:`Issue`; returns the
     repaired count.  Family kinds delegate to :func:`repair_driver`
     (truncate / quarantine / unlink are path-local); orphaned claims
-    are unlinked -- nothing references them."""
+    are unlinked -- nothing references them.  Cross-host kinds: stale
+    foreign claims and half-migrated handoffs are tombstoned with a
+    monotone epoch bump (never unlinked -- the epoch history is the
+    fence); a divergent WAL is quarantined, its bundle holds the
+    superset history."""
     repaired = 0
     rest = []
     for issue in issues:
-        if issue.kind != "claim_orphaned":
-            rest.append(issue)
-            continue
         try:
-            fs.unlink(issue.path)
+            if issue.kind == "claim_orphaned":
+                fs.unlink(issue.path)
+            elif issue.kind in ("claim_stale_foreign",
+                                "study_half_migrated"):
+                _republish_tombstone(issue.path, fs)
+            elif issue.kind == "wal_snap_divergent":
+                dst = f"{issue.path}.quarantined.{os.getpid()}"
+                fs.rename(issue.path, dst)
+                logger.warning("quarantined %s -> %s", issue.path, dst)
+            else:
+                rest.append(issue)
+                continue
             repaired += 1
         except FileNotFoundError:
             repaired += 1
@@ -517,6 +620,19 @@ def main(argv=None):
         "--tmp-grace", type=float, default=60.0,
         help="tmp-file age that counts as stale (seconds)",
     )
+    parser.add_argument(
+        "--live-owner", action="append", metavar="RID",
+        help="(--serve) a replica id known to be up (repeatable); "
+        "enables the cross-host claim_stale_foreign check -- a live "
+        "claim held by any OTHER owner is reported and, under "
+        "--repair, tombstoned with a monotone epoch bump",
+    )
+    parser.add_argument(
+        "--claim-grace", type=float, default=None,
+        help="(--serve) minimum claim-file age (seconds) before a "
+        "foreign claim counts as stale -- absorbs another host's "
+        "skewed clock mid-handoff; default: no age requirement",
+    )
     parser.add_argument("-v", "--verbose", action="count", default=0)
     options = parser.parse_args(argv)
     logging.basicConfig(
@@ -541,7 +657,9 @@ def main(argv=None):
     elif options.serve:
         target = options.serve
         do_audit = lambda: audit_serve(  # noqa: E731
-            options.serve, tmp_grace=options.tmp_grace
+            options.serve, tmp_grace=options.tmp_grace,
+            claim_grace=options.claim_grace,
+            live_owners=options.live_owner,
         )
         do_repair = lambda issues: repair_serve(  # noqa: E731
             options.serve, issues

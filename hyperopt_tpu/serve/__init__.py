@@ -31,6 +31,9 @@ __all__ = [
     "StudyHandle", "SuggestService",
     # graftfleet: the horizontal tier above one service
     "Fleet", "FleetRouter", "HashRing", "StudyClaim",
+    # graftpilot: the metric-driven autoscaler + traffic replay
+    "FleetPilot", "PilotConfig",
+    "extract_workload", "replay_flight_log", "stream_hash",
 ]
 
 _HOMES = {
@@ -40,6 +43,11 @@ _HOMES = {
     "StudyClaim": "fleet",
     "FleetRouter": "router",
     "HashRing": "router",
+    "FleetPilot": "pilot",
+    "PilotConfig": "pilot",
+    "extract_workload": "replay",
+    "replay_flight_log": "replay",
+    "stream_hash": "replay",
 }
 
 

@@ -44,6 +44,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import threading
 import time
 import uuid
 
@@ -184,17 +185,27 @@ class StudyClaim:
                 "double-serving"
             )
 
-    def release(self):
+    def release(self, handoff=False):
         """Tombstone the claim (epoch bumped, monotone) -- the planned
         handoff half of migration.  A crashed owner never releases;
-        its successor takes over with ``acquire(takeover=True)``."""
+        its successor takes over with ``acquire(takeover=True)``.
+
+        ``handoff=True`` marks the tombstone as the SOURCE half of a
+        migration: the releasing replica expects a new owner to adopt
+        next.  The next ``acquire`` (adoption) overwrites the marker;
+        a marker still on disk is therefore a study stranded between
+        handoff and restore -- the ``study_half_migrated`` artifact
+        ``hyperopt-tpu-fsck --serve`` reports on cross-host audits."""
         if not self.is_live():
             return  # taken over already; nothing of ours to release
         self.epoch += 1
-        self._publish({
+        doc = {
             "replica": None, "token": None,
             "epoch": self.epoch, "released": True,
-        })
+        }
+        if handoff:
+            doc["handoff"] = True
+        self._publish(doc)
 
 
 class Replica:
@@ -312,6 +323,15 @@ class Fleet:
         self.replicas = {}
         self.registry = set()  # studies created through the router
         self._moved = {}  # name -> rid: migration repoints ahead of ring
+        # membership lock: scale-out, scale-in, and failover all move
+        # claims, and two of them interleaving (the autoscaler racing
+        # the router's failure handling) could double-adopt a study or
+        # strand it between owners.  Every membership mutation runs
+        # under this single RLock, so racing paths serialize and each
+        # sees the other's completed placement -- the claim-epoch fence
+        # below stays the cross-process guarantee, this lock is the
+        # in-process one.
+        self._mlock = threading.RLock()
         plans = plans or {}
         for rid in replica_ids or [f"r{i}" for i in range(n_replicas)]:
             plan = plans.get(rid)
@@ -325,43 +345,60 @@ class Fleet:
         replacement), the registered studies whose ring owner becomes
         the new replica are handed over via the drain-migrate protocol
         BEFORE the ring flips -- adding a node moves ~1/N of the keys
-        and nothing else."""
-        rid = str(rid)
-        if rid in self.replicas:
-            raise ValueError(f"replica {rid!r} already in the fleet")
-        service = SuggestService(
-            self.space, algo=self.algo, root=self.root,
-            fs=fs if fs is not None else REAL_FS, owner=rid,
-            background=False, **self.service_kw,
-        )
-        replica = Replica(rid, service)
-        before = (
-            self.ring.placement(self.registry)
-            if migrate and self.registry else {}
-        )
-        self.replicas[rid] = replica
-        self.ring.add(rid)
-        if before:
-            after = self.ring.placement(self.registry)
-            for name in sorted(self.registry):
-                if after[name] == rid and before[name] != rid:
-                    self.migrate_study(name, rid, src_rid=before[name])
-        return replica
+        and nothing else.
+
+        Crash window (``pilot_mid_scale_out``, armed on the FLEET
+        plan): the coordinator dies after the first remapped study
+        moved -- the ring already includes the new replica, the rest
+        of the remapped keys have not.  Recovery is the ordinary lazy-
+        adoption path: the new ring owner adopts each stranded study
+        with ``create_study(takeover=True)`` on its first routed
+        request; re-running ``add_replica`` is NOT the heal (the rid is
+        already a member and is refused)."""
+        with self._mlock:
+            rid = str(rid)
+            if rid in self.replicas:
+                raise ValueError(f"replica {rid!r} already in the fleet")
+            service = SuggestService(
+                self.space, algo=self.algo, root=self.root,
+                fs=fs if fs is not None else REAL_FS, owner=rid,
+                background=False, **self.service_kw,
+            )
+            replica = Replica(rid, service)
+            before = (
+                self.ring.placement(self.registry)
+                if migrate and self.registry else {}
+            )
+            self.replicas[rid] = replica
+            self.ring.add(rid)
+            if before:
+                after = self.ring.placement(self.registry)
+                moved = 0
+                for name in sorted(self.registry):
+                    if after[name] == rid and before[name] != rid:
+                        self.migrate_study(name, rid, src_rid=before[name])
+                        moved += 1
+                        if moved == 1:
+                            self.fs.crashpoint("pilot_mid_scale_out")
+            return replica
 
     def register(self, name):
-        self.registry.add(name)
+        with self._mlock:
+            self.registry.add(name)
 
     def unregister(self, name):
-        self.registry.discard(name)
-        self._moved.pop(name, None)
+        with self._mlock:
+            self.registry.discard(name)
+            self._moved.pop(name, None)
 
     def route(self, name):
         """The replica currently serving ``name``: a migration
         override when one is pending, else the ring owner."""
-        rid = self._moved.get(name)
-        if rid is not None and rid in self.ring.nodes:
-            return rid
-        return self.ring.owner(name)
+        with self._mlock:
+            rid = self._moved.get(name)
+            if rid is not None and rid in self.ring.nodes:
+                return rid
+            return self.ring.owner(name)
 
     # -- failure handling --------------------------------------------------
     def mark_dead(self, rid):
@@ -388,29 +425,32 @@ class Fleet:
         """Re-materialize a dead replica's studies on ring survivors
         from their WAL+bundle pairs (tid-dedup exactly-once replay,
         claim epochs bumped).  Idempotent; returns the moved names."""
-        if rid not in self.ring.nodes:
-            return []
-        t0 = time.perf_counter()
-        owned = [n for n in sorted(self.registry) if self.route(n) == rid]
-        self.ring.remove(rid)
-        self._moved = {
-            n: r for n, r in self._moved.items() if r != rid
-        }
-        for name in owned:
-            new_rid = self.ring.owner(name)
-            self.replicas[new_rid].open_study(name, takeover=True)
-            logger.info(
-                "failover: study %r re-materialized on %r (was %r)",
-                name, new_rid, rid,
-            )
-        self.metrics.gauge(
-            "fleet_recovery_ms",
-            "last failover's study re-materialization time",
-        ).set_duration_ms(t0)
-        self.metrics.counter(
-            "fleet_failovers_total", "replica failovers executed"
-        ).inc()
-        return owned
+        with self._mlock:
+            if rid not in self.ring.nodes:
+                return []
+            t0 = time.perf_counter()
+            owned = [
+                n for n in sorted(self.registry) if self.route(n) == rid
+            ]
+            self.ring.remove(rid)
+            self._moved = {
+                n: r for n, r in self._moved.items() if r != rid
+            }
+            for name in owned:
+                new_rid = self.ring.owner(name)
+                self.replicas[new_rid].open_study(name, takeover=True)
+                logger.info(
+                    "failover: study %r re-materialized on %r (was %r)",
+                    name, new_rid, rid,
+                )
+            self.metrics.gauge(
+                "fleet_recovery_ms",
+                "last failover's study re-materialization time",
+            ).set_duration_ms(t0)
+            self.metrics.counter(
+                "fleet_failovers_total", "replica failovers executed"
+            ).inc()
+            return owned
 
     # -- planned migration (the drain protocol) ----------------------------
     def migrate_study(self, name, dst_rid, src_rid=None):
@@ -420,15 +460,16 @@ class Fleet:
         handoff when the source already released the study (the
         ``after_handoff_before_restore`` window) and the restore when
         the target already adopted it."""
-        src_rid = src_rid if src_rid is not None else self.route(name)
-        if src_rid == dst_rid:
-            return
-        src = self.replicas[src_rid]
-        if not src.dead and name in src.service.studies():
-            src.service.handoff_study(name)
-        self.fs.crashpoint("fleet_migrate_after_handoff_before_restore")
-        self.replicas[dst_rid].open_study(name, takeover=True)
-        self._moved[name] = dst_rid
+        with self._mlock:
+            src_rid = src_rid if src_rid is not None else self.route(name)
+            if src_rid == dst_rid:
+                return
+            src = self.replicas[src_rid]
+            if not src.dead and name in src.service.studies():
+                src.service.handoff_study(name)
+            self.fs.crashpoint("fleet_migrate_after_handoff_before_restore")
+            self.replicas[dst_rid].open_study(name, takeover=True)
+            self._moved[name] = dst_rid
 
     def begin_drain(self, rid, timeout=30.0):
         """Mark the replica draining: new asks are refused with
@@ -439,20 +480,23 @@ class Fleet:
     def complete_drain(self, rid):
         """Migrate every owned study to its ring successor, flip the
         ring, shut the replica down.  Returns the migrated names."""
-        replica = self.replicas[rid]
-        owned = [n for n in sorted(self.registry) if self.route(n) == rid]
-        for name in owned:
-            dst = self.ring.owner(name, exclude={rid})
-            self.migrate_study(name, dst, src_rid=rid)
-        self.ring.remove(rid)
-        self._moved = {
-            n: r for n, r in self._moved.items()
-            if n in self.registry and self.ring.owner(n) != r
-        }
-        replica.service.shutdown()
-        replica.dead = True
-        del self.replicas[rid]
-        return owned
+        with self._mlock:
+            replica = self.replicas[rid]
+            owned = [
+                n for n in sorted(self.registry) if self.route(n) == rid
+            ]
+            for name in owned:
+                dst = self.ring.owner(name, exclude={rid})
+                self.migrate_study(name, dst, src_rid=rid)
+            self.ring.remove(rid)
+            self._moved = {
+                n: r for n, r in self._moved.items()
+                if n in self.registry and self.ring.owner(n) != r
+            }
+            replica.service.shutdown()
+            replica.dead = True
+            del self.replicas[rid]
+            return owned
 
     def drain_replica(self, rid, timeout=30.0):
         """The full rolling-restart step for one replica."""

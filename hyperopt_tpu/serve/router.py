@@ -263,7 +263,7 @@ class RouterServer:
     """
 
     def __init__(self, backends, salt="", vnodes=64,
-                 probe_timeout=5.0):
+                 probe_timeout=5.0, probe_backoff_cap=8):
         self.backends = {b.rid: b for b in backends}
         self.ring = HashRing(self.backends, salt=salt, vnodes=vnodes)
         self._lock = threading.Lock()
@@ -287,7 +287,19 @@ class RouterServer:
             "router_backend_rejoins_total",
             "dead backends revived by a succeeding probe",
         )
+        self._probes_total = self.metrics.counter(
+            "router_probes_total",
+            "health probes attempted (skips under backoff excluded)",
+        )
         self.probe_timeout = float(probe_timeout)
+        # exponential probe backoff (graftpilot satellite): after the
+        # f-th consecutive failure the next min(2**(f-1), cap) sweeps
+        # skip the backend, so it is re-probed on sweeps 0, 2, 5, 10,
+        # 19, 28, ... -- a long-dead host is not hammered every
+        # interval; any success resets the schedule
+        self.probe_backoff_cap = int(probe_backoff_cap)
+        self._probe_fails = {}  # rid -> consecutive probe failures
+        self._probe_wait = {}  # rid -> sweeps left before the next try
         self._probe_conns = {}  # the probe loop's OWN connection cache
         self._probe_thread = None
         self._probing = False
@@ -459,8 +471,21 @@ class RouterServer:
         client ask eats its connection failure; a dead backend whose
         probe succeeds again rejoins the ring (its studies were adopted
         elsewhere -- the lazy-adoption path hands them back request by
-        request, with no client-visible error either way)."""
+        request, with no client-visible error either way).
+
+        Persistently-down backends back off exponentially: after the
+        f-th consecutive failed probe the next ``min(2**(f-1),
+        probe_backoff_cap)`` sweeps skip the backend entirely, and any
+        successful probe resets its schedule -- so rejoin latency
+        stays bounded at ``cap`` intervals while a long-dead host
+        costs one connection attempt per cap window instead of one
+        per sweep."""
         for rid in sorted(self.backends):
+            wait = self._probe_wait.get(rid, 0)
+            if wait > 0:
+                self._probe_wait[rid] = wait - 1
+                continue
+            self._probes_total.inc()
             t0 = time.perf_counter()
             try:
                 reply = self._rpc(
@@ -473,6 +498,8 @@ class RouterServer:
                 ok = False
             self._probe_hist.observe_since(t0)
             if ok:
+                self._probe_fails.pop(rid, None)
+                self._probe_wait.pop(rid, None)
                 with self._lock:
                     rejoined = rid in self._dead
                     self._dead.discard(rid)
@@ -484,6 +511,11 @@ class RouterServer:
                     )
                 self._up_gauge.labels(backend=rid).set(1)
             else:
+                fails = self._probe_fails.get(rid, 0) + 1
+                self._probe_fails[rid] = fails
+                self._probe_wait[rid] = min(
+                    2 ** (fails - 1), self.probe_backoff_cap
+                )
                 self._probe_failures.inc()
                 already = rid in self._alive_excluded()
                 self._mark_dead(rid)
@@ -604,10 +636,19 @@ def main(argv=None):
     parser.add_argument("--port", type=int, default=7076)
     parser.add_argument(
         "--probe-interval", type=float, default=1.0,
-        help="seconds between background health probes of every "
-        "backend (graftscope: per-backend connection reuse, suspect "
-        "marking before client asks fail, probe-recovered backends "
-        "rejoin the ring); 0 disables probing",
+        help="seconds between background health-probe sweeps "
+        "(graftscope: per-backend connection reuse, suspect marking "
+        "before client asks fail, probe-recovered backends rejoin the "
+        "ring); persistently-down backends back off exponentially "
+        "inside the sweep (see --probe-backoff-cap); 0 disables "
+        "probing",
+    )
+    parser.add_argument(
+        "--probe-backoff-cap", type=int, default=8,
+        help="max sweeps skipped between probes of a persistently-"
+        "down backend (exponential backoff 1, 2, 4, ... capped here; "
+        "any successful probe resets it) -- bounds both the load on a "
+        "long-dead host and its rejoin latency",
     )
     args = parser.parse_args(argv)
 
@@ -618,7 +659,10 @@ def main(argv=None):
         if not (rid and host and port):
             raise SystemExit(f"--backend must be ID=HOST:PORT, got {spec!r}")
         backends.append(_Backend(rid, host, int(port)))
-    router = RouterServer(backends, salt=args.salt, vnodes=args.vnodes)
+    router = RouterServer(
+        backends, salt=args.salt, vnodes=args.vnodes,
+        probe_backoff_cap=args.probe_backoff_cap,
+    )
     server = router.serve_forever(host=args.host, port=args.port)
     if args.probe_interval > 0:
         router.start_probes(interval=args.probe_interval)

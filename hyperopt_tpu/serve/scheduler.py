@@ -33,6 +33,7 @@ from __future__ import annotations
 import collections
 import logging
 import math
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -326,6 +327,7 @@ class BatchScheduler:
                  finite_check=True, quarantine_trips=QUARANTINE_TRIPS,
                  circuit_threshold=CIRCUIT_THRESHOLD, mesh=None,
                  recorder=None, device_metrics_every=0,
+                 retry_jitter=0.25, retry_jitter_seed=0,
                  **algo_kw):
         # graftscope wiring first: the descriptors above resolve
         # through this registry from the first counter touch on
@@ -369,6 +371,14 @@ class BatchScheduler:
         self.dispatch_timeout = (
             None if dispatch_timeout is None else float(dispatch_timeout)
         )
+        # graftpilot satellite: a deterministic retry_after makes every
+        # shed client retry on the same tick (a thundering herd against
+        # the recovering replica), so queue-based refusals jitter the
+        # hint from a SEEDED scheduler-private rng -- bounded, and
+        # drawn only after admission already refused, so suggestion
+        # streams can never observe it
+        self.retry_jitter = float(retry_jitter)
+        self._retry_rng = random.Random(int(retry_jitter_seed))
         self.finite_check = bool(finite_check)
         self.quarantine_trips = int(quarantine_trips)
         self.circuit_threshold = int(circuit_threshold)
@@ -557,7 +567,7 @@ class BatchScheduler:
                 )
                 rec.record(
                     "tell", t0, t2, study=study.name, tid=int(tid),
-                    **self.span_ids,
+                    loss=float(loss), **self.span_ids,
                 )
             # a tell can open a study's fresh_window gate: wake the
             # background loop so the unblocked ask dispatches now
@@ -621,6 +631,18 @@ class BatchScheduler:
             lats = sorted(self.ask_latencies)
         p50 = lats[len(lats) // 2] if lats else 0.010
         return round(rounds * p50, 6)
+
+    def _jittered(self, base):
+        """Seeded, bounded jitter on a queue-based ``retry_after`` hint
+        (the reply seam): the hint lands in ``[base, base * (1 +
+        retry_jitter)]``, spreading the retry herd instead of stamping
+        every shed client with the same tick.  Draining refusals stay
+        EXACT -- their hint is the published drain deadline, monotone
+        by contract, not a congestion estimate."""
+        if self.retry_jitter <= 0.0:
+            return base
+        frac = self.retry_jitter * self._retry_rng.random()
+        return round(base * (1.0 + frac), 6)
 
     def drain_retry_after(self):
         """The CONCRETE back-off hint a ``draining`` refusal carries:
@@ -693,7 +715,8 @@ class BatchScheduler:
                     f"{self.circuit_threshold} consecutive failed "
                     "dispatch rounds; the service needs operator "
                     "attention (reset_circuit)",
-                    retry_after=self.retry_after(), reason="circuit_open",
+                    retry_after=self._jittered(self.retry_after()),
+                    reason="circuit_open",
                 )
             if deadline is not None and time.perf_counter() >= deadline:
                 self.shed_count += 1
@@ -706,7 +729,8 @@ class BatchScheduler:
                 raise Overloaded(
                     f"ask queue at high-water mark ({self.max_queue}); "
                     "back off and resubmit",
-                    retry_after=self.retry_after(), reason="queue_full",
+                    retry_after=self._jittered(self.retry_after()),
+                    reason="queue_full",
                 )
             if self._queued_per_study.get(study.name, 0) >= \
                     self.study_queue_cap:
@@ -715,7 +739,7 @@ class BatchScheduler:
                     f"study {study.name!r} already holds "
                     f"{self.study_queue_cap} queued asks (per-study "
                     "fairness cap); tell or await results first",
-                    retry_after=self.retry_after(),
+                    retry_after=self._jittered(self.retry_after()),
                     reason="study_queue_cap",
                 )
             if replay is not None:

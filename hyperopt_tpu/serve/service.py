@@ -431,7 +431,8 @@ class SuggestService:
                  fs=REAL_FS, snapshot_cadence=256, max_queue=None,
                  study_queue_cap=None, dispatch_timeout=None,
                  finite_check=True, mesh=None, owner=None, recorder=None,
-                 device_metrics_every=0, **algo_kw):
+                 device_metrics_every=0, retry_jitter=0.25,
+                 retry_jitter_seed=0, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -454,7 +455,9 @@ class SuggestService:
             study_queue_cap=study_queue_cap,
             dispatch_timeout=dispatch_timeout,
             finite_check=finite_check, mesh=mesh, recorder=recorder,
-            device_metrics_every=device_metrics_every, **algo_kw,
+            device_metrics_every=device_metrics_every,
+            retry_jitter=retry_jitter,
+            retry_jitter_seed=retry_jitter_seed, **algo_kw,
         )
         # graftscope identity: every series and span a fleet replica
         # emits carries its owner id, so the router-side merge can
@@ -530,6 +533,17 @@ class SuggestService:
             study.claim = claim
             study.host_algo = host_algo  # before open: decides slotting
             self.scheduler.open_study(name, seed, study=study)
+            if self.recorder.enabled:
+                # the replayable-workload contract (serve/replay.py):
+                # the open span carries the study's EFFECTIVE seed
+                # (restored or fresh), so a recorded span log is
+                # self-contained load -- replaying it re-creates the
+                # study with the same seed and the suggestion stream
+                # re-derives bitwise
+                self.recorder.event(
+                    "study.open", study=name, seed=int(study.seed),
+                    **self.scheduler.span_ids,
+                )
             handle = StudyHandle(self, study)
             self._handles[name] = handle
             return handle
@@ -574,7 +588,10 @@ class SuggestService:
         if study.persist is not None:
             study.persist.close()
         if study.claim is not None:
-            study.claim.release()
+            # the handoff-marked tombstone: adoption overwrites it, so
+            # a marker still on disk is a study stranded between
+            # handoff and restore (fsck --serve: study_half_migrated)
+            study.claim.release(handoff=True)
         return study
 
     def studies(self):

@@ -136,6 +136,14 @@ def test_bench_prints_one_json_line():
     assert d["fleet_ask_p99_ms_failover"] > 0
     assert d["fleet_recovery_ms"] > 0
     assert d["fleet_replicas"] == 3
+    # round-21 graftpilot rows: the autoscaler's actuation latencies
+    # really measured, aggregate throughput while the fleet runs under
+    # the control loop, and the recorded flight log replaying to
+    # bitwise-identical suggestion streams
+    assert d["pilot_scale_out_ms"] > 0
+    assert d["pilot_scale_in_ms"] > 0
+    assert d["fleet_studies_per_sec_autoscaled"] > 0
+    assert d["replay_fidelity"] == 1.0
     # round-19 graftscope rows: tracing-armed overhead fractions
     # (deterministic zero-extra-dispatch half pinned in test_obs.py;
     # these are the measured wall-clock halves), span throughput, and

@@ -443,32 +443,90 @@ def test_fleet_points_registered():
 
 @pytest.mark.slow
 def test_fleet_soak_10k_churning_studies(tmp_path):
-    """10,000+ studies churn through a 3-replica fleet in waves
-    (create -> 2 batched ask+tell rounds -> close), with one replica
-    killed mid-soak.  Asserts every wave completes exactly (zero lost
-    / zero duplicate tells per study) and stamps the fleet-aggregate
-    soak metrics the bench's ``bench_fleet`` mirrors at small scale."""
+    """10,000+ studies churn through the fleet in waves (create -> 2
+    batched ask+tell rounds -> close) UNDER THE AUTOSCALER (ISSUE 16):
+    the fleet starts at two replicas and the pilot -- fed only by the
+    scraped metrics -- grows it when wave pressure sustains, with one
+    replica killed mid-soak and the quiet tail scaled back in.
+    Asserts every wave completes exactly (zero lost / zero duplicate
+    tells per study), the pilot both scaled out and scaled in, and
+    stamps the aggregate asks/s the bench's ``bench_pilot`` mirrors at
+    small scale."""
     import time
+
+    from hyperopt_tpu.exceptions import OwnershipLost, ReplicaDead
+    from hyperopt_tpu.serve import FleetPilot, PilotConfig
 
     n_studies = 10_000
     wave_size = 18
     rounds = 2
     root = str(tmp_path / "soak")
-    # capacity headroom: a wave spreads ~evenly over 3 replicas, but
-    # after the mid-soak kill the survivors absorb the victim's share
+    # capacity headroom: start UNDER-provisioned (two replicas) -- the
+    # pilot's scale-out is what absorbs the wave pressure, and after
+    # the mid-soak kill the survivors absorb the victim's share
     kw = dict(KW, max_batch=32)
     fleet = Fleet(
-        SPACE, root, replica_ids=list(REPLICAS),
+        SPACE, root, replica_ids=["r0", "r1"],
         plans={rid: FaultPlan(seed=i) for i, rid in enumerate(REPLICAS)},
         **kw,
     )
     router = FleetRouter(fleet)
-    victim = victim_rid()
+    pilot = FleetPilot(fleet, config=PilotConfig(
+        min_replicas=2, max_replicas=4, queue_high=12.0, shed_high=0,
+        breach_ticks=2, clear_ticks=2, cooldown_ticks=2,
+    ))
+    assert pilot.scrape == fleet.metrics_rows  # no test back-channel
     kill_at_wave = 3
+    victim = None
     t0 = time.perf_counter()
     lat = []
     served = told = 0
     waves = (n_studies + wave_size - 1) // wave_size
+
+    def ask_wave_under_pressure(names):
+        """Round 1 of each wave: submit the whole wave async so the
+        pilot's scrape sees the real queue, tick the control loop
+        mid-pressure, then gather -- any study whose replica died or
+        whose queue was shed by a mid-wave migration retries through
+        the ordinary failover path with ``recover=True``."""
+        by_rep = {}
+        for n in names:
+            by_rep.setdefault(fleet.route(n), []).append(n)
+        futs, failed = {}, []
+        for rid, group in by_rep.items():
+            rep = fleet.replicas[rid]
+            if rep.dead or rep.partitioned:
+                failed.extend(group)
+                continue
+            try:
+                for n in group:
+                    futs[n] = (rid, rep.ask_async(n))
+            except (ReplicaDead, SimulatedCrash, OwnershipLost):
+                fleet.mark_dead(rid)
+                fleet.failover(rid)
+                failed.extend(n for n in group if n not in futs)
+        pilot.tick()  # the scrape sees the queued wave
+        got = {}
+        for rid in {r for r, _ in futs.values()}:
+            group = [(n, f) for n, (r2, f) in futs.items() if r2 == rid]
+            rep = fleet.replicas[rid]
+            try:
+                rep.pump_until([f for _, f in group], timeout=60)
+            except (ReplicaDead, SimulatedCrash, OwnershipLost):
+                fleet.mark_dead(rid)
+                fleet.failover(rid)
+            for n, f in group:
+                try:
+                    got[n] = f.result(timeout=0)
+                except (ValueError, ReplicaDead, SimulatedCrash,
+                        OwnershipLost):
+                    # shed by a pilot-driven migration or a dead
+                    # owner: the WAL-logged seed re-serves identically
+                    failed.append(n)
+        for n in failed:
+            got[n] = router.ask(n, timeout=60, recover=True)
+        return got
+
     for w in range(waves):
         names = [
             f"w{w:04d}x{j:02d}"
@@ -477,10 +535,14 @@ def test_fleet_soak_10k_churning_studies(tmp_path):
         for j, n in enumerate(names):
             router.create_study(n, seed=w * 100 + j)
         if w == kill_at_wave:
+            victim = fleet.route(names[0])
             fleet.kill_replica(victim)  # failover on first contact
-        for _ in range(rounds):
+        for r in range(rounds):
             t_ask = time.perf_counter()
-            got = router.ask_batch(names, timeout=60)
+            if r == 0:
+                got = ask_wave_under_pressure(names)
+            else:
+                got = router.ask_batch(names, timeout=60)
             lat.append((time.perf_counter() - t_ask) / len(names))
             for n, (tid, vals) in got.items():
                 router.tell(n, tid, loss_fn(vals), vals=vals)
@@ -491,15 +553,30 @@ def test_fleet_soak_10k_churning_studies(tmp_path):
             assert st.buf.count == rounds, (n, st.buf.count)
             assert st.persist.wal.total_tells == rounds
             router.close_study(n)
+    # the quiet tail: no queued work -> the pilot shrinks the fleet
+    for _ in range(8):
+        pilot.tick()
     dt = time.perf_counter() - t0
     assert served == told == n_studies * rounds
     assert fleet.replicas[victim].dead
     assert fleet.recovery_ms is not None
+    prows = {
+        row["name"]: row for row in pilot.metrics_rows()
+        if not row.get("labels")
+    }
+    n_out = prows["pilot_scale_outs_total"]["value"]
+    n_in = prows["pilot_scale_ins_total"]["value"]
+    assert n_out >= 1, "the soak never pressured the pilot into growing"
+    assert n_in >= 1, "the quiet tail never shrank the fleet"
+    assert any(rid.startswith("p") for rid in fleet.replicas), (
+        "no pilot-spawned replica survived to the end of the soak"
+    )
     lat_ms = sorted(1000.0 * x for x in lat)
     p99 = lat_ms[min(len(lat_ms) - 1, int(0.99 * len(lat_ms)))]
     print(
-        f"\nfleet soak: {n_studies} studies, "
+        f"\nfleet soak (autoscaled): {n_studies} studies, "
         f"{served / dt:.1f} asks/s aggregate, "
+        f"{n_out} scale-outs / {n_in} scale-ins, "
         f"p99 per-ask latency {p99:.2f} ms (incl. failover), "
         f"recovery {fleet.recovery_ms:.1f} ms"
     )

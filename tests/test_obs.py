@@ -549,7 +549,13 @@ def test_fleet_wide_scrape_probes_and_scope_cli(tmp_path, capsys):
             target=revived.serve_forever, daemon=True
         ).start()
         servers[victim] = revived
-        router.probe_backends()
+        # the dead backend accumulated probe-backoff while down, so the
+        # revival is noticed within <= probe_backoff_cap sweeps (PR-16
+        # satellite: failed probes back off exponentially, capped)
+        for _ in range(router.probe_backoff_cap + 1):
+            router.probe_backends()
+            if victim not in router._alive_excluded():
+                break
         assert victim not in router._alive_excluded()
         rows = {
             (r["name"], r["labels"].get("backend")): r

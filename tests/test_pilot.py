@@ -1,0 +1,628 @@
+"""graftpilot unit coverage (ISSUE 16): the autoscaler control loop
+(hysteresis, cooldown, bounds, the down-backend veto), the probe
+exponential-backoff schedule, jittered ``retry_after`` hints,
+cross-host claim fencing between simulated hosts, ``fsck --serve``'s
+cross-host artifact kinds, and the scale-out vs failover membership
+race.
+
+The chaos-grade scenarios (kill-during-scale under a storm, the PILOT
+crash windows, record -> replay bitwise) live in
+``tests/test_pilot_chaos.py``.
+"""
+
+import os
+import threading
+
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.distributed.faults import REAL_FS, FaultPlan
+from hyperopt_tpu.exceptions import Overloaded, OwnershipLost
+from hyperopt_tpu.serve import FleetPilot, PilotConfig, SuggestService
+from hyperopt_tpu.serve.fleet import Fleet, StudyClaim
+from hyperopt_tpu.serve.pilot import PilotSample, summarize_rows
+from hyperopt_tpu.serve.router import RouterServer, _Backend
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+KW = dict(max_batch=8, n_startup_jobs=2, snapshot_cadence=4, **ALGO_KW)
+
+
+# ---------------------------------------------------------------------------
+# satellite: probe exponential backoff (pinned schedule)
+# ---------------------------------------------------------------------------
+
+
+def _stub_router(cap=8):
+    """A RouterServer over one fake backend whose probe outcome is a
+    flag -- ``_rpc`` is stubbed so the SCHEDULE (what the satellite
+    pins) is exercised without socket noise; the socket path is
+    end-to-end covered in test_obs.py."""
+    router = RouterServer(
+        [_Backend("b0", "127.0.0.1", 1)], salt="fp",
+        probe_backoff_cap=cap,
+    )
+    state = {"ok": False}
+
+    def rpc(conns, rid, req, timeout=30.0):
+        if not state["ok"]:
+            raise ConnectionError("down")
+        return {"ok": True}
+
+    router._rpc = rpc
+    return router, state
+
+
+def test_probe_backoff_schedule_pinned():
+    """A persistently-down backend is probed on sweeps 0, 2, 5, 10,
+    19, 28, ... : after the f-th consecutive failure the next
+    ``min(2**(f-1), cap)`` sweeps skip it entirely (cap=8 -> steady
+    state one probe per 9 sweeps, never rarer)."""
+    router, _ = _stub_router(cap=8)
+    probed_on = []
+    for sweep in range(29):
+        before = router._probes_total.value
+        router.probe_backends()
+        if router._probes_total.value > before:
+            probed_on.append(sweep)
+    assert probed_on == [0, 2, 5, 10, 19, 28]
+    assert router._probe_failures.value == len(probed_on)
+    assert "b0" in router._alive_excluded()
+
+
+def test_probe_backoff_resets_on_rejoin():
+    """A single successful probe clears the whole schedule: the
+    backend rejoins within <= cap sweeps of coming back, and a LATER
+    failure starts the backoff from scratch (probed again on the very
+    next sweep, not after the old wait)."""
+    router, state = _stub_router(cap=4)
+    for _ in range(8):  # deep into backoff (fails=3, waits growing)
+        router.probe_backends()
+    assert "b0" in router._alive_excluded()
+    state["ok"] = True
+    for sweep in range(router.probe_backoff_cap + 1):
+        router.probe_backends()
+        if "b0" not in router._alive_excluded():
+            break
+    assert "b0" not in router._alive_excluded()
+    assert sweep <= router.probe_backoff_cap
+    assert router.metrics.counter(
+        "router_backend_rejoins_total"
+    ).value == 1
+    assert router._probe_fails == {} and router._probe_wait == {}
+    # fresh failure: no residual wait -- next sweep probes immediately
+    state["ok"] = False
+    before = router._probes_total.value
+    router.probe_backends()
+    assert router._probes_total.value == before + 1
+    assert router._probe_wait["b0"] == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: seeded, bounded retry_after jitter at the reply seam
+# ---------------------------------------------------------------------------
+
+
+def _overflowing_service(**kw):
+    svc = SuggestService(
+        SPACE, background=False, max_batch=2, max_queue=2,
+        n_startup_jobs=2, **ALGO_KW, **kw,
+    )
+    h = svc.create_study("jam", seed=3)
+    for _ in range(2):  # fill the bounded queue exactly
+        h.ask_async()
+    return svc, h
+
+
+def _refusals(h, n):
+    hints = []
+    for _ in range(n):
+        with pytest.raises(Overloaded) as ei:
+            h.ask_async()
+        assert ei.value.reason == "queue_full"
+        hints.append(ei.value.retry_after)
+    return hints
+
+
+def test_retry_after_jitter_spread_bounded_and_seeded():
+    """Refused asks carry a JITTERED hint: spread over [base, base *
+    (1 + retry_jitter)], deterministic per seed -- the shed herd stops
+    retrying on one synchronized tick."""
+    svc, h = _overflowing_service(retry_jitter_seed=7)
+    base = svc.scheduler.retry_after()
+    hints = _refusals(h, 16)
+    assert len(set(hints)) > 1, "jitter produced a synchronized herd"
+    assert all(base <= x <= round(base * 1.25, 6) for x in hints), (
+        base, hints,
+    )
+    svc.shutdown()
+    # seeded: the same seed re-derives the same hint sequence...
+    svc2, h2 = _overflowing_service(retry_jitter_seed=7)
+    assert _refusals(h2, 16) == hints
+    svc2.shutdown()
+    # ...and jitter off means the exact queue-drain estimate, always
+    svc3, h3 = _overflowing_service(retry_jitter=0.0)
+    assert set(_refusals(h3, 8)) == {base}
+    svc3.shutdown()
+
+
+def test_retry_jitter_never_touches_suggestion_streams():
+    """The jitter rng lives at the REPLY seam, drawn only after an ask
+    was refused: two services differing only in jitter config serve
+    bitwise-identical suggestion streams, refusals interleaved or
+    not."""
+    streams = []
+    for jitter_kw in (
+        dict(retry_jitter=0.0),
+        dict(retry_jitter=0.25, retry_jitter_seed=99),
+    ):
+        svc = SuggestService(
+            SPACE, background=False, max_batch=2, max_queue=2,
+            n_startup_jobs=2, **ALGO_KW, **jitter_kw,
+        )
+        h = svc.create_study("s", seed=11)
+        got = []
+        for tid in range(6):
+            t, vals = h.ask()
+            # jam the queue and eat a refusal between real asks
+            f1, f2 = h.ask_async(), h.ask_async()
+            with pytest.raises(Overloaded):
+                h.ask_async()
+            while svc.pump():  # drain the jam deterministically
+                pass
+            got.append((t, tuple(sorted(vals.items()))))
+            h.tell(t, 0.5 + 0.1 * tid, vals=vals)
+            del f1, f2
+        streams.append(got)
+        svc.shutdown()
+    assert streams[0] == streams[1]
+
+
+# ---------------------------------------------------------------------------
+# the controller: summarize -> decide (hysteresis / cooldown / bounds)
+# ---------------------------------------------------------------------------
+
+
+def _rows(replicas, queue=0.0, shed=0.0, occ_sum=0.0, occ_count=0.0,
+          down=0, lat_buckets=None):
+    rows = []
+    for i, rid in enumerate(sorted(replicas)):
+        rows.append({
+            "name": "serve_queue_depth", "labels": {"replica": rid},
+            "value": queue / len(replicas),
+        })
+        rows.append({
+            "name": "serve_shed_total", "labels": {"replica": rid},
+            "value": shed / len(replicas),
+        })
+        rows.append({
+            "name": "serve_batch_occupancy", "labels": {"replica": rid},
+            "buckets": [], "sum": occ_sum / len(replicas),
+            "count": occ_count / len(replicas),
+        })
+        if lat_buckets and i == 0:
+            rows.append({
+                "name": "serve_ask_latency_seconds",
+                "labels": {"replica": rid},
+                "buckets": [
+                    {"le": le, "count": c} for le, c in lat_buckets
+                ],
+                "sum": 0.0,
+                "count": sum(c for _, c in lat_buckets),
+            })
+    for j in range(down):
+        rows.append({
+            "name": "router_backend_up", "labels": {"backend": f"d{j}"},
+            "value": 0,
+        })
+    return rows
+
+
+def test_summarize_rows_distills_the_scrape():
+    rows = _rows(
+        ("r0", "r1"), queue=10.0, shed=4.0, occ_sum=1.5, occ_count=2.0,
+        down=1,
+        lat_buckets=[(0.005, 90), (0.05, 8), (float("inf"), 2)],
+    )
+    s = summarize_rows(rows)
+    assert s.replicas == ("r0", "r1") and s.n_replicas == 2
+    assert s.queue_depth == pytest.approx(10.0)
+    assert s.shed_total == pytest.approx(4.0)
+    assert s.occupancy_sum == pytest.approx(1.5)
+    assert s.occupancy_count == pytest.approx(2.0)
+    assert s.backends_down == 1
+    # p99 upper bound: 99th of 100 falls in the +inf bucket -> the
+    # largest FINITE boundary is the estimate
+    assert s.ask_p99_s == pytest.approx(0.05)
+    empty = summarize_rows([])
+    assert empty.n_replicas == 0 and empty.ask_p99_s == 0.0
+
+
+def _fleet_with_pilot(root, replica_ids, cfg, scrape):
+    fleet = Fleet(
+        SPACE, root, replica_ids=list(replica_ids),
+        plans={}, **KW,
+    )
+    pilot = FleetPilot(fleet, config=cfg, scrape=scrape)
+    return fleet, pilot
+
+
+def test_pilot_scale_out_hysteresis_cooldown_and_max_bound(tmp_path):
+    """Pressure must be SUSTAINED (breach_ticks) to scale out; the
+    actuation starts a cooldown during which even hard pressure holds;
+    max_replicas clamps everything."""
+    root = str(tmp_path / "up")
+    state = {"queue": 0.0}
+    fleet, pilot = _fleet_with_pilot(
+        root, ["r0"],
+        PilotConfig(min_replicas=1, max_replicas=2, queue_high=8.0,
+                    breach_ticks=2, clear_ticks=3, cooldown_ticks=2),
+        lambda: _rows(sorted(fleet.replicas), queue=state["queue"]),
+    )
+    assert pilot.tick().action == "hold"  # quiet fleet
+    state["queue"] = 20.0
+    d1 = pilot.tick()
+    assert d1.action == "hold", "one noisy scrape must never scale"
+    d2 = pilot.tick()
+    assert d2.action == "scale_out" and d2.rid == "p0"
+    assert "queue_depth" in d2.reason
+    assert set(fleet.replicas) == {"r0", "p0"}
+    # cooldown: the migration's own spike cannot trigger the next move
+    assert [pilot.tick().reason for _ in range(2)] == ["cooldown"] * 2
+    # at max_replicas the breach is acknowledged but never actuated
+    for _ in range(4):
+        assert pilot.tick().action == "hold"
+    assert set(fleet.replicas) == {"r0", "p0"}
+    rows = {r["name"]: r for r in pilot.metrics_rows()
+            if not r.get("labels")}
+    assert rows["pilot_scale_outs_total"]["value"] == 1
+    assert rows["pilot_scale_out_ms"]["value"] >= 0.0
+    fleet.shutdown()
+
+
+def test_pilot_scale_in_quiet_min_bound_and_down_veto(tmp_path):
+    """Scale-in needs clear_ticks of quiet, drains the deterministic
+    victim (lexicographically last scraped replica), never goes below
+    min_replicas, and is VETOED while any backend is reported down --
+    scale-out is not."""
+    root = str(tmp_path / "down")
+    state = {"queue": 0.0, "down": 0}
+    fleet, pilot = _fleet_with_pilot(
+        root, ["r0", "r1"],
+        PilotConfig(min_replicas=1, max_replicas=3, queue_high=8.0,
+                    queue_low=1.0, breach_ticks=2, clear_ticks=2,
+                    cooldown_ticks=0),
+        lambda: _rows(sorted(fleet.replicas), queue=state["queue"],
+                      down=state["down"]),
+    )
+    # quiet but a backend is down: the veto holds capacity
+    state["down"] = 1
+    for _ in range(4):
+        assert pilot.tick().action == "hold"
+    assert set(fleet.replicas) == {"r0", "r1"}
+    # the down backend vetoes scale-IN only -- pressure still scales out
+    state["queue"] = 20.0
+    pilot.tick()
+    d = pilot.tick()
+    assert d.action == "scale_out"
+    assert set(fleet.replicas) == {"r0", "r1", "p0"}
+    # recovered and quiet: drain back down to min_replicas, one
+    # replica per quiet window, and stop there
+    state.update(queue=0.0, down=0)
+    drained = []
+    for _ in range(10):
+        d = pilot.tick()
+        if d.action == "scale_in":
+            drained.append(d.rid)
+    assert drained == ["r1", "r0"]  # lexicographic max first
+    assert set(fleet.replicas) == {"p0"}
+    assert pilot.metrics.counter("pilot_scale_ins_total").value == 2
+    fleet.shutdown()
+
+
+def test_pilot_actuation_refusal_absorbed_not_retried(tmp_path):
+    """A fleet that refuses the actuation (the rid joined by another
+    path since the scrape) costs one counted error; the pilot moves
+    its name counter past the contested rid and the next breach
+    actuates cleanly."""
+    root = str(tmp_path / "refuse")
+    state = {"queue": 20.0}
+    fleet, pilot = _fleet_with_pilot(
+        root, ["r0"],
+        PilotConfig(min_replicas=1, max_replicas=4, queue_high=8.0,
+                    breach_ticks=1, cooldown_ticks=0),
+        lambda: _rows(["r0"], queue=state["queue"]),
+    )
+    fleet.add_replica("p0", migrate=False)  # steal the pilot's name
+    d = pilot.tick()
+    assert d.action == "scale_out" and d.rid == "p0"
+    assert pilot.metrics.counter(
+        "pilot_actuation_errors_total"
+    ).value == 1
+    d2 = pilot.tick()  # re-derived from the (stale-by-design) scrape
+    assert d2.action == "scale_out" and d2.rid == "p1"
+    assert "p1" in fleet.replicas
+    fleet.shutdown()
+
+
+def test_pilot_crash_points_registered():
+    from hyperopt_tpu.distributed.faults import (
+        ALL_CRASH_POINTS,
+        PILOT_CRASH_POINTS,
+    )
+
+    assert set(PILOT_CRASH_POINTS) <= set(ALL_CRASH_POINTS)
+    assert set(PILOT_CRASH_POINTS) == {
+        "pilot_after_decision_before_actuate",
+        "pilot_mid_scale_out",
+    }
+
+
+# ---------------------------------------------------------------------------
+# cross-host claim fencing: two simulated hosts, one NFS-shaped root
+# ---------------------------------------------------------------------------
+
+
+def _host_service(root, owner, seed):
+    """One simulated host: its own fault-plan fs seam and owner id
+    over the SHARED root."""
+    return SuggestService(
+        SPACE, root=root, owner=owner, background=False,
+        fs=FaultPlan(seed=seed).fs(), max_batch=4, n_startup_jobs=2,
+        **ALGO_KW,
+    )
+
+
+def test_two_hosts_epoch_fencing_over_shared_root(tmp_path):
+    """hostA and hostB (distinct fs seams, distinct owner ids) fight
+    over one study in a shared root: a live claim refuses the second
+    host, ``takeover`` fences the first host out with a bumped epoch,
+    and every op the fenced zombie attempts raises OwnershipLost --
+    the epochs on disk stay strictly monotone throughout."""
+    root = str(tmp_path / "nfs")
+    a = _host_service(root, "hostA", seed=1)
+    b = _host_service(root, "hostB", seed=2)
+    ha = a.create_study("s", seed=5)
+    e0 = StudyClaim.read(root, "s")["epoch"]
+    tid, vals = ha.ask()
+    ha.tell(tid, 0.5, vals=vals)
+
+    # a live foreign claim refuses a plain acquire on the other host
+    with pytest.raises(OwnershipLost):
+        b.create_study("s")
+    assert StudyClaim.read(root, "s")["epoch"] == e0
+
+    # failover authority: hostB takes over; the epoch fence bumps
+    hb = b.create_study("s", takeover=True)
+    doc = StudyClaim.read(root, "s")
+    assert doc["replica"] == "hostB" and doc["epoch"] > e0
+    assert hb.n_tells == 1  # adopted WITH the shared-root history
+
+    # hostA is now a zombie: every fenced op drops, double-serving
+    # nothing
+    with pytest.raises(OwnershipLost):
+        ha.ask()
+    with pytest.raises(OwnershipLost):
+        ha.tell(99, 0.1, vals=vals)
+    t2, v2 = hb.ask()
+    hb.tell(t2, 0.25, vals=v2)
+    assert hb.n_tells == 2
+    a.shutdown()
+    b.shutdown()
+
+
+class _SkewedFS:
+    """An fs seam whose clock runs ``skew`` seconds ahead -- the other
+    host's NFS view of our mtimes."""
+
+    def __init__(self, inner, skew):
+        self._inner = inner
+        self._skew = float(skew)
+
+    def getmtime(self, path):
+        return self._inner.getmtime(path) + self._skew
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_fsck_cross_host_kinds_repair_then_restorable(tmp_path):
+    """The three cross-host artifacts a shared root accumulates --
+    stale foreign claim, half-migrated handoff tombstone, divergent
+    WAL/snap pair -- are each detected, repaired, and leave the root
+    adoptable; ``claim_grace`` under a skewed remote clock suppresses
+    the false positive."""
+    from hyperopt_tpu.distributed.fsck import audit_serve, repair_serve
+
+    root = str(tmp_path / "nfs")
+    svc = _host_service(root, "hostA", seed=3)
+    h = svc.create_study("a", seed=1)
+    for tid in range(3):
+        t, vals = h.ask()
+        h.tell(t, 0.5 + tid, vals=vals)
+    # hostA vanishes without releasing: its claim is now stale-foreign
+    for st in svc.scheduler._studies.values():
+        st.persist.wal.close()
+
+    # a second family stranded mid-handoff: the migration source
+    # released with the handoff marker, and no target ever adopted
+    svc2 = _host_service(root, "hostB", seed=4)
+    h2 = svc2.create_study("b", seed=2)
+    t, vals = h2.ask()
+    h2.tell(t, 1.0, vals=vals)
+    svc2.handoff_study("b")
+
+    # a third family whose WAL was replaced under the bundle: the
+    # snapshot counts tells the fresh (empty) log never logged
+    svc3 = _host_service(root, "hostC", seed=5)
+    h3 = svc3.create_study("c", seed=3)
+    for _ in range(3):
+        t, vals = h3.ask()
+        h3.tell(t, 2.0, vals=vals)
+    svc3.close_study("c")  # final snapshot counts total_tells=3
+    with open(os.path.join(root, "c.wal"), "wb"):
+        pass  # the log a history-blind host re-created from nothing
+
+    # no live-owner knowledge: the claim check stays quiet (operator
+    # opt-in), the handoff + divergence still surface
+    kinds = {i.kind for i in audit_serve(root)}
+    assert kinds == {"study_half_migrated", "wal_snap_divergent"}
+
+    # with the live-owner set, hostA's claim is stale-foreign...
+    issues = audit_serve(root, live_owners={"hostB", "hostC"})
+    kinds = {i.kind for i in issues}
+    assert kinds == {
+        "claim_stale_foreign", "study_half_migrated",
+        "wal_snap_divergent",
+    }, issues
+    # ...unless the claim is too YOUNG to be trusted stale:
+    # claim_grace absorbs an in-flight handoff from a host whose
+    # clock runs AHEAD (its mtimes land in the auditor's future)
+    young = audit_serve(
+        root, live_owners={"hostB", "hostC"}, claim_grace=60.0,
+        fs=_SkewedFS(REAL_FS, skew=120.0),
+    )
+    assert "claim_stale_foreign" not in {i.kind for i in young}
+    # a claim old past the grace stays stale -- a BEHIND clock only
+    # ages it further
+    old = audit_serve(
+        root, live_owners={"hostB", "hostC"}, claim_grace=60.0,
+        fs=_SkewedFS(REAL_FS, skew=-120.0),
+    )
+    assert "claim_stale_foreign" in {i.kind for i in old}
+
+    n = repair_serve(root, issues)
+    assert n == len(issues)
+    assert audit_serve(root, live_owners={"hostB", "hostC"}) == []
+    # repaired-then-restorable: tombstoned claims adopt WITHOUT
+    # takeover (the repair is the failover authority), and the
+    # quarantined-WAL family restores from its bundle superset
+    svc4 = _host_service(root, "hostD", seed=6)
+    assert svc4.create_study("a").n_tells == 3
+    assert svc4.create_study("b").n_tells == 1
+    assert svc4.create_study("c", takeover=True).n_tells == 3
+    svc4.shutdown()
+    svc3.shutdown()
+
+
+def test_fsck_serve_cli_cross_host_flags(tmp_path, capsys):
+    """``hyperopt-tpu-fsck --serve --live-owner ... --claim-grace``
+    end to end: report, repair, clean."""
+    from hyperopt_tpu.distributed import fsck
+
+    root = str(tmp_path / "nfs")
+    svc = _host_service(root, "gone", seed=9)
+    h = svc.create_study("a", seed=1)
+    t, vals = h.ask()
+    h.tell(t, 0.5, vals=vals)
+    for st in svc.scheduler._studies.values():
+        st.persist.wal.close()
+
+    rc = fsck.main([
+        "--serve", root, "--live-owner", "alive", "--claim-grace", "0",
+    ])
+    assert rc == 1
+    assert "claim_stale_foreign" in capsys.readouterr().out
+    rc = fsck.main([
+        "--serve", root, "--live-owner", "alive", "--repair",
+    ])
+    assert rc == 0
+    rc = fsck.main(["--serve", root, "--live-owner", "alive"])
+    assert rc == 0
+    assert "clean" in capsys.readouterr().out.lower()
+
+
+# ---------------------------------------------------------------------------
+# the membership race: scale-out vs failover (lockdep armed)
+# ---------------------------------------------------------------------------
+
+
+def test_add_replica_races_failover_no_double_adopt_no_strand(
+    tmp_path, monkeypatch,
+):
+    """``Fleet.add_replica(migrate=True)`` on one thread races
+    ``failover()`` on another: the membership lock serializes them, so
+    no study ends up adopted by two replicas (the claim on disk names
+    exactly its route target) and none is stranded ownerless -- every
+    study still serves."""
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    root = str(tmp_path / "race")
+    fleet = Fleet(SPACE, root, replica_ids=["r0", "r1", "r2"], **KW)
+    names = [f"s{i:02d}" for i in range(9)]
+    for i, n in enumerate(names):
+        fleet.register(n)
+        fleet.replicas[fleet.route(n)].open_study(n, seed=40 + i)
+    for n in names:
+        rep = fleet.replicas[fleet.route(n)]
+        t, vals = rep.ask(n)
+        rep.tell(n, t, 0.5, vals=vals)
+
+    victim = "r1"
+    fleet.mark_dead(victim)
+    errs = []
+
+    def run(fn, *a, **kw):
+        try:
+            fn(*a, **kw)
+        except Exception as e:  # surfaced after join, not swallowed
+            errs.append(e)
+
+    t1 = threading.Thread(target=run, args=(fleet.failover, victim))
+    t2 = threading.Thread(
+        target=run, args=(fleet.add_replica, "r9"),
+        kwargs=dict(migrate=True),
+    )
+    t1.start()
+    t2.start()
+    t1.join(30)
+    t2.join(30)
+    assert not errs, errs
+    assert victim not in fleet.ring.nodes and "r9" in fleet.ring.nodes
+
+    # no strand: every study routes to a live replica and serves
+    for n in names:
+        rid = fleet.route(n)
+        assert rid in fleet.replicas and not fleet.replicas[rid].dead
+        rep = fleet.replicas[rid]
+        t, vals = rep.ask(n)
+        rep.tell(n, t, 0.25, vals=vals)
+    # no double-adopt: the claim on disk names exactly the replica the
+    # fleet routes to -- nobody else holds a live claim
+    for n in names:
+        doc = StudyClaim.read(root, n)
+        assert not doc.get("released")
+        assert doc["replica"] == fleet.route(n), (n, doc)
+    # each study's tells landed exactly once
+    for n in names:
+        st = fleet.replicas[fleet.route(n)].service.scheduler.study(n)
+        assert st.buf.count == 2, (n, st.buf.count)
+        assert st.persist.wal.total_tells == 2
+    fleet.shutdown()
+    assert dep.inversions == 0, dep.errors
+
+
+# ---------------------------------------------------------------------------
+# satellite: graftlint + graftrace stay clean over the new modules
+# ---------------------------------------------------------------------------
+
+
+def test_pilot_modules_lint_and_trace_clean():
+    """graftlint + graftrace over exactly the new pilot/replay modules
+    (the whole-package zero-baseline gates cover them too; this pins
+    the satellite explicitly)."""
+    from hyperopt_tpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [
+        os.path.join(repo, "hyperopt_tpu", "serve", "pilot.py"),
+        os.path.join(repo, "hyperopt_tpu", "serve", "replay.py"),
+    ]
+    for pack in ("ast", "trace"):
+        result = lint_paths(paths, pack=pack)
+        assert not result.findings, (pack, result.findings)

@@ -1,0 +1,471 @@
+"""graftpilot chaos (ISSUE 16): the self-driving fleet under fire.
+
+The headline acceptance: a replica is killed mid-batch while the
+autoscaler -- driven ONLY by the metrics the fleet already exposes,
+no test back-channel -- executes a scale-out under a 10% transient
+fault storm.  Zero lost / zero duplicate tells (live counters AND a
+cold WAL-replay audit), every suggestion stream bitwise the same-seed
+no-fault run's, the whole scenario replays bitwise, and the recorded
+flight-recorder span log replays through the traffic harness to the
+same streams bitwise.
+
+Plus both PILOT crash windows (decision-to-actuation, mid-scale-out
+migration) and the record-once-replay-bitwise harness on a solo
+service.
+
+Same discipline as ``tests/test_fleet_chaos.py``: seeded FaultPlans,
+deterministic single-threaded pumping, protocol-client retries, and
+every scenario run twice same-seed.
+"""
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import hp
+from hyperopt_tpu.distributed.faults import FaultPlan, SimulatedCrash
+from hyperopt_tpu.obs.flightrec import FlightRecorder
+from hyperopt_tpu.serve import (
+    Fleet,
+    FleetPilot,
+    FleetRouter,
+    HashRing,
+    PilotConfig,
+    SuggestService,
+)
+from hyperopt_tpu.serve.fleet import fleet_salt
+from hyperopt_tpu.serve.replay import (
+    ServiceTarget,
+    load_workload,
+    replay_fidelity,
+    replay_workload,
+    stream_hash,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "lr": hp.loguniform("lr", -5, 0),
+    "c": hp.choice("c", [0, 1]),
+}
+ALGO_KW = dict(n_cand=16, n_cand_cat=8)
+KW = dict(max_batch=8, n_startup_jobs=2, snapshot_cadence=4, **ALGO_KW)
+REPLICAS = ("r0", "r1")
+NAMES = tuple(f"s{i:02d}" for i in range(9))
+R = 4  # tells per study the workload must end with, exactly
+
+
+def loss_fn(vals):
+    return (vals["x"]) ** 2 / 10 + abs(float(np.log(vals["lr"])) + 2) / 3
+
+
+def victim_rid(name="s00"):
+    ring = HashRing(REPLICAS, salt=fleet_salt("tpe", SPACE))
+    return ring.owner(name)
+
+
+def make_fleet(root, storm_rate=0.0, arm_victim=None, seed=0, fs=None,
+               recorder=None):
+    plans = {
+        rid: FaultPlan(seed=seed * 100 + i, rate=storm_rate)
+        for i, rid in enumerate(REPLICAS)
+    }
+    if arm_victim is not None:
+        point, at = arm_victim
+        plans[victim_rid()].arm(point, at=at)
+    kw = dict(KW)
+    if recorder is not None:
+        kw["recorder"] = recorder
+    return Fleet(
+        SPACE, root, replica_ids=list(REPLICAS), plans=plans,
+        fs=fs if fs is not None else FaultPlan(seed=seed).fs(), **kw,
+    )
+
+
+class Client:
+    """The protocol client's retry discipline (test_fleet_chaos)."""
+
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self.router = FleetRouter(fleet)
+
+    def _restart(self):
+        self.router = FleetRouter(self.fleet)
+
+    def create(self, name, seed):
+        while True:
+            try:
+                return self.router.create_study(name, seed=seed)
+            except SimulatedCrash:
+                self._restart()
+
+    def ask(self, name):
+        recover = False
+        while True:
+            try:
+                return self.router.ask(name, timeout=30, recover=recover)
+            except SimulatedCrash:
+                self._restart()
+                recover = True
+
+    def tell(self, name, tid, loss, vals):
+        while True:
+            try:
+                return self.router.tell(name, tid, loss, vals=vals)
+            except SimulatedCrash:
+                self._restart()
+
+
+def drive(client, streams, rounds, names=NAMES):
+    for _ in range(rounds):
+        for n in names:
+            tid, vals = client.ask(n)
+            client.tell(n, tid, loss_fn(vals), vals)
+            streams[n].append((tid, tuple(sorted(vals.items()))))
+
+
+def final_state(fleet, names=NAMES):
+    out = {}
+    for n in names:
+        st = fleet.replicas[fleet.route(n)].service.scheduler.study(n)
+        buf = st.buf
+        out[n] = {
+            "count": int(buf.count),
+            "tids": buf.tids[: buf.count].tolist(),
+            "losses": buf.losses[: buf.count].tolist(),
+            "values": buf.values[:, : buf.count].copy(),
+            "wal_total_tells": st.persist.wal.total_tells,
+        }
+    return out
+
+
+def assert_zero_lost_zero_duplicate(state):
+    for n, d in state.items():
+        assert d["count"] == R, (n, d["count"])
+        assert len(set(d["tids"])) == R, f"{n}: duplicate tid absorbed"
+        assert d["wal_total_tells"] == R, (
+            f"{n}: WAL logged {d['wal_total_tells']} tells for "
+            f"{R} applied -- lost or duplicated"
+        )
+
+
+def assert_states_bitwise_equal(a, b, names=NAMES):
+    for n in names:
+        assert a[n]["tids"] == b[n]["tids"], n
+        assert a[n]["losses"] == b[n]["losses"], n
+        np.testing.assert_array_equal(a[n]["values"], b[n]["values"])
+        assert a[n]["wal_total_tells"] == b[n]["wal_total_tells"]
+
+
+def build_pressure(fleet, n_load=2, n_asks=2):
+    """Queue real load the pilot can SEE: unregistered load studies
+    opened directly on each live replica (few enough to fit the
+    ``max_batch`` study cap next to the measured studies), several
+    asks queued per study but not pumped -- ``serve_queue_depth`` in
+    the next scrape is genuinely high.  Unregistered means they never
+    migrate or fail over; their pending asks drain into later
+    coalesced dispatches and none of them touch the measured studies'
+    per-study suggestion streams."""
+    futs = []
+    for rid in sorted(fleet.replicas):
+        rep = fleet.replicas[rid]
+        if rep.dead or rep.partitioned:
+            continue
+        for j in range(n_load):
+            name = f"zz-load-{rid}-{j}"
+            if name not in rep.service.studies():
+                rep.open_study(name, seed=900 + j)
+            for _ in range(n_asks):
+                futs.append(rep.ask_async(name))
+    return futs
+
+
+def pilot_for(fleet, **cfg_kw):
+    """The production wiring: NO scrape override -- the controller's
+    only input is ``fleet.metrics_rows`` (what /metrics serves)."""
+    cfg = dict(
+        min_replicas=2, max_replicas=3, queue_high=6.0, shed_high=0,
+        breach_ticks=2, clear_ticks=50, cooldown_ticks=2,
+    )
+    cfg.update(cfg_kw)
+    return FleetPilot(fleet, config=PilotConfig(**cfg))
+
+
+@pytest.fixture(scope="module")
+def clean_run(tmp_path_factory):
+    """The same-seed NO-FAULT, NO-PILOT run every scenario's streams
+    are pinned against (streams are placement-independent, so one
+    2-replica clean run serves every membership trajectory)."""
+    root = tmp_path_factory.mktemp("pilot-clean")
+    fleet = make_fleet(str(root))
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, R)
+    state = final_state(fleet)
+    fleet.shutdown()
+    return streams, state
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance scenario: kill-during-scale under a storm
+# ---------------------------------------------------------------------------
+
+
+def test_kill_during_autoscale_under_storm_acceptance(
+    tmp_path, clean_run
+):
+    """A replica dies mid-batch in the dispatch window right after the
+    autoscaler -- fed only by scraped metrics -- executed a scale-out,
+    all under a 10% transient-errno storm.  Zero lost / zero duplicate
+    tells (live AND cold-audited), every stream bitwise the no-fault
+    run's, the scenario replays bitwise, and the recorded flight log
+    replays through the traffic harness to the same streams bitwise."""
+    clean_streams, clean_state = clean_run
+    runs = []
+    for rep in range(2):
+        root = str(tmp_path / f"kill-{rep}")
+        log = str(tmp_path / f"flight-{rep}.jsonl")
+        recorder = FlightRecorder(path=log)
+        fleet = make_fleet(
+            root, storm_rate=0.10,
+            arm_victim=("serve_mid_batch", 8), seed=7,
+            recorder=recorder,
+        )
+        victim = victim_rid()
+        pilot = pilot_for(fleet)
+        assert pilot.scrape == fleet.metrics_rows  # no back-channel
+        client = Client(fleet)
+        for i, n in enumerate(NAMES):
+            client.create(n, seed=100 + i)
+        streams = {n: [] for n in NAMES}
+        drive(client, streams, 1)
+        assert pilot.tick().action == "hold"  # quiet warmup scrape
+
+        # real pressure -> sustained breach -> the pilot scales out
+        build_pressure(fleet)
+        decisions = [pilot.tick(), pilot.tick()]
+        assert [d.action for d in decisions] == ["hold", "scale_out"]
+        assert decisions[1].rid == "p0" and "p0" in fleet.replicas
+        assert not fleet.replicas[victim].dead
+
+        # ...and the victim dies mid-batch in the very next dispatch
+        # window, while the scaled-out fleet absorbs the storm
+        drive(client, streams, R - 1)
+        pilot.tick()  # the loop keeps running across the failover
+        assert fleet.replicas[victim].dead
+        assert victim not in fleet.ring.nodes
+        assert fleet.recovery_ms is not None and fleet.recovery_ms > 0
+        prows = {
+            r["name"]: r for r in pilot.metrics_rows()
+            if not r.get("labels")
+        }
+        assert prows["pilot_scale_outs_total"]["value"] == 1
+        assert prows["pilot_scale_out_ms"]["value"] >= 0.0
+
+        state = final_state(fleet)
+        assert_zero_lost_zero_duplicate(state)
+        fleet.shutdown()
+        recorder.flush()
+
+        # cold audit: re-materialize every study from nothing but its
+        # WAL+bundle pair
+        audit = SuggestService(
+            SPACE, root=root, owner="audit", background=False,
+            max_batch=16, n_startup_jobs=2, **ALGO_KW,
+        )
+        for n in NAMES:
+            h = audit.create_study(n, takeover=True)
+            assert h.n_tells == R, (n, h.n_tells)
+        cold = {
+            n: audit.scheduler.study(n).buf.tids[:R].tolist()
+            for n in NAMES
+        }
+        audit.shutdown()
+        for n in NAMES:
+            assert cold[n] == state[n]["tids"], n
+
+        # the flight log IS the workload: replay it against a fresh
+        # solo service and the measured streams re-derive bitwise --
+        # the faulted run's recovery re-serves collapse onto the
+        # clean op order
+        ops = load_workload(log)
+        target = ServiceTarget(SuggestService(
+            SPACE, background=False, max_batch=16, n_startup_jobs=2,
+            **ALGO_KW,
+        ))
+        replayed = replay_workload(ops, target)
+        target.service.shutdown()
+        rep_named = {n: replayed[n] for n in NAMES}
+        rec_named = {
+            n: [(t, dict(v)) for t, v in streams[n]] for n in NAMES
+        }
+        assert replay_fidelity(rec_named, rep_named) == 1.0
+        assert stream_hash(rep_named) == stream_hash(rec_named)
+        runs.append((streams, state, ops,
+                     [d.action for d in decisions]))
+
+    # every stream bitwise the same-seed no-fault run's
+    for streams, state, _, _ in runs:
+        assert streams == clean_streams
+        assert_states_bitwise_equal(state, clean_state)
+    # the whole scenario -- streams, state, the extracted workload,
+    # and the autoscaler's decision sequence -- replays bitwise
+    assert runs[0][0] == runs[1][0]
+    assert_states_bitwise_equal(runs[0][1], runs[1][1])
+    assert runs[0][2] == runs[1][2]
+    assert runs[0][3] == runs[1][3]
+
+
+# ---------------------------------------------------------------------------
+# the PILOT crash windows
+# ---------------------------------------------------------------------------
+
+
+def test_pilot_crash_between_decision_and_actuation(tmp_path, clean_run):
+    """The pilot dies AFTER stamping its decision but BEFORE touching
+    the fleet: nothing moved, and a restarted pilot -- decisions are
+    stateless functions of the scrape -- re-derives the same decision
+    from the same metrics and actuates it."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "dw")
+    fleet = make_fleet(root)
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 2)
+
+    build_pressure(fleet)
+    crashed = FleetPilot(
+        fleet,
+        config=PilotConfig(min_replicas=2, max_replicas=3,
+                           queue_high=6.0, breach_ticks=1),
+        fs=FaultPlan(seed=3).arm(
+            "pilot_after_decision_before_actuate", at=1
+        ).fs(),
+    )
+    with pytest.raises(SimulatedCrash):
+        crashed.tick()
+    # the decision was recorded, the fleet never moved
+    assert crashed.metrics.counter("pilot_decisions_total").labels(
+        action="scale_out"
+    ).value == 1
+    assert set(fleet.replicas) == set(REPLICAS)
+
+    # restart: a fresh pilot re-scrapes, re-decides, actuates
+    restarted = FleetPilot(
+        fleet,
+        config=PilotConfig(min_replicas=2, max_replicas=3,
+                           queue_high=6.0, breach_ticks=1),
+    )
+    d = restarted.tick()
+    assert d.action == "scale_out" and d.rid == "p0"
+    assert "p0" in fleet.replicas
+
+    drive(client, streams, R - 2)
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+def test_pilot_mid_scale_out_crash_heals_by_lazy_adoption(
+    tmp_path, clean_run
+):
+    """The coordinator dies inside the pilot's scale-out after the
+    FIRST remapped study migrated: the ring already includes the new
+    replica, the remaining remapped studies are stranded behind it.
+    The heal is the ordinary lazy-adoption path -- the new owner
+    adopts each stranded study on its first routed request -- and
+    re-running ``add_replica`` is refused, not the recovery."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "ms")
+    fleet = make_fleet(
+        root, fs=FaultPlan(seed=4).arm("pilot_mid_scale_out", at=1).fs()
+    )
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 2)
+
+    build_pressure(fleet)
+    pilot = pilot_for(fleet, breach_ticks=1)
+    with pytest.raises(SimulatedCrash):
+        pilot.tick()
+    # the ring flipped, at most one study actually moved
+    assert "p0" in fleet.replicas and "p0" in fleet.ring.nodes
+    remapped = [n for n in NAMES if fleet.route(n) == "p0"]
+    assert remapped, "the crash window needs a remapped share"
+    resident = set(fleet.replicas["p0"].service.studies()) & set(NAMES)
+    assert len(resident) == 1, resident
+    stranded = [n for n in remapped if n not in resident]
+    assert stranded, "nothing stranded -- the window closed too early"
+    # re-running the actuation is refused; it is NOT the heal
+    with pytest.raises(ValueError):
+        fleet.add_replica("p0")
+
+    # the heal: ordinary traffic -- the new owner lazily adopts each
+    # stranded study on first contact
+    drive(client, streams, R - 2)
+    assert set(
+        fleet.replicas["p0"].service.studies()
+    ) & set(NAMES) >= set(remapped)
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    fleet.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# record once, replay bitwise (the traffic harness, solo)
+# ---------------------------------------------------------------------------
+
+
+def test_flight_log_records_then_replays_bitwise(tmp_path):
+    """Arm a flight recorder on a solo service, run a multi-study
+    workload, then replay the span log against a FRESH service with a
+    different batch shape: every suggestion stream re-derives bitwise
+    (tid sequences checked by the harness, vals by hash)."""
+    log = str(tmp_path / "flight.jsonl")
+    svc = SuggestService(
+        SPACE, background=False, max_batch=4, n_startup_jobs=2,
+        recorder=FlightRecorder(path=log), **ALGO_KW,
+    )
+    handles = {
+        f"m{i}": svc.create_study(f"m{i}", seed=30 + i) for i in range(3)
+    }
+    recorded = {n: [] for n in handles}
+    for _ in range(3):
+        for n, h in handles.items():
+            tid, vals = h.ask()
+            h.tell(tid, loss_fn(vals), vals=vals)
+            recorded[n].append((tid, dict(vals)))
+    svc.recorder.flush()
+    svc.shutdown()
+
+    target = ServiceTarget(SuggestService(
+        SPACE, background=False, max_batch=16, n_startup_jobs=2,
+        **ALGO_KW,
+    ))
+    replayed = replay_workload(load_workload(log), target)
+    target.service.shutdown()
+    assert replayed == recorded
+    assert replay_fidelity(recorded, replayed) == 1.0
+    # the hash is order-canonical, not dict-order-accidental
+    assert stream_hash(dict(reversed(list(recorded.items())))) \
+        == stream_hash(replayed)
