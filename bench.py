@@ -1303,6 +1303,100 @@ def bench_fmin_client(n_trials=1000, seed=7, ask_ahead=4):
     return dt, float(min(trials.losses())), n_trials / dt
 
 
+def bench_burst(space, n_clients=64, n_studies=4, asks_per_client=8,
+                n_cand=128, pool_width=32):
+    """The round-22 graftburst concurrency headline: N concurrent
+    clients speak the negotiated binary frame protocol to ONE served
+    engine over TCP, each pipelining a window of asks and telling the
+    results back.  Three rows come out of the single timed scenario:
+
+    ``fleet_asks_per_sec_concurrent``
+        aggregate served asks/sec across all clients -- the CI-sized
+        twin of the 10^3-client soak (BENCH_BURST_CLIENTS sizes it up
+        on an accelerator host);
+    ``wal_fsyncs_per_tell``
+        durability amortization under load: group commit issues one
+        barrier per WAL per round instead of one fsync per tell, so
+        the ratio collapses toward studies/asks-per-round
+        (acceptance < 0.2) while the durability point is unchanged;
+    ``client_cobatch_occupancy``
+        mean filled-slot fraction of the engine's vmapped rounds while
+        the clients co-ride -- the co-batching payoff made visible.
+    """
+    import concurrent.futures
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    from hyperopt_tpu.serve import SuggestService
+    from hyperopt_tpu.serve.frames import FrameConn
+    from hyperopt_tpu.serve.service import serve_forever
+
+    root = tempfile.mkdtemp(prefix="bench_burst_")
+    svc = SuggestService(
+        space, root=root, background=True, max_batch=64,
+        n_startup_jobs=3, n_cand=n_cand, snapshot_cadence=1000,
+        max_queue=4096, study_queue_cap=64,
+    )
+    srv = serve_forever(svc, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    addr = srv.server_address[:2]
+    names = [f"b{i}" for i in range(n_studies)]
+    for i, name in enumerate(names):
+        svc.create_study(name, seed=i)
+
+    def one_client(i):
+        name = names[i % n_studies]
+        sock = socket.create_connection(addr, timeout=60)
+        served = 0
+        try:
+            conn = FrameConn(sock.makefile("rwb"))
+            futs = [
+                conn.submit({"op": "ask", "study": name, "timeout": 45})
+                for _ in range(asks_per_client)
+            ]
+            replies = [conn.drain(f) for f in futs]
+            tells = [
+                conn.submit({
+                    "op": "tell", "study": name, "tid": r["tid"],
+                    "loss": 0.1 + (r["tid"] % 97) / 100.0,
+                })
+                for r in replies if r.get("ok")
+            ]
+            for f in tells:
+                if conn.drain(f).get("ok"):
+                    served += 1
+            conn.close()
+        finally:
+            sock.close()
+        return served
+
+    t0 = time.perf_counter()
+    with concurrent.futures.ThreadPoolExecutor(pool_width) as pool:
+        served = sum(pool.map(one_client, range(n_clients)))
+    dt = time.perf_counter() - t0
+    c = svc.counters
+    occ = [float(x) for x in svc.scheduler.occupancy]
+    srv.shutdown()
+    srv.server_close()
+    svc.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+    tells = max(c.get("wal_tells", 0), 1)
+    return {
+        "fleet_asks_per_sec_concurrent": round(served / dt, 1),
+        "wal_fsyncs_per_tell": round(c.get("wal_fsyncs", 0) / tells, 4),
+        "client_cobatch_occupancy": (
+            round(float(np.mean(occ)), 4) if occ else None
+        ),
+        "burst_config": {
+            "n_clients": n_clients, "n_studies": n_studies,
+            "asks_per_client": asks_per_client,
+            "pool_width": pool_width,
+        },
+    }
+
+
 def bench_best_at_1k_device_loop(n_trials=1000, n_cand=128, seed=7,
                                  batch_size=32):
     """The same 1k-trial experiment as ONE on-device program
@@ -1555,6 +1649,18 @@ def main():
         n_studies=int(os.environ.get("BENCH_PILOT_STUDIES", "12")),
         n_cand=n_cand,
     )
+    # round-22 graftburst rows: N concurrent binary-frame clients on
+    # one served engine -- aggregate asks/sec, the group-commit fsync
+    # amortization ratio, and co-batched round occupancy
+    burst_rows = bench_burst(
+        space,
+        n_clients=int(os.environ.get(
+            "BENCH_BURST_CLIENTS", "1000" if on_accel else "64"
+        )),
+        n_studies=int(os.environ.get("BENCH_BURST_STUDIES", "4")),
+        asks_per_client=int(os.environ.get("BENCH_BURST_ASKS", "8")),
+        n_cand=n_cand,
+    )
     # round-17 graftmesh rows: the study-sharded serve engine and the
     # shard_map PBT schedule per mesh shape (virtual CPU devices here;
     # the MULTICHIP dryrun runs the same programs on real meshes)
@@ -1674,6 +1780,11 @@ def main():
                 # aggregate studies/sec, failover-window p99, recovery
                 **fleet_rows,
                 **pilot_rows,
+                # round-22 graftburst rows (bench_burst): concurrent
+                # binary-frame clients on one engine -- aggregate
+                # throughput, wal_fsyncs_per_tell (< 0.2 acceptance),
+                # co-batch occupancy
+                **burst_rows,
                 # round-19 graftscope rows (bench_obs): tracing-armed
                 # overhead fractions, span throughput, and the
                 # fleet-wide /metrics scrape latency

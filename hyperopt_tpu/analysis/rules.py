@@ -845,6 +845,69 @@ def check_adhoc_metric_state(ctx):
                     )
 
 
+#: the durability calls whose per-item cost group-commit amortizes: a
+#: raw fsync and the durable-pickle saver (tmp+fsync+rename) -- one of
+#: these per loop iteration is one storage barrier per item
+_SYNC_CALLS = frozenset({"fsync", "durable_pickle"})
+
+
+@register(
+    "GL308", "fsync-in-hot-loop",
+    "fsync/durable_pickle issued inside a for-loop in serve//"
+    "distributed/ library code -- one storage barrier per item is the "
+    "latency class group-commit retired (PR-6 flush-then-barrier, "
+    "graftburst round barriers); flush per item, fsync ONCE after the "
+    "loop (barrier helpers are exempt by name)",
+)
+def check_fsync_in_hot_loop(ctx):
+    # the graftburst rule: a tell/round/batch loop that fsyncs every
+    # iteration serializes the whole batch behind N storage barriers.
+    # The sanctioned shape is flush-in-loop + one barrier after -- so
+    # functions whose name carries "barrier" (TellWAL.barrier, the
+    # scheduler's _barrier_round) are the fix, not the bug, and are
+    # exempt wherever the sync call lands inside them.
+    in_domain = any(
+        p in ("serve", "distributed") for p in ctx.parts[:-1]
+    )
+    if not in_domain or _is_test_file(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not (
+            isinstance(node, ast.Call)
+            and terminal_name(node.func) in _SYNC_CALLS
+        ):
+            continue
+        in_loop = exempt = False
+        for anc in ctx.ancestors(node):
+            if isinstance(
+                anc, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and "barrier" in anc.name:
+                exempt = True
+                break
+            if isinstance(anc, ast.For) and ctx.enclosing_function(
+                node
+            ) is ctx.enclosing_function(anc):
+                # same function scope: the sync runs once PER ITERATION
+                # (a closure merely defined inside the loop does not)
+                in_loop = True
+                break
+        if in_loop and not exempt:
+            # keep climbing for a barrier-named enclosing helper
+            exempt = any(
+                isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and "barrier" in a.name
+                for a in ctx.ancestors(node)
+            )
+        if in_loop and not exempt:
+            yield ctx.finding(
+                "GL308", node,
+                f"{terminal_name(node.func)}() inside a for-loop: one "
+                "storage barrier per item serializes the batch; flush "
+                "in the loop and issue ONE barrier fsync after it "
+                "(TellWAL.barrier / the group-commit round shape)",
+            )
+
+
 _NP_GLOBAL_STATE = frozenset({
     "seed", "rand", "randn", "randint", "random", "uniform", "normal",
     "choice", "shuffle", "permutation", "standard_normal", "beta",

@@ -60,7 +60,9 @@ from __future__ import annotations
 
 import collections
 import functools
+import json
 import logging
+import threading
 import time
 
 import numpy as np
@@ -95,6 +97,90 @@ CLIENT_STUDY = "fmin"
 #: the DriverRecovery default, so the durability granularity of the
 #: unified layout matches the driver WAL it replaces
 CLIENT_SNAPSHOT_CADENCE = 25
+
+#: round width of a SHARED client service: concurrent ``fmin`` clients
+#: of one (root, space, algo, objective) family ride the same vmapped
+#: rounds up to this many asks per dispatch (graftburst co-batching)
+SHARED_MAX_BATCH = 64
+
+#: per-study submit-ahead cap of a shared service; a client's
+#: ``ask_ahead`` window is clamped to it (depth is stream-invisible,
+#: so the clamp is bitwise-safe -- and without it a deep window would
+#: spin forever against the cap's Overloaded backpressure)
+SHARED_QUEUE_CAP = 8
+
+# -- the co-batching registry (graftburst tentpole 2) -----------------------
+#
+# ``fmin(engine=True)`` used to build a PRIVATE max_batch=1 service per
+# call -- N concurrent clients meant N schedulers, N dispatch rounds,
+# zero batching.  The registry below keys LIVE client-owned services by
+# their full study-family identity (root, algo, algo knobs, space
+# fingerprint, objective identity); concurrent ``connect()`` calls with
+# the same key share one wide service and each open their own study on
+# it, so their asks co-batch into the same vmapped rounds.  Each stream
+# stays bitwise its solo run: seeds are drawn from each study's OWN
+# rstate at submit time (the PR-8 construction) and the per-slot math
+# is vmapped identically whatever the round width.
+#
+# Refcounted, live-only: release at zero shuts the service down and
+# drops the entry, so a SEQUENTIAL restore still finds the root closed
+# and quiescent -- sharing only ever spans temporally-overlapping
+# clients.  Chaos harnesses (fs=) and recorder runs stay private: an
+# armed fault plan or span recorder belongs to ONE call.
+_SHARED_SERVICES = {}  # key -> [service, refcount]
+_SHARED_LOCK = threading.Lock()
+
+
+def _registry_key(spec, domain, fn, root):
+    """The full study-family identity of one client-owned service."""
+    from .hyperband import _algo_identity, _space_fingerprint
+
+    return json.dumps(
+        [
+            str(root),
+            spec.name,
+            sorted(spec.algo_kw.items()),
+            spec.n_startup_jobs,
+            sorted((spec.hook_kw or {}).items()),
+            bool(spec.resident),
+            _space_fingerprint(domain.expr),
+            _algo_identity(fn) if fn is not None else None,
+        ],
+        sort_keys=True,
+        default=str,
+    )
+
+
+def _alloc_study_name(service):
+    """The next free client study name on ``service``: ``fmin`` when
+    free (the solo layout -- restore keys on it), else ``fmin-2``,
+    ``fmin-3``, ...  Callers hold :data:`_SHARED_LOCK`."""
+    existing = set(service.studies())
+    if CLIENT_STUDY not in existing:
+        return CLIENT_STUDY
+    i = 2
+    while f"{CLIENT_STUDY}-{i}" in existing:
+        i += 1
+    return f"{CLIENT_STUDY}-{i}"
+
+
+def _release_shared(key, service, study_name):
+    """Drop one client's hold on a shared service; the last one out
+    shuts it down (snapshots inside) and retires the registry entry."""
+    with _SHARED_LOCK:
+        entry = _SHARED_SERVICES.get(key)
+        if entry is None or entry[0] is not service:
+            # registry moved on (shouldn't happen); close just our study
+            service.close_study(study_name)
+            return
+        entry[1] -= 1
+        if entry[1] > 0:
+            service.close_study(study_name)
+            return
+        del _SHARED_SERVICES[key]
+        # shutdown INSIDE the lock: a racing connect() on the same key
+        # must not build a second service over a root still closing
+        service.shutdown()
 
 
 class EngineSpec:
@@ -279,7 +365,7 @@ class EngineClient:
 
     def __init__(self, service, handle, spec, domain, trials, rstate,
                  ask_ahead=1, owns_service=True, max_submits=None,
-                 restored=False):
+                 restored=False, shared_key=None):
         self.service = service
         self.handle = handle
         self.study = handle._study
@@ -288,7 +374,14 @@ class EngineClient:
         self.trials = trials
         self.rstate = rstate
         self.ps = service.ps
-        self.ask_ahead = max(1, int(ask_ahead))
+        # clamp the window to the service's per-study submit cap: depth
+        # is stream-invisible (fresh_window holds dispatch order), and
+        # an unclamped window on a shared service would spin the
+        # Overloaded backoff loop against study_queue_cap forever
+        self.ask_ahead = max(
+            1, min(int(ask_ahead), service.scheduler.study_queue_cap)
+        )
+        self._shared_key = shared_key
         self.owns_service = owns_service
         #: total ask budget (max_evals); submits stop at it so the
         #: rstate cursor ends exactly where the solo driver's would
@@ -331,7 +424,14 @@ class EngineClient:
                     self.study, timeout=remaining
                 )
             except Overloaded as e:
-                wait = e.retry_after if e.retry_after else 0.05
+                from .serve.service import RETRY_AFTER_CAP
+
+                # honor the server's (jittered, PR-16) hint, capped: a
+                # wild hint must never eat the whole client deadline
+                wait = min(
+                    e.retry_after if e.retry_after else 0.05,
+                    RETRY_AFTER_CAP,
+                )
                 if time.perf_counter() + wait >= deadline:
                     raise DeadlineExpired(
                         f"client study {self.study_name!r}: the engine "
@@ -558,9 +658,32 @@ class EngineClient:
         while self._queue:
             self.service.scheduler.drop_request(self._queue.popleft())
         if self.owns_service:
-            self.service.shutdown()  # close_study snapshots inside
+            if self._shared_key is not None:
+                _release_shared(
+                    self._shared_key, self.service, self.study_name
+                )
+            else:
+                self.service.shutdown()  # close_study snapshots inside
         else:
             self.service.close_study(self.study_name)
+
+    def abandon(self):
+        """Crash-path release: drop the co-batching registry hold
+        WITHOUT finalizing -- no final snapshot, no study close, no
+        shutdown; the WAL stays the truth for restore (the solo crash
+        posture).  A later ``connect()`` on the same family then builds
+        a fresh service and restores from disk instead of silently
+        riding the dead run's live one."""
+        if self.closed:
+            return
+        self.closed = True
+        if self._shared_key is not None:
+            with _SHARED_LOCK:
+                entry = _SHARED_SERVICES.get(self._shared_key)
+                if entry is not None and entry[0] is self.service:
+                    entry[1] -= 1
+                    if entry[1] <= 0:
+                        del _SHARED_SERVICES[self._shared_key]
 
 
 def connect(engine, algo, domain, trials, rstate, fn=None, ask_ahead=1,
@@ -576,37 +699,66 @@ def connect(engine, algo, domain, trials, rstate, fn=None, ask_ahead=1,
     ``(client, trials, rstate, restored)`` -- on restore, the rebuilt
     Trials store and the study's restored rstate supersede the passed
     ones, exactly the PR-6 driver semantics.
+
+    **Co-batching** (graftburst): ``engine=True`` connects through the
+    shared-service registry -- concurrent ``fmin`` calls whose study
+    family matches (same root, space, algo + knobs, objective) ride ONE
+    wide scheduler, each as its own study (``fmin``, ``fmin-2``, ...),
+    their asks vmapped together per round.  Every stream is bitwise its
+    solo run; the last client out shuts the service down, so sequential
+    runs (and restores) see exactly the solo layout.  ``fs=`` and
+    ``recorder=`` opt out into a private service.
     """
     from .serve import SuggestService
 
     spec = resolve_engine_algo(algo)
     owns = not isinstance(engine, SuggestService)
+    shared_key = None
     if owns:
-        kw = {}
-        if fs is not None:
-            kw["fs"] = fs
-        service = SuggestService(
-            domain.expr, algo=spec.name, root=root,
-            max_batch=1, background=False,
-            n_startup_jobs=spec.n_startup_jobs,
-            snapshot_cadence=CLIENT_SNAPSHOT_CADENCE,
-            finite_check=False,
-            study_queue_cap=max(2, int(ask_ahead)),
-            max_queue=max(8, 2 * int(ask_ahead)),
-            recorder=recorder, **dict(spec.algo_kw, **kw),
-        )
-        if fn is not None:
-            # objective identity joins the study guard: resuming this
-            # root under a different objective is refused
-            service._guard = _client_guard(service._guard, fn)
+        if fs is None and recorder is None:
+            shared_key = _registry_key(spec, domain, fn, root)
+            with _SHARED_LOCK:
+                entry = _SHARED_SERVICES.get(shared_key)
+                if entry is None:
+                    service = SuggestService(
+                        domain.expr, algo=spec.name, root=root,
+                        max_batch=SHARED_MAX_BATCH, background=False,
+                        n_startup_jobs=spec.n_startup_jobs,
+                        snapshot_cadence=CLIENT_SNAPSHOT_CADENCE,
+                        finite_check=False,
+                        study_queue_cap=SHARED_QUEUE_CAP,
+                        max_queue=8 * SHARED_MAX_BATCH,
+                        **spec.algo_kw,
+                    )
+                    if fn is not None:
+                        # objective identity joins the study guard:
+                        # resuming this root under a different
+                        # objective is refused
+                        service._guard = _client_guard(
+                            service._guard, fn
+                        )
+                    entry = _SHARED_SERVICES[shared_key] = [service, 0]
+                entry[1] += 1
+                service = entry[0]
+        else:
+            # an armed fault plan or a span recorder belongs to ONE
+            # call: private service, the pre-graftburst shape
+            service = SuggestService(
+                domain.expr, algo=spec.name, root=root,
+                max_batch=1, background=False,
+                n_startup_jobs=spec.n_startup_jobs,
+                snapshot_cadence=CLIENT_SNAPSHOT_CADENCE,
+                finite_check=False,
+                study_queue_cap=max(2, int(ask_ahead)),
+                max_queue=max(8, 2 * int(ask_ahead)),
+                recorder=recorder,
+                **(dict(spec.algo_kw, fs=fs) if fs is not None
+                   else spec.algo_kw),
+            )
+            if fn is not None:
+                service._guard = _client_guard(service._guard, fn)
     else:
         service = engine
-        if CLIENT_STUDY in service.studies():
-            raise ValueError(
-                f"the provided engine already hosts a {CLIENT_STUDY!r} "
-                "client study (one fmin per service at a time; close "
-                "it first, or use a fresh engine)"
-            )
         if service.scheduler.algo != spec.name:
             raise ValueError(
                 f"the provided engine serves algo "
@@ -618,59 +770,77 @@ def connect(engine, algo, domain, trials, rstate, fn=None, ask_ahead=1,
                 "pass durability through the provided engine's root= "
                 f"(engine root {service.root!r} != {root!r})"
             )
-    if require_existing:
-        from .serve.service import StudyPersistence
+    try:
+        if require_existing:
+            from .serve.service import StudyPersistence
 
-        probe = StudyPersistence(
-            service.root, CLIENT_STUDY, None, fs=service.fs
-        )
-        if not probe.exists():
-            probe.close()
-            raise CheckpointError(
-                f"resume_from root {service.root!r} holds no "
-                f"{CLIENT_STUDY!r} study artifacts; pass "
-                "trials_save_file= to start a fresh recoverable run "
-                "instead"
+            probe = StudyPersistence(
+                service.root, CLIENT_STUDY, None, fs=service.fs
             )
-        probe.close()
+            if not probe.exists():
+                probe.close()
+                raise CheckpointError(
+                    f"resume_from root {service.root!r} holds no "
+                    f"{CLIENT_STUDY!r} study artifacts; pass "
+                    "trials_save_file= to start a fresh recoverable "
+                    "run instead"
+                )
+            probe.close()
 
-    host_algo = None
-    if spec.name == "atpe":
-        # the hook closes over the LIVE trials store; on restore it is
-        # rebound below once the rebuilt store exists
-        host_algo = _make_host_hook(spec, domain, trials)
-    handle = service.create_study(CLIENT_STUDY, seed=0,
-                                  host_algo=host_algo)
-    study = handle._study
-    restored = bool(
-        study.n_tells or study.pending_asks or study.outstanding
-        or study.client_blob or study.n_asks
-    )
-    client = EngineClient(
-        service, handle, spec, domain, trials, rstate,
-        ask_ahead=ask_ahead, owns_service=owns,
-        max_submits=max_submits, restored=restored,
-    )
-    if restored:
-        trials = client.rebuild_trials(trials)
-        rstate = study.rstate  # the post-draw cursor of the last ask
-        client.rstate = rstate
+        host_algo = None
         if spec.name == "atpe":
-            study.host_algo = _make_host_hook(spec, domain, trials)
-        logger.info(
-            "resumed %d trial doc(s) from %r (study %r); rstate cursor "
-            "restored -- the suggestion stream continues exactly where "
-            "the previous run stopped",
-            len(trials), service.root, CLIENT_STUDY,
+            # the hook closes over the LIVE trials store; on restore it
+            # is rebound below once the rebuilt store exists
+            host_algo = _make_host_hook(spec, domain, trials)
+        # allocate-and-create under the registry lock: two co-batched
+        # clients racing to open their studies must not both pick the
+        # same free name
+        with _SHARED_LOCK:
+            study_name = _alloc_study_name(service)
+            handle = service.create_study(study_name, seed=0,
+                                          host_algo=host_algo)
+        study = handle._study
+        restored = bool(
+            study.n_tells or study.pending_asks or study.outstanding
+            or study.client_blob or study.n_asks
         )
-    else:
-        if trials is None:
-            trials = Trials()
-        client.trials = trials
-        # the study's stream IS fmin's stream: submit-time seeds come
-        # off the driver's own rstate
-        study.rstate = rstate
-    # depth-k window, posterior-fresh by construction
-    study.fresh_window = 1
-    client.arm_durability()
-    return client, trials, rstate, restored
+        client = EngineClient(
+            service, handle, spec, domain, trials, rstate,
+            ask_ahead=ask_ahead, owns_service=owns,
+            max_submits=max_submits, restored=restored,
+            shared_key=shared_key,
+        )
+        if restored:
+            trials = client.rebuild_trials(trials)
+            rstate = study.rstate  # the post-draw cursor of the last ask
+            client.rstate = rstate
+            if spec.name == "atpe":
+                study.host_algo = _make_host_hook(spec, domain, trials)
+            logger.info(
+                "resumed %d trial doc(s) from %r (study %r); rstate "
+                "cursor restored -- the suggestion stream continues "
+                "exactly where the previous run stopped",
+                len(trials), service.root, study_name,
+            )
+        else:
+            if trials is None:
+                trials = Trials()
+            client.trials = trials
+            # the study's stream IS fmin's stream: submit-time seeds
+            # come off the driver's own rstate
+            study.rstate = rstate
+        # depth-k window, posterior-fresh by construction
+        study.fresh_window = 1
+        client.arm_durability()
+        return client, trials, rstate, restored
+    except BaseException:
+        # a failed connect must not strand its registry hold
+        if shared_key is not None:
+            with _SHARED_LOCK:
+                entry = _SHARED_SERVICES.get(shared_key)
+                if entry is not None and entry[0] is service:
+                    entry[1] -= 1
+                    if entry[1] <= 0:
+                        del _SHARED_SERVICES[shared_key]
+                        service.shutdown()
+        raise

@@ -103,10 +103,20 @@ DRIVER_CRASH_POINTS = (
 #:     serve_after_dispatch_before_ack  device state committed, clients
 #:                                      not yet acked / served records
 #:                                      not yet logged
+#:     serve_group_commit_after_flush_before_barrier
+#:                                      the round's tells are flushed
+#:                                      (kernel-visible, process-crash
+#:                                      safe) but the group-commit
+#:                                      round barrier has not fsynced
+#:                                      yet -- a kill here loses only
+#:                                      what a machine crash could tear,
+#:                                      and replay restores exactly the
+#:                                      flushed prefix with zero dupes
 SERVE_CRASH_POINTS = (
     "serve_after_wal_before_dispatch",
     "serve_mid_batch",
     "serve_after_dispatch_before_ack",
+    "serve_group_commit_after_flush_before_barrier",
 )
 
 #: crash points of the CHUNKED device loop's host loop

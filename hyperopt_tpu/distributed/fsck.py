@@ -489,7 +489,12 @@ def audit_driver(path, fs=REAL_FS, tmp_grace=60.0):
                 "orphaned_snapshot_tmp", full, f"age {age:.0f}s"
             ))
     # WAL integrity: a torn tail is normal crash residue (repairable by
-    # truncation); a mid-file checksum failure is not ours to truncate
+    # truncation); a mid-file checksum failure is not ours to truncate.
+    # Under graftburst group-commit the window widens: a machine crash
+    # between a round's flushes and its barrier fsync can tear (or drop)
+    # the whole unbarriered suffix, not just one record -- the same
+    # truncate-to-valid-prefix repair covers it, and the barriered
+    # prefix is exactly what replay restores
     wal = TellWAL(path + ".wal", fs=fs)
     wal_guard = None
     if wal.exists():

@@ -729,7 +729,13 @@ def _run_engine_client(fn, space, algo, max_evals, timeout,
     """The ``fmin(engine=...)`` body (graftclient): open a study on an
     in-process serve engine and drive the sequential loop through
     ``StudyHandle.ask``/``tell`` with a depth-k ask-ahead window --
-    the solo fused path's job, done by the one engine (ISSUE 15)."""
+    the solo fused path's job, done by the one engine (ISSUE 15).
+
+    Since graftburst, ``engine=True`` goes through the client module's
+    shared-service registry: concurrent ``fmin`` calls of the same
+    study family (root, space, algo + knobs, objective) co-batch into
+    ONE scheduler's vmapped rounds, each stream bitwise its solo run;
+    the last client out shuts the shared service down."""
     from .client import connect
 
     if max_queue_len != 1:
@@ -812,9 +818,16 @@ def _run_engine_client(fn, space, algo, max_evals, timeout,
         client=client,
     )
     rval.catch_eval_exceptions = catch_eval_exceptions
-    rval.exhaust()
-    # orderly completion only: a crash (SimulatedCrash, uncaught
-    # objective error) must leave the WAL as the truth, un-compacted
+    try:
+        rval.exhaust()
+    except BaseException:
+        # a crash (SimulatedCrash, uncaught objective error) must
+        # leave the WAL as the truth, un-compacted -- but the
+        # co-batching registry hold is dropped so a same-process
+        # retry restores from disk, not from the dead run's service
+        client.abandon()
+        raise
+    # orderly completion only
     client.finalize()
     return _fmin_result(trials, return_argmin)
 

@@ -537,6 +537,15 @@ class Fleet:
         )
         return total
 
+    def wal_fsyncs_per_tell(self):
+        """Fleet-wide fsync amortization: WAL fsyncs issued per tell
+        absorbed.  Per-tell fsync pins this at >= 1.0; group-commit
+        (graftburst) drops it toward 1/round-size -- the bench stamps
+        it as ``wal_fsyncs_per_tell``."""
+        c = self.counters()
+        tells = c.get("wal_tells", 0)
+        return (c.get("wal_fsyncs", 0) / tells) if tells else 0.0
+
     def shutdown(self):
         for r in self.replicas.values():
             if not r.dead:

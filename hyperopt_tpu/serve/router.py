@@ -40,6 +40,7 @@ from ..distributed.faults import REAL_FS, SimulatedCrash
 from ..exceptions import OwnershipLost, ReplicaDead
 from ..obs.expo import merge_rows, render_prometheus, tag_rows
 from ..obs.registry import LATENCY_BUCKETS_S, MetricsRegistry
+from .frames import FrameConn, FrameError
 
 logger = logging.getLogger(__name__)
 
@@ -313,16 +314,25 @@ class RouterServer:
         with self._lock:
             return frozenset(self._dead)
 
+    def _conn(self, conns, rid, timeout=30.0):
+        """This thread's negotiated :class:`FrameConn` to ``rid``
+        (opened + hello'd on first use): binary frames against a
+        graftburst backend, JSON-lines against an old one -- the
+        fallback is the negotiation's, not ours."""
+        c = conns.get(rid)
+        if c is None:
+            c = conns[rid] = FrameConn(
+                self.backends[rid].connect(timeout=timeout)
+            )
+        return c
+
+    def _drop_conn(self, conns, rid):
+        c = conns.pop(rid, None)
+        if c is not None:
+            c.close()
+
     def _rpc(self, conns, rid, req, timeout=30.0):
-        f = conns.get(rid)
-        if f is None:
-            f = conns[rid] = self.backends[rid].connect(timeout=timeout)
-        f.write((json.dumps(req) + "\n").encode("utf-8"))
-        f.flush()
-        line = f.readline()
-        if not line:
-            raise ConnectionError(f"backend {rid} closed the connection")
-        return json.loads(line)
+        return self._conn(conns, rid, timeout=timeout).call(req)
 
     def handle_request(self, req, conns):
         """Route one request; ``conns`` is the calling thread's
@@ -337,10 +347,13 @@ class RouterServer:
             return self._aggregate_metrics(conns)
         if op == "trace":
             return self._aggregate_trace(conns, req.get("tail"))
+        if op == "ask_batch":
+            return self._ask_batch(req, conns)
         name = req.get("name") or req.get("study")
         if not name:
             return {"ok": False, "error": f"op {op!r} needs a study name"}
         last_exc = None
+        draining_reply = None
         for _attempt in range(1 + len(self.backends)):
             try:
                 rid = self.ring.owner(name, exclude=self._alive_excluded())
@@ -370,10 +383,29 @@ class RouterServer:
                         if op == "ask":
                             req = dict(req, recover=True)
                         reply = self._rpc(conns, rid, req)
+                if (
+                    not reply.get("ok")
+                    and reply.get("error_type") == "Overloaded"
+                    and reply.get("reason") == "draining"
+                    and reply.get("retry_after") is not None
+                ):
+                    # a draining backend names its own comeback time
+                    # (jittered server-side, PR 16): honor it, capped,
+                    # and retry -- bounded by this attempt loop, so a
+                    # backend that drains forever still ends in a typed
+                    # refusal, never a hang
+                    from .service import RETRY_AFTER_CAP
+
+                    draining_reply = reply
+                    time.sleep(min(  # graftlint: disable=GL303 the sleep IS the server's typed retry_after hint, capped and bounded by the attempt budget
+                        float(reply["retry_after"]), RETRY_AFTER_CAP
+                    ))
+                    continue
                 return reply
-            except (OSError, ConnectionError, json.JSONDecodeError) as e:
+            except (OSError, ConnectionError, FrameError,
+                    json.JSONDecodeError) as e:
                 last_exc = e
-                conns.pop(rid, None)
+                self._drop_conn(conns, rid)
                 self._mark_dead(rid)
                 logger.warning(
                     "router: backend %s unreachable (%s); failing over",
@@ -382,10 +414,77 @@ class RouterServer:
                 if op == "ask":
                     req = dict(req, recover=True)
                 continue
+        if draining_reply is not None:
+            # the backend outlasted the retry budget still draining:
+            # hand the TYPED backpressure to the client, whose own
+            # backoff loop owns the longer wait -- never ReplicaDead
+            return draining_reply
         return {
             "ok": False, "error_type": "ReplicaDead",
             "error": f"no backend could serve {name!r}: {last_exc}",
         }
+
+    def _ask_batch(self, req, conns):
+        """The coalesced fleet ask over TCP: group names by ring owner,
+        SUBMIT one ``ask_batch`` frame per backend (all in flight at
+        once -- the pipelining half of graftburst), then drain.  Names
+        whose backend died, isn't loaded (UnknownStudy -> adoption), or
+        predates ``ask_batch`` fall back to the per-name
+        :meth:`handle_request` path with its full failover policy."""
+        names = [str(n) for n in (req.get("names") or ())]
+        timeout = float(req.get("timeout") or 60.0)
+        results, retry, flights = {}, [], []
+        by_rid = {}
+        for name in names:
+            try:
+                rid = self.ring.owner(
+                    name, exclude=self._alive_excluded()
+                )
+            except ReplicaDead as e:
+                results[name] = {"ok": False, "error": str(e),
+                                 "error_type": "ReplicaDead"}
+                continue
+            by_rid.setdefault(rid, []).append(name)
+        for rid, group in by_rid.items():
+            try:
+                c = self._conn(conns, rid)
+                flights.append((rid, group, c, c.submit({
+                    "op": "ask_batch", "names": group,
+                    "timeout": timeout,
+                })))
+            except (OSError, ConnectionError, FrameError):
+                self._drop_conn(conns, rid)
+                self._mark_dead(rid)
+                retry.extend(group)
+        for rid, group, c, fut in flights:
+            try:
+                reply = c.drain(fut)
+            except (OSError, ConnectionError, FrameError,
+                    json.JSONDecodeError):
+                self._drop_conn(conns, rid)
+                self._mark_dead(rid)
+                retry.extend(group)
+                continue
+            if not reply.get("ok"):
+                retry.extend(group)  # pre-graftburst backend
+                continue
+            sub = reply.get("results") or {}
+            for name in group:
+                r = sub.get(name)
+                if r is None or (
+                    not r.get("ok")
+                    and r.get("error_type") in (
+                        "UnknownStudy", "OwnershipLost"
+                    )
+                ):
+                    retry.append(name)  # adoption via the per-name path
+                else:
+                    results[name] = r
+        for name in retry:
+            results[name] = self.handle_request(
+                {"op": "ask", "study": name, "timeout": timeout}, conns
+            )
+        return {"ok": True, "results": results}
 
     def _aggregate(self, op, conns):
         replies = {}
@@ -394,8 +493,8 @@ class RouterServer:
                 continue
             try:
                 replies[rid] = self._rpc(conns, rid, {"op": op})
-            except (OSError, ConnectionError) as e:
-                conns.pop(rid, None)
+            except (OSError, ConnectionError, FrameError) as e:
+                self._drop_conn(conns, rid)
                 replies[rid] = {"ok": False, "error": str(e)}
         if op == "ready":
             return {
@@ -426,8 +525,9 @@ class RouterServer:
                 continue
             try:
                 reply = self._rpc(conns, rid, {"op": "metrics"})
-            except (OSError, ConnectionError, json.JSONDecodeError):
-                conns.pop(rid, None)
+            except (OSError, ConnectionError, FrameError,
+                    json.JSONDecodeError):
+                self._drop_conn(conns, rid)
                 continue
             if reply.get("ok"):
                 row_lists.append(
@@ -452,8 +552,9 @@ class RouterServer:
                 reply = self._rpc(
                     conns, rid, {"op": "trace", "tail": tail}
                 )
-            except (OSError, ConnectionError, json.JSONDecodeError):
-                conns.pop(rid, None)
+            except (OSError, ConnectionError, FrameError,
+                    json.JSONDecodeError):
+                self._drop_conn(conns, rid)
                 continue
             if reply.get("ok"):
                 for s in reply.get("spans", []):
@@ -493,8 +594,9 @@ class RouterServer:
                     timeout=self.probe_timeout,
                 )
                 ok = bool(reply.get("ok"))
-            except (OSError, ConnectionError, json.JSONDecodeError):
-                self._probe_conns.pop(rid, None)
+            except (OSError, ConnectionError, FrameError,
+                    json.JSONDecodeError):
+                self._drop_conn(self._probe_conns, rid)
                 ok = False
             self._probe_hist.observe_since(t0)
             if ok:
@@ -559,40 +661,94 @@ class RouterServer:
         self._probe_conns.clear()
 
     def serve_forever(self, host="127.0.0.1", port=0):
-        """Bind the JSON-line front; returns the (not yet serving)
-        ``ThreadingTCPServer`` exactly like ``service.serve_forever``."""
+        """Bind the client front; returns the (not yet serving)
+        ``ThreadingTCPServer`` exactly like ``service.serve_forever``
+        -- including the graftburst hello negotiation, so a binary
+        pipelining client gets frames end to end through the router."""
         import socketserver
+
+        from .frames import PROTO_V2, read_frame, write_frame
 
         router = self
 
         class Handler(socketserver.StreamRequestHandler):
+            def _send(self, reply, binary):
+                if binary:
+                    write_frame(self.wfile, reply)
+                else:
+                    self.wfile.write(
+                        (json.dumps(reply) + "\n").encode("utf-8")
+                    )
+                self.wfile.flush()
+
             def handle(self):
                 conns = {}  # this thread's backend connections
+                binary = False
                 try:
-                    for raw in self.rfile:
-                        line = raw.strip()
-                        if not line:
+                    while True:
+                        if binary:
+                            try:
+                                req = read_frame(self.rfile)
+                            except FrameError as e:
+                                self._send({
+                                    "ok": False, "error": str(e),
+                                    "error_type": "FrameError",
+                                }, binary)
+                                return
+                            if req is None:
+                                return
+                            if not isinstance(req, dict):
+                                self._send({
+                                    "ok": False,
+                                    "error": "frame payload must be a map",
+                                    "error_type": "FrameError",
+                                }, binary)
+                                return
+                        else:
+                            raw = self.rfile.readline()
+                            if not raw:
+                                return
+                            line = raw.strip()
+                            if not line:
+                                continue
+                            try:
+                                req = json.loads(line)
+                            except ValueError as e:
+                                self._send({
+                                    "ok": False,
+                                    "error": f"malformed request line: {e}",
+                                    "error_type": "FrameError",
+                                }, binary)
+                                continue
+                            if not isinstance(req, dict):
+                                self._send({
+                                    "ok": False,
+                                    "error": "request must be a JSON object",
+                                    "error_type": "FrameError",
+                                }, binary)
+                                continue
+                        if req.get("op") == "hello":
+                            proto = min(int(req.get("proto", 1)), PROTO_V2)
+                            reply = {"ok": True, "proto": proto}
+                            if "rid" in req:
+                                reply["rid"] = req["rid"]
+                            self._send(reply, binary)
+                            binary = proto >= PROTO_V2
                             continue
                         try:
-                            reply = router.handle_request(
-                                json.loads(line), conns
-                            )
+                            reply = router.handle_request(req, conns)
                         except Exception as e:  # one bad request must
                             # not kill the connection
                             reply = {
                                 "ok": False,
                                 "error": f"{type(e).__name__}: {e}",
                             }
-                        self.wfile.write(
-                            (json.dumps(reply) + "\n").encode("utf-8")
-                        )
-                        self.wfile.flush()
+                        if "rid" in req:
+                            reply = dict(reply, rid=req["rid"])
+                        self._send(reply, binary)
                 finally:
-                    for f in conns.values():
-                        try:
-                            f.close()
-                        except OSError:
-                            pass
+                    for c in conns.values():
+                        c.close()
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True

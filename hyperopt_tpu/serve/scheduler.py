@@ -129,16 +129,31 @@ def draw_seed(rstate):
     return int(rstate.randint(2**31 - 1))
 
 
+def _cat_set(ps):
+    """The categorical-dim index set, cached on the PackedSpace: the
+    dispatch hot loop calls :func:`dense_to_vals` once per served ask,
+    and rebuilding this set each time was measurable at burst rates."""
+    cat = getattr(ps, "_serve_cat_set", None)
+    if cat is None:
+        cat = frozenset(int(d) for d in ps.cat_idx)
+        try:
+            ps._serve_cat_set = cat
+        except AttributeError:
+            pass  # immutable space container: rebuild per call
+    return cat
+
+
 def dense_to_vals(ps, col_v, col_a):
     """One dense suggestion column -> the {label: value} config dict at
     API types (ints for categorical-family dims, inactive conditional
     dims omitted) -- the serve twin of ``tpe_jax._cast_vals``."""
-    cat = {int(d) for d in ps.cat_idx}
+    cat = _cat_set(ps)
+    labels = ps.labels
     vals = {}
-    for d, label in enumerate(ps.labels):
-        if col_a[d]:
-            v = float(col_v[d])
-            vals[label] = int(round(v)) if d in cat else v
+    for d in np.nonzero(np.asarray(col_a))[0]:
+        d = int(d)
+        v = float(col_v[d])
+        vals[labels[d]] = int(round(v)) if d in cat else v
     return vals
 
 
@@ -311,6 +326,10 @@ class BatchScheduler:
         "asks served by a per-study host_algo hook (graftclient atpe; "
         "NOT part of serve_dispatch_total -- the hook's own device "
         "dispatches are counted on its ObsBuffer)")
+    group_commit_barriers = CounterAttr(
+        "serve_group_commit_barriers_total",
+        "round fsync barriers issued (graftburst group commit: one "
+        "covers every tell flushed since the previous round)")
     ask_latencies = HistogramAttr(
         "serve_ask_latency_seconds", "submit-to-ack ask latency",
         window=METRICS_WINDOW)
@@ -328,7 +347,7 @@ class BatchScheduler:
                  circuit_threshold=CIRCUIT_THRESHOLD, mesh=None,
                  recorder=None, device_metrics_every=0,
                  retry_jitter=0.25, retry_jitter_seed=0,
-                 **algo_kw):
+                 group_commit=True, **algo_kw):
         # graftscope wiring first: the descriptors above resolve
         # through this registry from the first counter touch on
         self.metrics = MetricsRegistry("serve")
@@ -382,6 +401,13 @@ class BatchScheduler:
         self.finite_check = bool(finite_check)
         self.quarantine_trips = int(quarantine_trips)
         self.circuit_threshold = int(circuit_threshold)
+        # graftburst group commit: tells append flush-only (process-
+        # crash safe immediately) and ONE fsync barrier per round --
+        # issued before the dispatch, covering every WAL touched since
+        # the previous round -- establishes the machine-crash
+        # durability point N per-tell fsyncs used to
+        self.group_commit = bool(group_commit)
+        self._pending_barrier = set()  # TellWALs flushed, not barriered
         # the device-fault seam: a DeviceFaultPlan riding the fs plan
         # (REAL_FS has no plan -> None -> zero overhead in production)
         self._device_faults = getattr(
@@ -449,9 +475,15 @@ class BatchScheduler:
             "quarantine_count", "evictions", "watchdog_timeouts",
             "watchdog_retries", "watchdog_recoveries",
             "device_metric_dispatches", "host_algo_served",
+            "group_commit_barriers",
             "ask_latencies", "occupancy", "watchdog_recovery_ms",
         ):
             getattr(self, attr)
+        # graftburst dispatch-path caches (vectorized round
+        # bookkeeping): the per-round delta template and the dummy
+        # PRNG key are reused instead of rebuilt per round
+        self._delta_cache = None
+        self._dummy_key = None
 
     # -- tenancy -----------------------------------------------------------
     def _alloc_slot(self):
@@ -548,7 +580,15 @@ class BatchScheduler:
                 return
             t0 = time.perf_counter() if rec.enabled else 0.0
             if study.persist is not None:
-                study.persist.log_tell(tid, vals, loss, result=result)
+                # group commit: flush-only append (kernel-visible at
+                # once -- process death loses nothing) and register the
+                # WAL for the next round's single fsync barrier
+                study.persist.log_tell(
+                    tid, vals, loss, result=result,
+                    sync=not self.group_commit,
+                )
+                if self.group_commit:
+                    self._pending_barrier.add(study.persist.wal)
             if rec.enabled:
                 t1 = time.perf_counter()
                 rec.record(
@@ -881,8 +921,8 @@ class BatchScheduler:
             return
         # backlog drain: one masked delta per slot per dispatch, FIFO
         while any(len(st.pending) > 1 for st in self._slots.values()):
-            vcol, acol, dloss, didx, dapply = _dummy_delta(
-                self.ps, self._slot_cap
+            vcol, acol, dloss, didx, dapply = self._delta_template(
+                self._slot_cap
             )
             for st in self._slots.values():
                 if len(st.pending) > 1:
@@ -899,53 +939,85 @@ class BatchScheduler:
             self.dispatch_count += 1
             self.delta_drain_dispatches += 1
 
+    def _delta_template(self, s):
+        """The round's delta columns, zeroed (graftburst: one cached
+        allocation reused per round instead of five fresh arrays --
+        safe because the jitted callee copies its np inputs to device
+        synchronously at call time, so by the next round nothing
+        aliases these buffers)."""
+        tmpl = self._delta_cache
+        if tmpl is None or tmpl[2].shape[0] != s:
+            tmpl = _dummy_delta(self.ps, s)
+            self._delta_cache = tmpl
+        else:
+            for arr in tmpl:
+                arr.fill(0)
+        return tmpl
+
     def _pick_round(self):  # graftlint: disable=GL505 shed futures resolve under the round lock by design: the service API attaches no done-callbacks to ask futures (clients block in Future.result, which waits on the future's own condition, never this lock)
         """At most one queued ask per study this round, FIFO.  Expired
         deadlines and closed/quarantined studies are shed here -- a
         request nobody is waiting for must not consume a dispatch
         slot."""
         now = time.perf_counter()
-        picked, leftover, seen = [], collections.deque(), set()
-        while self._asks:
-            req = self._asks.popleft()
-            if req.study.closed:
+        n = len(self._asks)
+        if n == 0:
+            return []
+        reqs = list(self._asks)
+        studies = [r.study for r in reqs]
+        # graftburst: ONE vectorized verdict pass over the queue
+        # instead of a 6-branch python loop per request -- at 10^3-
+        # client queue depths the per-request attribute churn was the
+        # profile's top pick cost.  Semantics are the FIFO originals:
+        # shed closed/quarantined/expired; hold fresh_window-gated asks
+        # (depth-k ask-ahead: the submit-time seed is already fixed,
+        # the later dispatch sees the full posterior); pick the FIRST
+        # eligible ask per study, capped at max_batch.
+        closed = np.fromiter((s.closed for s in studies), bool, n)
+        quar = np.fromiter((s.quarantined for s in studies), bool, n)
+        expired = np.fromiter(
+            ((r.deadline is not None and now >= r.deadline)
+             for r in reqs), bool, n,
+        )
+        gated = np.fromiter(
+            ((s.fresh_window is not None
+              and len(s.outstanding) >= s.fresh_window)
+             for s in studies), bool, n,
+        )
+        shed = closed | quar | expired
+        eligible = np.nonzero(~(shed | gated))[0]
+        # first occurrence per study id in FIFO order (np.unique
+        # returns the first index of each value), capped at max_batch
+        ids = np.fromiter(
+            (id(studies[i]) for i in eligible), np.int64, len(eligible)
+        )
+        _uniq, first = np.unique(ids, return_index=True)
+        chosen = set(np.sort(eligible[first])[: self.max_batch].tolist())
+        picked, leftover = [], collections.deque()
+        for i, req in enumerate(reqs):
+            if shed[i]:
                 self._dec_queue(req)
-                req.future.set_exception(
-                    ValueError(f"study {req.study.name!r} closed")
-                )
-                continue
-            if req.study.quarantined:
+                if closed[i]:
+                    req.future.set_exception(
+                        ValueError(f"study {req.study.name!r} closed")
+                    )
+                elif quar[i]:
+                    req.future.set_exception(StudyQuarantined(
+                        f"study {req.study.name!r} was evicted by the "
+                        "finite-check guard while this ask was queued"
+                    ))
+                else:
+                    self.shed_count += 1
+                    req.future.set_exception(DeadlineExpired(
+                        f"ask tid={req.tid} for study "
+                        f"{req.study.name!r} expired while queued; "
+                        "shed before dispatch"
+                    ))
+            elif i in chosen:
                 self._dec_queue(req)
-                req.future.set_exception(StudyQuarantined(
-                    f"study {req.study.name!r} was evicted by the "
-                    "finite-check guard while this ask was queued"
-                ))
-                continue
-            if req.deadline is not None and now >= req.deadline:
-                self._dec_queue(req)
-                self.shed_count += 1
-                req.future.set_exception(DeadlineExpired(
-                    f"ask tid={req.tid} for study {req.study.name!r} "
-                    "expired while queued; shed before dispatch"
-                ))
-                continue
-            if (
-                req.study.fresh_window is not None
-                and len(req.study.outstanding) >= req.study.fresh_window
-            ):
-                # depth-k ask-ahead gate (graftclient): the study still
-                # owes tells for previously served suggestions, so this
-                # ask stays queued -- its submit-time seed is already
-                # fixed, and the later dispatch will see the full
-                # posterior (bitwise-at-any-depth by construction)
+                picked.append(req)
+            else:
                 leftover.append(req)
-                continue
-            if id(req.study) in seen or len(picked) >= self.max_batch:
-                leftover.append(req)
-                continue
-            seen.add(id(req.study))
-            self._dec_queue(req)
-            picked.append(req)
         self._asks = leftover
         if self.recorder.enabled:
             rec, now2 = self.recorder, time.perf_counter()
@@ -971,15 +1043,25 @@ class BatchScheduler:
         keep propagating: a dead process serves nobody."""
         with self._lock:
             picked = self._pick_round()
-            if not picked:
-                # tells without asks stay staged (or dirty) until the
-                # next ask round -- a tell-only window never dispatches
-                return 0
             try:
+                # group-commit fsync point: every WAL flushed since the
+                # previous round barriers HERE, before the dispatch --
+                # so a round's device work never outruns the durability
+                # of the tells it was conditioned on
+                self._barrier_round()
+                if not picked:
+                    # tells without asks stay staged (or dirty) until
+                    # the next ask round -- a tell-only window never
+                    # dispatches (its barrier just ran above)
+                    return 0
                 served = self._dispatch_round(picked)
                 self._round_failures = 0
                 return served
             except Exception as e:
+                if not picked:
+                    # a barrier failure with no picks has no futures to
+                    # contain it in: surface the fs truth to the caller
+                    raise
                 return self._recover_round(picked, e)
             except BaseException as e:
                 # simulated process death (and real interpreter exits):
@@ -989,6 +1071,32 @@ class BatchScheduler:
                 for req in picked:
                     if not req.future.done():
                         req.future.set_exception(e)
+                raise
+
+    def _barrier_round(self, fire_crashpoint=True):
+        """Issue the round's group-commit barriers (lock held): one
+        fsync per WAL touched by a flush-only tell since the last
+        round.  The ``serve_group_commit_after_flush_before_barrier``
+        crash window sits between the flushed records and their
+        barrier: a kill here loses nothing a process crash could lose
+        (the records are kernel-visible), and replay restores exactly
+        the flushed prefix with zero duplicates.  A WAL whose barrier
+        fails stays registered, so the next round (or :meth:`stop`)
+        retries it; its records remain flushed in the meantime."""
+        if not self._pending_barrier:
+            return
+        if fire_crashpoint:
+            self.fs.crashpoint(
+                "serve_group_commit_after_flush_before_barrier"
+            )
+        pend = list(self._pending_barrier)
+        self._pending_barrier.clear()
+        for i, wal in enumerate(pend):
+            try:
+                if wal.barrier():
+                    self.group_commit_barriers += 1
+            except BaseException:
+                self._pending_barrier.update(pend[i:])
                 raise
 
     def _force_rematerialize(self):
@@ -1162,10 +1270,26 @@ class BatchScheduler:
 
         self._maintain()
         s = self._slot_cap
-        dummy = host_key(0)
-        keys = [dummy] * s
+        if self._dummy_key is None:
+            self._dummy_key = host_key(0)
+        keys = [self._dummy_key] * s
         warm = np.zeros(s, dtype=bool)
-        vcol, acol, dloss, didx, dapply = _dummy_delta(self.ps, s)
+        vcol, acol, dloss, didx, dapply = self._delta_template(s)
+        # vectorized warm mask over the slot table (graftburst): one
+        # fancy-index assignment instead of a per-slot python branch
+        n_slots = len(self._slots)
+        if n_slots:
+            slot_arr = np.fromiter(
+                self._slots.keys(), np.int64, n_slots
+            )
+            counts = np.fromiter(
+                (st.buf.count for st in self._slots.values()),
+                np.int64, n_slots,
+            )
+            warm[slot_arr] = (
+                counts > 0 if self._engine_algo == "anneal"
+                else counts >= self.n_startup_jobs
+            )
         for st in self._slots.values():
             if st.pending:  # at most one left after _maintain
                 n, vc, ac, lo = st.pending.popleft()
@@ -1174,11 +1298,6 @@ class BatchScheduler:
                 dloss[st.slot] = lo
                 didx[st.slot] = n
                 dapply[st.slot] = True
-            warm[st.slot] = (
-                st.buf.count > 0
-                if self._engine_algo == "anneal"
-                else st.buf.count >= self.n_startup_jobs
-            )
         for req in picked:
             keys[req.study.slot] = host_key(req.seed % (2**31 - 1))
         self.fs.crashpoint("serve_mid_batch")
@@ -1384,6 +1503,20 @@ class BatchScheduler:
             self._cond.notify_all()
             t = self._thread
             self._thread = None
+            # group-commit epilogue: no further rounds will run, so the
+            # last window's flushed tells barrier here (not a round --
+            # the crash window does not apply; durable studies that
+            # snapshot on close have already absorbed theirs)
+            try:
+                self._barrier_round(fire_crashpoint=False)
+            except OSError:
+                # shutdown must not hang on a dead mount: the records
+                # are flushed (process-crash safe) and fsck's torn-tail
+                # rule covers the machine-crash window
+                logger.warning(
+                    "group-commit barrier failed during stop; flushed "
+                    "tells remain kernel-visible", exc_info=True,
+                )
             # a stopping batcher must not strand blocked clients:
             # drain the queue promptly instead of letting ask() hang
             # out its full timeout -- but resolve the futures AFTER

@@ -48,6 +48,13 @@ __all__ = [
 
 _NAME_RE = re.compile(r"[A-Za-z0-9._-]{1,120}")
 
+#: ceiling on any server-provided ``retry_after`` hint a backoff loop
+#: will honor (seconds): the hint is advisory pacing, and a pathological
+#: or drain-length value must never turn one retry sleep into the whole
+#: client deadline.  Shared by :meth:`StudyHandle.ask`, the fmin
+#: client's submit loop, and the router's drain-absorbing retry.
+RETRY_AFTER_CAP = 5.0
+
 #: compiled spaces keyed by structural fingerprint: a RESTARTED service
 #: over the same space (the crash-recovery loop, and every test
 #: harness) reuses the PackedSpace -- and with it the program cache the
@@ -129,7 +136,12 @@ class StudyPersistence:
             "served", {"tid": int(tid), "vals": dict(vals)}, sync=False
         )
 
-    def log_tell(self, tid, vals, loss, result=None):
+    def log_tell(self, tid, vals, loss, result=None, sync=True):
+        """``sync=False`` is the group-commit half of the PR-6 idiom:
+        the tell is flushed (kernel-visible, process-crash safe) and
+        the scheduler's per-round :meth:`TellWAL.barrier` establishes
+        the machine-crash durability point for the whole round at one
+        fsync instead of one per tell."""
         body = {"tid": int(tid), "vals": dict(vals), "loss": float(loss)}
         if result is not None:
             # graftclient: the full SONified result dict rides the tell
@@ -137,7 +149,7 @@ class StudyPersistence:
             # (arbitrary objective-returned keys included) from the one
             # unified WAL instead of a driver-WAL twin
             body["result"] = result
-        self.wal.append("tell", body)
+        self.wal.append("tell", body, sync=sync)
         self._tells_since_snap += 1
 
     def log_fail(self, tid, doc=None):
@@ -364,7 +376,12 @@ class StudyHandle:
             except Overloaded as e:
                 if not backoff:
                     raise
-                wait = e.retry_after if e.retry_after else 0.05
+                # honor the server's jittered retry_after hint, capped:
+                # the hint paces the herd, the cap bounds one sleep
+                wait = min(
+                    e.retry_after if e.retry_after else 0.05,
+                    RETRY_AFTER_CAP,
+                )
                 if _time.perf_counter() + wait >= deadline:
                     raise DeadlineExpired(
                         f"study {self._study.name!r}: the service stayed "
@@ -432,7 +449,7 @@ class SuggestService:
                  study_queue_cap=None, dispatch_timeout=None,
                  finite_check=True, mesh=None, owner=None, recorder=None,
                  device_metrics_every=0, retry_jitter=0.25,
-                 retry_jitter_seed=0, **algo_kw):
+                 retry_jitter_seed=0, group_commit=True, **algo_kw):
         self.space = space
         self.ps = _compile_space_cached(space)
         self.root = None if root is None else str(root)
@@ -457,7 +474,8 @@ class SuggestService:
             finite_check=finite_check, mesh=mesh, recorder=recorder,
             device_metrics_every=device_metrics_every,
             retry_jitter=retry_jitter,
-            retry_jitter_seed=retry_jitter_seed, **algo_kw,
+            retry_jitter_seed=retry_jitter_seed,
+            group_commit=group_commit, **algo_kw,
         )
         # graftscope identity: every series and span a fleet replica
         # emits carries its owner id, so the router-side merge can
@@ -728,7 +746,23 @@ class SuggestService:
             "watchdog_recoveries": s.watchdog_recoveries,
             # graftclient accounting
             "host_algo_served": s.host_algo_served,
+            # graftburst accounting: round fsync barriers issued, and
+            # the raw fsync/tell tallies across the open studies'
+            # WALs -- wal_fsyncs / wal_tells is the bench's
+            # ``wal_fsyncs_per_tell`` (1.0 per-tell-fsync regime,
+            # ~1/round-size under group commit)
+            "group_commit_barriers": s.group_commit_barriers,
+            "wal_fsyncs": self._wal_stat("fsyncs"),
+            "wal_tells": self._wal_stat("total_tells"),
         }
+
+    def _wal_stat(self, attr):
+        with self._lock:
+            studies = [h._study for h in self._handles.values()]
+        return sum(
+            int(getattr(st.persist.wal, attr))
+            for st in studies if st.persist is not None
+        )
 
     def metrics_rows(self):
         """graftscope exposition: refresh the point-in-time gauges,
@@ -847,6 +881,61 @@ def _serve_error_reply(e):
     return reply
 
 
+def _ask_batch(service, req):
+    """Coalesced multi-study ask: every admitted ask is submitted
+    BEFORE any round is pumped, so one vmapped dispatch serves the
+    whole group -- the router forwards one ``ask_batch`` frame per
+    backend, preserving coalescing through the pipelined transport
+    (in lockstep per-connection request/response, the server would
+    only ever see one ask at a time)."""
+    import time as _time
+
+    names = list(req.get("studies") or req.get("names") or ())
+    timeout = float(req.get("timeout", 60.0))
+    results, reqs = {}, {}
+    for name in names:
+        with service._lock:
+            handle = service._handles.get(name)
+        if handle is None:
+            results[name] = {
+                "ok": False, "error": f"unknown study {name!r}",
+                "error_type": "UnknownStudy",
+            }
+            continue
+        try:
+            reqs[name] = service._submit(handle._study, timeout=timeout)
+        except ServeError as e:
+            results[name] = _serve_error_reply(e)
+    deadline = _time.perf_counter() + timeout
+    pending = dict(reqs)
+    while pending:
+        stepped = (
+            service.scheduler.step() if not service._background else 0
+        )
+        for name in [n for n, r in pending.items() if r.future.done()]:
+            pending.pop(name)
+        if not pending or _time.perf_counter() >= deadline:
+            break
+        if stepped == 0:
+            _time.sleep(0.001)
+    for name, r in reqs.items():
+        if not r.future.done():
+            # past the deadline the pick loop sheds it; force the drop
+            # so a late round cannot strand the suggestion in flight
+            service.scheduler.drop_request(r)
+        try:
+            tid, vals = r.future.result(timeout=0)
+            results[name] = {"ok": True, "tid": tid, "vals": vals}
+        except ServeError as e:
+            results[name] = _serve_error_reply(e)
+        except Exception as e:
+            results[name] = {
+                "ok": False, "error": f"{type(e).__name__}: {e}",
+                "error_type": type(e).__name__,
+            }
+    return {"ok": True, "results": results}
+
+
 def _handle_request(service, req):
     op = req.get("op")
     try:
@@ -880,6 +969,8 @@ def _handle_request(service, req):
             return {"ok": True, "study": h.name, "n_tells": h.n_tells}
         if op == "studies":
             return {"ok": True, "studies": service.studies()}
+        if op == "ask_batch":
+            return _ask_batch(service, req)
         if op == "drain":
             service.drain(
                 timeout=float(req.get("timeout", 30.0)), block=False
@@ -921,28 +1012,100 @@ def _handle_request(service, req):
 
 
 def serve_forever(service, host="127.0.0.1", port=0):
-    """Bind the JSON-line TCP front; returns the (not yet serving)
+    """Bind the TCP front; returns the (not yet serving)
     ``ThreadingTCPServer`` -- call ``.serve_forever()`` (the console
-    script does) or drive it from a thread (the tests do).  Protocol:
-    one JSON object per request line, one JSON reply line each; every
-    reply carries ``ok`` plus either the result fields or ``error``."""
+    script does) or drive it from a thread (the tests do).
+
+    Protocol: JSON-lines by default (one JSON object per request line,
+    one JSON reply line each; every reply carries ``ok`` plus either
+    the result fields or ``error``).  A client whose first request is
+    ``{"op": "hello", "proto": 2}`` negotiates the connection up to
+    graftburst binary frames (:mod:`~hyperopt_tpu.serve.frames`);
+    replies echo the request's ``rid`` when it carries one, so a
+    pipelining client can keep many requests in flight.  A framing
+    error gets a typed ``FrameError`` reply and the connection closes
+    -- never a hang."""
     import socketserver
 
+    from .frames import PROTO_V2, FrameError, read_frame, write_frame
+
     class Handler(socketserver.StreamRequestHandler):
-        def handle(self):
-            for raw in self.rfile:
-                line = raw.strip()
-                if not line:
-                    continue
-                try:
-                    reply = _handle_request(service, json.loads(line))
-                except Exception as e:  # one bad request must not
-                    # kill the connection; the error rides the reply
-                    reply = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        def _send(self, reply, binary):
+            if binary:
+                write_frame(self.wfile, reply)
+            else:
                 self.wfile.write(
                     (json.dumps(reply) + "\n").encode("utf-8")
                 )
-                self.wfile.flush()
+            self.wfile.flush()
+
+        def handle(self):
+            binary = False
+            while True:
+                if binary:
+                    try:
+                        req = read_frame(self.rfile)
+                    except FrameError as e:
+                        # typed reply, then hang up: past a framing
+                        # error the stream offset is meaningless
+                        self._send({
+                            "ok": False, "error": str(e),
+                            "error_type": "FrameError",
+                        }, binary)
+                        return
+                    if req is None:
+                        return
+                    if not isinstance(req, dict):
+                        self._send({
+                            "ok": False,
+                            "error": "frame payload must be a map",
+                            "error_type": "FrameError",
+                        }, binary)
+                        return
+                else:
+                    raw = self.rfile.readline()
+                    if not raw:
+                        return
+                    line = raw.strip()
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                    except ValueError as e:
+                        self._send({
+                            "ok": False,
+                            "error": f"malformed request line: {e}",
+                            "error_type": "FrameError",
+                        }, binary)
+                        continue
+                    if not isinstance(req, dict):
+                        self._send({
+                            "ok": False,
+                            "error": "request must be a JSON object",
+                            "error_type": "FrameError",
+                        }, binary)
+                        continue
+                if req.get("op") == "hello":
+                    proto = min(int(req.get("proto", 1)), PROTO_V2)
+                    reply = {"ok": True, "proto": proto}
+                    if "rid" in req:
+                        reply["rid"] = req["rid"]
+                    # the ack goes out in the OLD mode; both sides
+                    # switch after it
+                    self._send(reply, binary)
+                    binary = proto >= PROTO_V2
+                    continue
+                try:
+                    reply = _handle_request(service, req)
+                except Exception as e:  # one bad request must not
+                    # kill the connection; the error rides the reply
+                    reply = {
+                        "ok": False,
+                        "error": f"{type(e).__name__}: {e}",
+                    }
+                if "rid" in req:
+                    reply = dict(reply, rid=req["rid"])
+                self._send(reply, binary)
 
     class Server(socketserver.ThreadingTCPServer):
         allow_reuse_address = True

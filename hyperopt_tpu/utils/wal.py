@@ -89,6 +89,11 @@ class TellWAL:
         self._next_seq = None  # lazily established from the file
         self._base_tells = 0
         self._n_tells = 0  # tells appended since the last header
+        #: fsync barriers this WAL has issued (append sync, group-commit
+        #: barrier, header publish, compaction, torn-tail truncation) --
+        #: the numerator of the bench's ``wal_fsyncs_per_tell``
+        self.fsyncs = 0
+        self._unbarriered = False  # flush-only records since the last fsync
 
     # -- scanning ----------------------------------------------------------
     def exists(self):
@@ -170,6 +175,7 @@ class TellWAL:
                 with self.fs.open(tmp, "wb") as f:
                     f.write(raw[:good])
                     self.fs.fsync(f)
+                    self.fsyncs += 1
                 self.fs.rename(tmp, self.path)
 
             _common.with_retries(_truncate, label="wal truncate")
@@ -218,6 +224,7 @@ class TellWAL:
             with self.fs.open(tmp, "w") as f:
                 f.write(_encode_record(self._header_body(self._next_seq, 0)))
                 self.fs.fsync(f)
+                self.fsyncs += 1
             self.fs.rename(tmp, self.path)
         self._f = self.fs.open(self.path, "a")
 
@@ -259,8 +266,11 @@ class TellWAL:
                 self._f.write(line)
                 if sync:
                     self.fs.fsync(self._f)
+                    self.fsyncs += 1
+                    self._unbarriered = False
                 else:
                     self._f.flush()
+                    self._unbarriered = True
             except OSError:
                 # drop the handle and any torn partial record so the
                 # retry appends onto a valid prefix
@@ -285,6 +295,40 @@ class TellWAL:
             if kind == "tell":
                 self._n_tells += 1
         return seq
+
+    def barrier(self):
+        """Group-commit barrier: one fsync covering every flush-only
+        record appended since the last fsync.  Returns True iff a sync
+        was actually issued (no-op when nothing is unbarriered -- safe
+        to call after :meth:`reset` absorbed the records, or twice).
+
+        This is the other half of the ``sync=False`` idiom documented
+        on :meth:`append`: a scheduler round flushes all of its tells
+        per study, then one barrier per touched WAL establishes the
+        same durability point N per-tell fsyncs would have.  A machine
+        crash inside the flush-to-barrier window tears at most the
+        unbarriered suffix, which the torn-tail rule truncates on
+        replay; a process kill in the window loses nothing (flushed
+        records are kernel-visible).
+        """
+        if not self._unbarriered:
+            return False
+        from ..distributed import _common
+
+        def attempt():
+            try:
+                self._ensure_open()
+                self.fs.fsync(self._f)
+            except OSError:
+                # same healing discipline as append: drop the handle so
+                # the retry fsyncs a freshly opened descriptor
+                self.close()
+                raise
+
+        _common.with_retries(attempt, label="wal barrier")
+        self.fsyncs += 1
+        self._unbarriered = False
+        return True
 
     def close(self):
         if self._f is not None:
@@ -328,6 +372,10 @@ class TellWAL:
                 self._header_body(self._next_seq, self.total_tells)
             ))
             self.fs.fsync(f)
+            self.fsyncs += 1
         self.fs.rename(tmp, self.path)
         self._base_tells = self.total_tells
         self._n_tells = 0
+        # every pre-compaction record is in the bundle; nothing left to
+        # barrier
+        self._unbarriered = False

@@ -1,0 +1,29 @@
+"""GL308 true positives: a storage barrier issued per item of a
+batch/round loop in fault-domain library code -- the per-tell fsync
+regime graftburst group-commit retires.  One fsync per record
+serializes the whole round behind N disk barriers."""
+
+import os
+import pickle
+
+
+def durable_pickle(path, obj):
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class RoundLog:
+    def __init__(self, f):
+        self.f = f
+
+    def commit_round(self, records):
+        for rec in records:
+            self.f.write(rec)
+            self.f.flush()
+            os.fsync(self.f.fileno())  # GL308: one barrier PER record
+
+    def publish_all(self, paths, states):
+        for p, s in zip(paths, states):
+            durable_pickle(p, s)  # GL308: durable publish per item

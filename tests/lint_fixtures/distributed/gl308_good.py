@@ -1,0 +1,43 @@
+"""GL308 near-misses: the group-commit shapes the rule must NOT flag.
+Flush per item with ONE fsync after the loop; a barrier-named helper
+fsyncing inside its own retry loop (TellWAL.barrier's shape); and a
+closure merely DEFINED inside a loop -- it runs later, once, not per
+iteration."""
+
+import os
+import pickle
+
+
+def durable_pickle(path, obj):
+    with open(path, "wb") as f:
+        pickle.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class RoundLog:
+    def __init__(self, f):
+        self.f = f
+
+    def commit_round(self, records):
+        # the sanctioned shape: kernel-visible per record, ONE storage
+        # barrier per round
+        for rec in records:
+            self.f.write(rec)
+            self.f.flush()
+        os.fsync(self.f.fileno())
+
+    def barrier_round(self, wals):
+        # a barrier helper retrying each log's own barrier fsync IS
+        # the group-commit fix -- exempt by name
+        for w in wals:
+            os.fsync(w.fileno())
+
+    def arm(self, handles):
+        flushers = []
+        for h in handles:
+            def flush_one(h=h):
+                os.fsync(h.fileno())  # defined in the loop, runs once
+
+            flushers.append(flush_one)
+        return flushers

@@ -22,6 +22,7 @@ def test_bench_prints_one_json_line():
     env["BENCH_OBS_SWEEP"] = "60,120"  # CI-sized obs-scaling sweep
     env["BENCH_SERVE_STUDIES"] = "8"  # CI-sized serve batch
     env["BENCH_SERVE_ROUNDS"] = "3"
+    env["BENCH_BURST_CLIENTS"] = "32"  # CI-sized concurrent-client burst
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=1200, env=env,
@@ -144,6 +145,17 @@ def test_bench_prints_one_json_line():
     assert d["pilot_scale_in_ms"] > 0
     assert d["fleet_studies_per_sec_autoscaled"] > 0
     assert d["replay_fidelity"] == 1.0
+    # round-22 graftburst rows: concurrent binary-frame clients on one
+    # served engine -- aggregate throughput, the group-commit fsync
+    # amortization (per-tell fsync would stamp >= 1.0; group commit
+    # must stay well under it), and co-batched round occupancy. The
+    # graftclient sequential headline must not regress under the
+    # shared-service regime: fmin_client_asks_per_sec stays a
+    # positive stamped row (asserted > 0 above) on every round.
+    assert d["fleet_asks_per_sec_concurrent"] > 0
+    assert 0 <= d["wal_fsyncs_per_tell"] < 0.9
+    assert 0 < d["client_cobatch_occupancy"] <= 1.0
+    assert d["burst_config"]["n_clients"] == 32
     # round-19 graftscope rows: tracing-armed overhead fractions
     # (deterministic zero-extra-dispatch half pinned in test_obs.py;
     # these are the measured wall-clock halves), span throughput, and

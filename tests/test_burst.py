@@ -1,0 +1,753 @@
+"""graftburst acceptance (ISSUE 17): WAL group-commit, multi-client
+co-batching, negotiated binary framing + pipelining, and the capped
+``retry_after`` discipline.
+
+The contract, pinned deterministically:
+
+* GROUP-COMMIT PARITY: a run with one fsync barrier per scheduler
+  round produces bitwise the suggestion stream of the per-tell-fsync
+  run, at a fraction of the fsyncs; a machine crash in the
+  flush-to-barrier window loses ONLY the unbarriered suffix (replay
+  restores exactly the barriered prefix, zero duplicates);
+* CO-BATCHING PARITY: N concurrent ``fmin(engine=True)`` clients of
+  one study family share ONE service (the registry), and each client's
+  loss stream is bitwise its solo sequential run;
+* PROTOCOL NEGOTIATION: binary client vs JSON server (and vice versa)
+  falls back cleanly; a malformed frame is a typed error reply, never
+  a hang; pipelined replies land on the right futures under
+  reordering;
+* BACKOFF CAPS: every retry loop sleeps ``min(server hint,
+  RETRY_AFTER_CAP)``, never the raw hint.
+"""
+
+import io
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import base, hp, tpe_jax
+from hyperopt_tpu.base import Trials
+from hyperopt_tpu.exceptions import Overloaded
+from hyperopt_tpu.serve import SuggestService
+from hyperopt_tpu.serve.frames import (
+    MAX_FRAME,
+    FrameConn,
+    FrameError,
+    pack,
+    read_frame,
+    unpack,
+    write_frame,
+)
+from hyperopt_tpu.serve.service import RETRY_AFTER_CAP, serve_forever
+from hyperopt_tpu.utils.wal import TellWAL
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_armed(monkeypatch):
+    from hyperopt_tpu.analysis import lockdep
+
+    dep = lockdep.arm_scheduler_class(monkeypatch)
+    yield dep
+    assert dep.inversions == 0, dep.errors
+
+
+SPACE = {
+    "x": hp.uniform("x", -5, 5),
+    "c": hp.choice("c", [0, 1, 2]),
+}
+ALGO_KW = dict(n_cand=8, n_cand_cat=4)
+
+
+def loss_fn(vals):
+    return (vals["x"] - 1) ** 2 / 10 + 0.1 * vals["c"]
+
+
+def _spawn(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# WAL group-commit: barrier semantics + torn-window recovery
+# ---------------------------------------------------------------------------
+
+
+def test_wal_barrier_amortizes_fsyncs(tmp_path):
+    wal = TellWAL(str(tmp_path / "w.wal"))
+    for i in range(8):
+        wal.append("tell", {"tid": i, "state": 2}, sync=False)
+    before = wal.fsyncs
+    assert wal.barrier() is True
+    assert wal.fsyncs == before + 1  # ONE fsync covers all 8 records
+    assert wal.barrier() is False  # nothing unbarriered: a no-op
+    assert wal.fsyncs == before + 1
+    wal.close()
+    fresh = TellWAL(str(tmp_path / "w.wal"))
+    assert [r["tid"] for r in fresh.replay()] == list(range(8))
+    assert fresh.total_tells == 8
+    fresh.close()
+
+
+def test_wal_sync_append_clears_barrier_debt(tmp_path):
+    wal = TellWAL(str(tmp_path / "w.wal"))
+    wal.append("tell", {"tid": 0, "state": 2}, sync=False)
+    wal.append("tell", {"tid": 1, "state": 2}, sync=True)
+    # the sync append's fsync covered the flushed predecessor too
+    assert wal.barrier() is False
+    wal.close()
+
+
+def test_machine_crash_in_window_keeps_barriered_prefix(tmp_path):
+    """The widened torn-tail rule: a machine crash between a round's
+    flushes and its barrier can drop the WHOLE unbarriered suffix --
+    replay restores exactly the barriered prefix and appends continue
+    from it, zero duplicates."""
+    path = str(tmp_path / "w.wal")
+    wal = TellWAL(path)
+    for i in range(4):
+        wal.append("tell", {"tid": i, "state": 2}, sync=False)
+    wal.barrier()
+    barriered = os.path.getsize(path)
+    for i in range(4, 7):
+        wal.append("tell", {"tid": i, "state": 2}, sync=False)
+    wal.close()
+    # simulate the lost unsynced suffix: everything past the barrier
+    # is gone, plus a torn half-record straddling the cut
+    with open(path, "r+b") as f:
+        f.truncate(barriered + 7)
+    fresh = TellWAL(path)
+    assert [r["tid"] for r in fresh.replay()] == [0, 1, 2, 3]
+    assert fresh.total_tells == 4
+    assert fresh.append("tell", {"tid": 4, "state": 2}) == 4
+    fresh.close()
+
+
+def _run_rounds(root, group_commit, rounds=6, width=4):
+    svc = SuggestService(
+        SPACE, root=root, max_batch=8, background=False,
+        n_startup_jobs=2, snapshot_cadence=1000, study_queue_cap=8,
+        group_commit=group_commit, **ALGO_KW,
+    )
+    names = ["a", "b", "c", "d"]
+    handles = {n: svc.create_study(n, seed=i) for i, n in enumerate(names)}
+    streams = {n: [] for n in names}
+    for _ in range(rounds):
+        # `width` asks in flight per study: the burst shape whose
+        # tells all land inside ONE barrier window
+        futs = {n: [handles[n].ask_async() for _ in range(width)]
+                for n in names}
+        while not all(f.done() for fs in futs.values() for f in fs):
+            svc.pump()
+        for n, fs in futs.items():
+            for f in fs:
+                tid, vals = f.result(timeout=30)
+                streams[n].append((tid, json.dumps(vals, sort_keys=True)))
+                handles[n].tell(tid, loss_fn(vals))
+    counters = dict(svc.counters)
+    svc.shutdown()
+    return streams, counters
+
+
+def test_group_commit_bitwise_parity_and_fsync_amortization(tmp_path):
+    gc_streams, gc = _run_rounds(str(tmp_path / "gc"), True)
+    pt_streams, pt = _run_rounds(str(tmp_path / "pt"), False)
+    assert gc_streams == pt_streams  # fsync timing is stream-invisible
+    assert gc["wal_tells"] == pt["wal_tells"] == 96
+    assert pt["wal_fsyncs"] >= pt["wal_tells"]  # per-tell: one each
+    assert gc["group_commit_barriers"] > 0
+    assert pt["group_commit_barriers"] == 0
+    # one barrier per WAL per round (plus the per-study header/guard
+    # publishes), NOT one fsync per tell
+    assert gc["wal_fsyncs"] < 0.4 * pt["wal_fsyncs"]
+    assert gc["wal_fsyncs"] / gc["wal_tells"] < 0.4
+
+
+def test_group_commit_crash_window_zero_lost_zero_duplicate(tmp_path):
+    """Kill in the new flush-to-barrier crash window: the acked
+    (flushed) tell survives a process crash, restore sees it exactly
+    once, and a client re-tell dedups."""
+    from hyperopt_tpu.distributed.faults import FaultPlan, SimulatedCrash
+
+    root = str(tmp_path / "gc")
+    plan = FaultPlan(seed=0).arm(
+        "serve_group_commit_after_flush_before_barrier", at=1
+    )
+    svc = SuggestService(
+        SPACE, root=root, fs=plan.fs(), max_batch=8, background=False,
+        n_startup_jobs=2, snapshot_cadence=1000, **ALGO_KW,
+    )
+    h = svc.create_study("a", seed=5)
+    fut = h.ask_async()
+    svc.pump()
+    tid, vals = fut.result(timeout=30)
+    h.tell(tid, loss_fn(vals))  # flushed, barrier still pending
+    with pytest.raises(SimulatedCrash):
+        h.ask_async()
+        svc.pump()  # the next round's barrier hits the armed point
+    assert plan.stats[
+        "crash:serve_group_commit_after_flush_before_barrier"
+    ] == 1
+    svc2 = SuggestService(
+        SPACE, root=root, fs=FaultPlan(seed=1).fs(), max_batch=8,
+        background=False, n_startup_jobs=2, snapshot_cadence=1000,
+        **ALGO_KW,
+    )
+    h2 = svc2.create_study("a", seed=5)
+    st = svc2.scheduler.study("a")
+    assert st.buf.count == 1  # the flushed tell survived the crash
+    assert st.persist.wal.total_tells == 1
+    h2.tell(tid, loss_fn(vals), vals=vals)  # lost-ack client re-tell
+    assert st.persist.wal.total_tells == 1  # absorbed exactly once
+    svc2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# frames: codec + framing discipline
+# ---------------------------------------------------------------------------
+
+
+def test_codec_roundtrip():
+    obj = {
+        "op": "ask", "study": "fmin-2", "rid": 7, "f": -2.5,
+        "flags": [True, False, None], "nested": {"k": [1, {"d": 2}]},
+        "blob": b"\x00\xffbytes", "big": 2**40,
+    }
+    assert unpack(pack(obj)) == obj
+
+
+def test_codec_rejects_non_protocol_values():
+    with pytest.raises(TypeError):
+        pack({"bad": object()})
+
+
+def test_codec_typed_errors():
+    with pytest.raises(FrameError):
+        unpack(b"")  # tag past end
+    with pytest.raises(FrameError):
+        unpack(b"\x99")  # unknown tag
+    with pytest.raises(FrameError):
+        unpack(pack("x") + b"junk")  # trailing bytes
+    with pytest.raises(FrameError):
+        unpack(pack({"a": 1})[:-2])  # truncated payload
+
+
+def test_read_frame_discipline():
+    assert read_frame(io.BytesIO(b"")) is None  # clean EOF
+    with pytest.raises(FrameError):
+        read_frame(io.BytesIO(b"\x00\x00\x00\x00"))  # zero length
+    with pytest.raises(FrameError):
+        read_frame(io.BytesIO(
+            (MAX_FRAME + 1).to_bytes(4, "big")
+        ))  # hostile length prefix must not allocate
+    with pytest.raises(FrameError):
+        read_frame(io.BytesIO(b"\x00\x00\x00\x08" + b"ab"))  # short body
+    buf = io.BytesIO()
+    write_frame(buf, {"ok": True})
+    buf.seek(0)
+    assert read_frame(buf) == {"ok": True}
+
+
+# ---------------------------------------------------------------------------
+# negotiation + pipelining over real sockets
+# ---------------------------------------------------------------------------
+
+
+def _tcp_service(**kw):
+    svc = SuggestService(
+        SPACE, background=True, max_batch=8, n_startup_jobs=2,
+        **ALGO_KW, **kw,
+    )
+    srv = serve_forever(svc, port=0)
+    _spawn(srv)
+    return svc, srv
+
+
+def _teardown(svc, srv):
+    srv.shutdown()
+    srv.server_close()
+    svc.shutdown()
+
+
+def test_binary_pipelining_end_to_end():
+    svc, srv = _tcp_service()
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    try:
+        assert conn.binary is True  # negotiated up
+        # four requests in flight before the first reply is read
+        futs = [
+            conn.submit({"op": "ping"}),
+            conn.submit({"op": "create_study", "name": "s", "seed": 3}),
+            conn.submit({"op": "ask", "study": "s", "timeout": 30}),
+            conn.submit({"op": "studies"}),
+        ]
+        ping, created, ask, studies = [conn.drain(f) for f in futs]
+        assert ping["pong"] is True
+        assert created["ok"], created
+        assert ask["ok"], ask
+        assert studies["studies"] == ["s"]
+        told = conn.call({
+            "op": "tell", "study": "s", "tid": ask["tid"], "loss": 0.5,
+        })
+        assert told["ok"], told
+    finally:
+        conn.close()
+        sock.close()
+        _teardown(svc, srv)
+
+
+def test_json_client_against_binary_server():
+    """An old client never says hello: the connection stays JSON-lines
+    end to end (the server-side fallback)."""
+    svc, srv = _tcp_service()
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    f = sock.makefile("rwb")
+
+    def rpc(**req):
+        f.write((json.dumps(req) + "\n").encode())
+        f.flush()
+        return json.loads(f.readline())
+
+    try:
+        assert rpc(op="ping")["pong"] is True
+        assert rpc(op="create_study", name="s", seed=3)["ok"]
+        r = rpc(op="ask", study="s", timeout=30)
+        assert r["ok"], r
+        assert rpc(op="tell", study="s", tid=r["tid"], loss=0.5)["ok"]
+    finally:
+        f.close()
+        sock.close()
+        _teardown(svc, srv)
+
+
+class _OldJsonServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class _OldJsonHandler(socketserver.StreamRequestHandler):
+    """A pre-graftburst peer: JSON lines, strictly in order, no rid
+    echo, and ``hello`` is an unknown op."""
+
+    def handle(self):
+        for raw in self.rfile:
+            req = json.loads(raw)
+            if req.get("op") == "hello":
+                reply = {"ok": False, "error": "unknown op 'hello'"}
+            else:
+                reply = {"ok": True, "echo": req.get("n")}
+            self.wfile.write((json.dumps(reply) + "\n").encode())
+            self.wfile.flush()
+
+
+def test_binary_client_against_json_server_falls_back():
+    srv = _OldJsonServer(("127.0.0.1", 0), _OldJsonHandler)
+    _spawn(srv)
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    try:
+        assert conn.binary is False  # the old server declined hello
+        futs = [conn.submit({"op": "x", "n": i}) for i in range(3)]
+        for i, fut in enumerate(futs):
+            # rid-less in-order replies resolve FIFO onto the right
+            # futures
+            assert conn.drain(fut)["echo"] == i
+    finally:
+        conn.close()
+        sock.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+class _ReorderHandler(socketserver.StreamRequestHandler):
+    """A binary server that answers two pipelined requests in REVERSE
+    order: only rid correlation can land them correctly."""
+
+    def handle(self):
+        self.rfile.readline()  # the hello line
+        self.wfile.write(
+            (json.dumps({"ok": True, "proto": 2}) + "\n").encode()
+        )
+        self.wfile.flush()
+        reqs = [read_frame(self.rfile), read_frame(self.rfile)]
+        for req in reversed(reqs):
+            write_frame(self.wfile, {
+                "ok": True, "echo": req["n"], "rid": req["rid"],
+            })
+        self.wfile.flush()
+
+
+def test_pipelined_replies_reordered_land_on_correct_futures():
+    srv = _OldJsonServer(("127.0.0.1", 0), _ReorderHandler)
+    _spawn(srv)
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    try:
+        assert conn.binary is True
+        f0 = conn.submit({"op": "x", "n": 0})
+        f1 = conn.submit({"op": "x", "n": 1})
+        assert conn.drain(f0)["echo"] == 0  # reply for f1 arrives first
+        assert f1.result(timeout=0)["echo"] == 1
+    finally:
+        conn.close()
+        sock.close()
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_malformed_frame_is_typed_error_not_hang():
+    svc, srv = _tcp_service()
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    try:
+        assert conn.binary is True
+        conn.f.write(b"\x00\x00\x00\x00")  # a zero-length "frame"
+        conn.f.flush()
+        sock.shutdown(socket.SHUT_WR)
+        reply = read_frame(conn.f)
+        assert reply["ok"] is False
+        assert reply["error_type"] == "FrameError"
+        assert read_frame(conn.f) is None  # server hung up cleanly
+    finally:
+        conn.close()
+        sock.close()
+        _teardown(svc, srv)
+
+
+def test_truncated_frame_is_typed_error_not_hang():
+    svc, srv = _tcp_service()
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    try:
+        assert conn.binary is True
+        conn.f.write(b"\x00\x00\x00\x64" + b"short")  # 100 declared, 5 sent
+        conn.f.flush()
+        sock.shutdown(socket.SHUT_WR)  # EOF mid-frame on the server
+        reply = read_frame(conn.f)
+        assert reply["ok"] is False
+        assert reply["error_type"] == "FrameError"
+    finally:
+        conn.close()
+        sock.close()
+        _teardown(svc, srv)
+
+
+def test_ask_batch_over_tcp_coalesces():
+    svc, srv = _tcp_service()
+    sock = socket.create_connection(srv.server_address[:2], timeout=30)
+    conn = FrameConn(sock.makefile("rwb"))
+    names = ["a", "b", "c"]
+    try:
+        for i, n in enumerate(names):
+            assert conn.call(
+                {"op": "create_study", "name": n, "seed": 10 + i}
+            )["ok"]
+        reply = conn.call({
+            "op": "ask_batch", "names": names, "timeout": 30,
+        })
+        assert reply["ok"], reply
+        for n in names:
+            r = reply["results"][n]
+            assert r["ok"], (n, r)
+            assert conn.call({
+                "op": "tell", "study": n, "tid": r["tid"], "loss": 0.5,
+            })["ok"]
+        missing = conn.call({
+            "op": "ask_batch", "names": ["nope"], "timeout": 5,
+        })
+        assert missing["results"]["nope"]["error_type"] == "UnknownStudy"
+    finally:
+        conn.close()
+        sock.close()
+        _teardown(svc, srv)
+
+
+# ---------------------------------------------------------------------------
+# the capped retry_after discipline (satellite 6)
+# ---------------------------------------------------------------------------
+
+
+def _connect_client(svc, **kw):
+    from hyperopt_tpu.client import connect
+
+    domain = base.Domain(loss_fn, SPACE)
+    return connect(
+        svc, tpe_jax.suggest, domain, Trials(),
+        np.random.default_rng(0), fn=loss_fn, **kw,
+    )
+
+
+def test_submit_one_backoff_sleeps_capped(monkeypatch):
+    svc = SuggestService(
+        SPACE, max_batch=8, background=False, n_startup_jobs=2,
+        **ALGO_KW,
+    )
+    client, _, _, _ = _connect_client(svc, ask_ahead=1, max_submits=5)
+    refusals = iter([99.0, 42.0])
+    orig = svc._submit
+
+    def flaky(study, timeout=None, replay=None):
+        try:
+            ra = next(refusals)
+        except StopIteration:
+            return orig(study, timeout=timeout, replay=replay)
+        raise Overloaded("busy", retry_after=ra, reason="queue_full")
+
+    monkeypatch.setattr(svc, "_submit", flaky)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    client._submit_one(time.perf_counter() + 60.0)
+    # the wild 99s hint and the 42s hint both sleep the CAP, not the
+    # raw server value
+    assert sleeps == [RETRY_AFTER_CAP, RETRY_AFTER_CAP]
+    svc.shutdown()
+
+
+def test_handle_ask_backoff_sleeps_capped(monkeypatch):
+    svc = SuggestService(
+        SPACE, max_batch=8, background=False, n_startup_jobs=2,
+        **ALGO_KW,
+    )
+    h = svc.create_study("a", seed=3)
+    refusals = iter([77.0, 2.0])
+    orig = svc._submit
+
+    def flaky(study, timeout=None, replay=None):
+        try:
+            ra = next(refusals)
+        except StopIteration:
+            return orig(study, timeout=timeout, replay=replay)
+        raise Overloaded("busy", retry_after=ra, reason="queue_full")
+
+    monkeypatch.setattr(svc, "_submit", flaky)
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    tid, vals = h.ask(timeout=60.0, backoff=True)
+    assert vals
+    # capped hint first, then the modest hint verbatim
+    assert sleeps == [RETRY_AFTER_CAP, 2.0]
+    svc.shutdown()
+
+
+def test_router_draining_retry_sleeps_capped_and_stays_typed(monkeypatch):
+    from hyperopt_tpu.serve import router as router_mod
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+
+    r = RouterServer([_Backend("b0", "127.0.0.1", 1)])
+    draining = {
+        "ok": False, "error_type": "Overloaded", "reason": "draining",
+        "retry_after": 123.0, "error": "draining for restart",
+    }
+    monkeypatch.setattr(
+        r, "_rpc", lambda conns, rid, req, timeout=30.0: dict(draining)
+    )
+    sleeps = []
+    monkeypatch.setattr(
+        router_mod.time, "sleep", lambda s: sleeps.append(s)
+    )
+    reply = r.handle_request({"op": "ask", "study": "s"}, {})
+    # the backend outlasted the retry budget: the TYPED backpressure
+    # reaches the client (whose own backoff owns the longer wait)
+    assert reply["error_type"] == "Overloaded"
+    assert reply["reason"] == "draining"
+    assert sleeps and all(s == RETRY_AFTER_CAP for s in sleeps)
+
+
+# ---------------------------------------------------------------------------
+# co-batching: the shared-service registry
+# ---------------------------------------------------------------------------
+
+
+def test_ask_ahead_clamped_to_study_queue_cap():
+    svc = SuggestService(
+        SPACE, max_batch=8, background=False, n_startup_jobs=2,
+        study_queue_cap=3, **ALGO_KW,
+    )
+    client, _, _, _ = _connect_client(svc, ask_ahead=99, max_submits=5)
+    assert client.ask_ahead == 3  # an unclamped window would spin the
+    client.finalize()             # backoff loop against the cap
+    svc.shutdown()
+
+
+def test_explicit_engine_hosts_multiple_clients():
+    """The retired max_batch=1 regime's other half: a caller-provided
+    engine now hosts N client studies (fmin, fmin-2, ...) instead of
+    refusing the second connect."""
+    svc = SuggestService(
+        SPACE, max_batch=8, background=False, n_startup_jobs=2,
+        **ALGO_KW,
+    )
+    c1, _, _, _ = _connect_client(svc, ask_ahead=1, max_submits=5)
+    c2, _, _, _ = _connect_client(svc, ask_ahead=1, max_submits=5)
+    assert c1.study_name == "fmin"
+    assert c2.study_name == "fmin-2"
+    c1.finalize()
+    c2.finalize()
+    svc.shutdown()
+
+
+def test_concurrent_fmin_cobatch_one_service_bitwise_solo():
+    """The tentpole: overlapping ``fmin(engine=True)`` calls of one
+    study family ride ONE service, and every stream is bitwise the
+    solo sequential run with the same rstate seed."""
+    import hyperopt_tpu.serve as serve
+    from hyperopt_tpu import client as client_mod
+    from hyperopt_tpu import fmin
+
+    seeds = [7, 8, 9]
+    n_evals = 8
+
+    def run_one(seed, objective):
+        t = Trials()
+        fmin(
+            objective, SPACE, algo=tpe_jax.suggest, max_evals=n_evals,
+            trials=t, rstate=np.random.default_rng(seed), engine=True,
+            show_progressbar=False,
+        )
+        return [d["result"]["loss"] for d in t.trials]
+
+    solo = {s: run_one(s, loss_fn) for s in seeds}
+    assert not client_mod._SHARED_SERVICES  # sequential: drained
+
+    built = []
+    orig_init = serve.SuggestService.__init__
+
+    def counting_init(self, *a, **kw):
+        built.append(1)
+        return orig_init(self, *a, **kw)
+
+    gate = threading.Barrier(len(seeds), timeout=120)
+    first_wave = threading.Semaphore(len(seeds))
+
+    def overlapping(vals):
+        if first_wave.acquire(blocking=False):
+            gate.wait()  # force all three runs to overlap temporally
+        return loss_fn(vals)
+
+    results = {}
+    serve.SuggestService.__init__ = counting_init
+    try:
+        threads = [
+            threading.Thread(
+                target=lambda s=s: results.update(
+                    {s: run_one(s, overlapping)}
+                )
+            )
+            for s in seeds
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+    finally:
+        serve.SuggestService.__init__ = orig_init
+    assert len(built) == 1, f"{len(built)} services for {len(seeds)} fmins"
+    assert not client_mod._SHARED_SERVICES  # last client out cleaned up
+    for s in seeds:
+        assert results[s] == solo[s], f"seed {s} diverged from solo"
+
+
+# ---------------------------------------------------------------------------
+# CI gates: the burst modules stay lint- and trace-clean
+# ---------------------------------------------------------------------------
+
+
+def test_burst_modules_lint_and_trace_clean():
+    from hyperopt_tpu.analysis import lint_paths
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = [
+        os.path.join(repo, "hyperopt_tpu", "serve", "frames.py"),
+        os.path.join(repo, "hyperopt_tpu", "serve", "scheduler.py"),
+        os.path.join(repo, "hyperopt_tpu", "serve", "service.py"),
+        os.path.join(repo, "hyperopt_tpu", "serve", "router.py"),
+        os.path.join(repo, "hyperopt_tpu", "utils", "wal.py"),
+        os.path.join(repo, "hyperopt_tpu", "client.py"),
+    ]
+    for pack in ("ast", "trace"):
+        result = lint_paths(paths, pack=pack)
+        assert not result.findings, (pack, result.findings)
+
+
+# ---------------------------------------------------------------------------
+# the 10^3-client soak (slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_thousand_client_soak_typed_errors_only():
+    """10^3 binary pipelining clients against one served engine, a
+    worker pool deep: every reply is ok or a TYPED error (Overloaded /
+    DeadlineExpired backpressure is the signal, never a raw traceback,
+    never a hang), with lockdep armed the whole way."""
+    svc, srv = _tcp_service(max_queue=4096, study_queue_cap=64)
+    addr = srv.server_address[:2]
+    names = [f"s{i}" for i in range(8)]
+    for i, n in enumerate(names):
+        svc.create_study(n, seed=i)
+    failures = []
+    counted = threading.Lock()
+    stats = {"ok": 0, "typed": 0}
+
+    def one_client(i):
+        try:
+            sock = socket.create_connection(addr, timeout=60)
+        except OSError as e:
+            failures.append(("connect", i, str(e)))
+            return
+        try:
+            conn = FrameConn(sock.makefile("rwb"))
+            name = names[i % len(names)]
+            fut = conn.submit({"op": "ask", "study": name, "timeout": 45})
+            r = conn.drain(fut)
+            if r.get("ok"):
+                t = conn.call({
+                    "op": "tell", "study": name, "tid": r["tid"],
+                    "loss": 0.1 + (i % 10) / 100.0,
+                })
+                if not t.get("ok") and not t.get("error_type"):
+                    failures.append(("tell", i, t))
+                with counted:
+                    stats["ok"] += 1
+            elif r.get("error_type"):
+                with counted:
+                    stats["typed"] += 1  # backpressure: the contract
+            else:
+                failures.append(("ask", i, r))
+            conn.close()
+        except Exception as e:  # noqa: BLE001 -- any raw client crash fails the soak
+            failures.append(("client", i, f"{type(e).__name__}: {e}"))
+        finally:
+            sock.close()
+
+    n_clients = 1000
+    pool_width = 32
+    idx = iter(range(n_clients))
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                i = next(idx, None)
+            if i is None:
+                return
+            one_client(i)
+
+    workers = [threading.Thread(target=worker) for _ in range(pool_width)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=600)
+    try:
+        assert not failures, failures[:10]
+        assert stats["ok"] + stats["typed"] == n_clients
+        assert stats["ok"] > 0
+    finally:
+        _teardown(svc, srv)

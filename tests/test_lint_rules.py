@@ -19,6 +19,7 @@ PACK_RULES = [
     "GL101", "GL102", "GL103", "GL104",
     "GL201", "GL202", "GL203",
     "GL301", "GL302", "GL303", "GL304", "GL305", "GL306", "GL307",
+    "GL308",
 ]
 
 
@@ -69,6 +70,9 @@ def test_known_finding_counts():
     # two hand-rolled counter bumps + one ad-hoc timing delta; the
     # underscore-private control attr must contribute none
     assert len(_lint(_fixture_path("GL307", "bad"))) == 3
+    # one per-record fsync + one per-item durable_pickle; the barrier
+    # helpers and the loop-defined closure must contribute none
+    assert len(_lint(_fixture_path("GL308", "bad"))) == 2
 
 
 def test_partial_wrapped_functions_resolve_as_jitted():

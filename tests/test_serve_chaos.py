@@ -236,4 +236,5 @@ def test_serve_points_registered():
         "serve_after_wal_before_dispatch",
         "serve_mid_batch",
         "serve_after_dispatch_before_ack",
+        "serve_group_commit_after_flush_before_barrier",
     }
