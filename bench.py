@@ -1397,6 +1397,122 @@ def bench_burst(space, n_clients=64, n_studies=4, asks_per_client=8,
     }
 
 
+def bench_storm(space, n_replicas=3, n_studies=4, rounds=6, n_cand=128):
+    """The round-23 graftstorm rows: the fleet under a HOSTILE network
+    -- a seeded 10%-reset + latency + truncate storm on the client
+    wire and a mid-run black-hole partition of one backend -- measured
+    over real sockets through the TCP router.  Three rows:
+
+    ``fleet_asks_per_sec_under_storm``
+        aggregate ask+tell throughput with the storm armed -- resets,
+        torn frames, a failover, and a heal all inside the timed
+        window;
+    ``net_fault_recovery_ms``
+        mean wall-clock of the ops that needed at least one transport
+        retry (reconnect + resubmission + any failover adoption) --
+        the price of a fault, not the price of the round;
+    ``net_typed_error_rate``
+        injected transport faults absorbed per client op.  Every one
+        of them surfaced typed and was retried; a raw exception
+        anywhere fails the bench.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from hyperopt_tpu.client import RemoteStudy
+    from hyperopt_tpu.distributed.faults import NetFaultPlan
+    from hyperopt_tpu.serve import SuggestService
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+    from hyperopt_tpu.serve.service import serve_forever
+
+    root = tempfile.mkdtemp(prefix="bench_storm_")
+    services, servers, backends = [], [], []
+    for i in range(n_replicas):
+        svc = SuggestService(
+            space, root=root, owner=f"r{i}", background=True,
+            max_batch=16, n_startup_jobs=3, n_cand=n_cand,
+            snapshot_cadence=1000,
+        )
+        srv = serve_forever(svc, port=0)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        host, port = srv.server_address[:2]
+        services.append(svc)
+        servers.append(srv)
+        backends.append(_Backend(f"r{i}", host, port))
+    # the rate storm lives on the client wire; the router-side plan
+    # carries only the black-hole partition (a rate storm on backend
+    # dials would read as backend death to the failover detector)
+    router_plan = NetFaultPlan(seed=230)
+    router = RouterServer(
+        backends, salt="bench-storm", read_timeout=5.0,
+        net_plan=router_plan,
+    )
+    rsrv = router.serve_forever(port=0)
+    threading.Thread(target=rsrv.serve_forever, daemon=True).start()
+    rhost, rport = rsrv.server_address[:2]
+
+    plan = NetFaultPlan(
+        seed=23, reset_rate=0.10, latency=0.001, truncate_rate=0.05,
+        burst=2,
+    )
+    names = [f"n{i}" for i in range(n_studies)]
+    clients = {
+        n: RemoteStudy(
+            rhost, rport, n, seed=i, net_plan=plan,
+            key=f"client/{n}", read_timeout=5.0,
+        )
+        for i, n in enumerate(names)
+    }
+    victim = router.ring.owner(names[0])
+    pairs = 0
+    recovery = []
+    t0 = time.perf_counter()
+    for r in range(rounds):
+        if r == rounds // 2:
+            router_plan.partition(victim)  # black-hole one backend
+        for n in names:
+            c = clients[n]
+            before = c.stats.get("transport_errors", 0)
+            t_op = time.perf_counter()
+            tid, vals = c.ask(timeout=45)
+            c.tell(tid, 0.1 + (tid % 97) / 100.0, vals)
+            if c.stats.get("transport_errors", 0) > before:
+                recovery.append(time.perf_counter() - t_op)
+            pairs += 1
+        if r == rounds // 2:
+            router_plan.heal(victim)  # partition lifts; probe rejoins
+            router.probe_backends()
+    dt = time.perf_counter() - t0
+    faults = sum(
+        c.stats.get("transport_errors", 0) for c in clients.values()
+    )
+    for c in clients.values():
+        c.close()
+    rsrv.shutdown()
+    rsrv.server_close()
+    for srv in servers:
+        srv.shutdown()
+        srv.server_close()
+    for svc in services:
+        svc.shutdown()
+    shutil.rmtree(root, ignore_errors=True)
+    ops = pairs * 2  # one ask + one tell per pair
+    return {
+        "fleet_asks_per_sec_under_storm": round(pairs / dt, 1),
+        "net_fault_recovery_ms": (
+            round(1000.0 * sum(recovery) / len(recovery), 2)
+            if recovery else 0.0
+        ),
+        "net_typed_error_rate": round(faults / ops, 4),
+        "storm_config": {
+            "n_replicas": n_replicas, "n_studies": n_studies,
+            "rounds": rounds, "reset_rate": 0.10,
+            "truncate_rate": 0.05, "faulted_ops": len(recovery),
+        },
+    }
+
+
 def bench_best_at_1k_device_loop(n_trials=1000, n_cand=128, seed=7,
                                  batch_size=32):
     """The same 1k-trial experiment as ONE on-device program
@@ -1661,6 +1777,17 @@ def main():
         asks_per_client=int(os.environ.get("BENCH_BURST_ASKS", "8")),
         n_cand=n_cand,
     )
+    # round-23 graftstorm rows: the routed fleet under a seeded
+    # reset+truncate+latency storm with a mid-run partition+heal --
+    # throughput with faults armed, the wall-clock price of a faulted
+    # op, and the injected-fault absorption rate
+    storm_rows = bench_storm(
+        space,
+        n_replicas=int(os.environ.get("BENCH_STORM_REPLICAS", "3")),
+        n_studies=int(os.environ.get("BENCH_STORM_STUDIES", "4")),
+        rounds=int(os.environ.get("BENCH_STORM_ROUNDS", "6")),
+        n_cand=n_cand,
+    )
     # round-17 graftmesh rows: the study-sharded serve engine and the
     # shard_map PBT schedule per mesh shape (virtual CPU devices here;
     # the MULTICHIP dryrun runs the same programs on real meshes)
@@ -1785,6 +1912,11 @@ def main():
                 # throughput, wal_fsyncs_per_tell (< 0.2 acceptance),
                 # co-batch occupancy
                 **burst_rows,
+                # round-23 graftstorm rows (bench_storm): the fleet
+                # under a hostile network -- throughput with the storm
+                # armed, mean recovery wall-clock of faulted ops, and
+                # typed transport faults absorbed per op
+                **storm_rows,
                 # round-19 graftscope rows (bench_obs): tracing-armed
                 # overhead fractions, span throughput, and the
                 # fleet-wide /metrics scrape latency

@@ -908,6 +908,77 @@ def check_fsync_in_hot_loop(ctx):
             )
 
 
+#: socket calls that block forever unless a deadline is in force: the
+#: handle-makers (``makefile`` inherits the socket's timeout -- or its
+#: absence) and the raw blocking reads/accepts
+_SOCKET_DEADLINE_OPS = frozenset({"makefile", "recv", "recv_into", "accept"})
+
+#: deadline evidence inside one function scope: an explicit
+#: ``settimeout``, or the blessed :func:`~..serve.frames.dial` seam
+#: (which carries both deadlines by construction)
+_SOCKET_DEADLINE_EVIDENCE = frozenset({"settimeout", "dial"})
+
+
+def _create_connection_has_timeout(call):
+    if len(call.args) >= 2:
+        return True
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+@register(
+    "GL309", "socket-op-without-deadline",
+    "create_connection/makefile/recv/accept in serve//distributed//"
+    "client.py with no timeout in scope -- a silent peer blocks the "
+    "thread forever; dial() (or settimeout before the op) is the "
+    "graftstorm contract",
+)
+def check_socket_op_without_deadline(ctx):
+    # the graftstorm rule: every socket op in the serve stack must run
+    # under a deadline.  Heuristic, scope-local: a function that calls
+    # settimeout, dial(), or create_connection(..., timeout=...) has
+    # deadline evidence; a makefile/recv/accept (or a timeout-less
+    # create_connection) in a scope WITHOUT evidence is the hung-read
+    # shape the storm suite exposes.
+    in_domain = any(
+        p in ("serve", "distributed") for p in ctx.parts[:-1]
+    ) or (ctx.parts and ctx.parts[-1] == "client.py")
+    if not in_domain or _is_test_file(ctx):
+        return
+    for fn in ctx.functions:
+        if isinstance(fn, ast.Lambda):
+            continue
+        own = list(walk_scope(fn))
+        calls = [n for n in own if isinstance(n, ast.Call)]
+        evidence = any(
+            terminal_name(c.func) in _SOCKET_DEADLINE_EVIDENCE
+            or (
+                terminal_name(c.func) == "create_connection"
+                and _create_connection_has_timeout(c)
+            )
+            for c in calls
+        )
+        for c in calls:
+            name = terminal_name(c.func)
+            if (
+                name == "create_connection"
+                and not _create_connection_has_timeout(c)
+            ):
+                yield ctx.finding(
+                    "GL309", c,
+                    "create_connection without a timeout: the connect "
+                    "blocks for the OS default (minutes) and the socket "
+                    "inherits NO read deadline -- use frames.dial() or "
+                    "pass timeout=",
+                )
+            elif name in _SOCKET_DEADLINE_OPS and not evidence:
+                yield ctx.finding(
+                    "GL309", c,
+                    f"{name}() with no deadline in scope: a silent or "
+                    "half-open peer blocks this thread forever -- "
+                    "settimeout first (or route through frames.dial)",
+                )
+
+
 _NP_GLOBAL_STATE = frozenset({
     "seed", "rand", "randn", "randint", "random", "uniform", "normal",
     "choice", "shuffle", "permutation", "standard_normal", "beta",

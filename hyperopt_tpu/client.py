@@ -75,7 +75,18 @@ from .base import (
     SONify,
     Trials,
 )
-from .exceptions import CheckpointError, DeadlineExpired, Overloaded
+from .exceptions import (
+    CheckpointError,
+    DeadlineExpired,
+    DispatchTimeout,
+    NetworkTimeout,
+    Overloaded,
+    OwnershipLost,
+    PeerUnreachable,
+    ReplicaDead,
+    StudyPoisoned,
+    StudyQuarantined,
+)
 from .rand import docs_from_idxs_vals
 
 logger = logging.getLogger(__name__)
@@ -84,6 +95,7 @@ __all__ = [
     "CLIENT_STUDY",
     "EngineClient",
     "EngineSpec",
+    "RemoteStudy",
     "connect",
     "resolve_engine_algo",
 ]
@@ -844,3 +856,215 @@ def connect(engine, algo, domain, trials, rstate, fn=None, ask_ahead=1,
                         del _SHARED_SERVICES[shared_key]
                         service.shutdown()
         raise
+
+
+# ---------------------------------------------------------------------------
+# the TCP study client (graftstorm)
+# ---------------------------------------------------------------------------
+
+#: the reply ``error_type`` -> typed exception map: a server-side
+#: failure crosses the wire as a name and is re-raised as the matching
+#: class, so the ONLY errors a RemoteStudy caller ever sees are the
+#: typed hierarchy (the storm acceptance contract)
+_REPLY_ERRORS = {
+    "DeadlineExpired": DeadlineExpired,
+    "DispatchTimeout": DispatchTimeout,
+    "NetworkTimeout": NetworkTimeout,
+    "OwnershipLost": OwnershipLost,
+    "PeerUnreachable": PeerUnreachable,
+    "ReplicaDead": ReplicaDead,
+    "StudyPoisoned": StudyPoisoned,
+    "StudyQuarantined": StudyQuarantined,
+}
+
+
+class RemoteStudy:
+    """Exactly-once client for ONE study behind a TCP front (a serve
+    process or the fleet router).
+
+    The transport discipline the storm chaos suite pins:
+
+    * every socket carries connect AND read deadlines
+      (:func:`~.serve.frames.dial`): a silent peer surfaces typed
+      :class:`NetworkTimeout`, never a hung client thread;
+    * a transport failure (reset, torn frame, missed deadline, refused
+      connect) drops the connection and retries the op on a fresh one
+      with bounded backoff -- asks resubmit with ``recover=True`` (the
+      service re-serves the oldest undelivered suggestion BITWISE
+      instead of burning a fresh seed), tells resubmit with their
+      explicit ``vals`` payload (the WAL tid-dedup absorbs the
+      duplicate) -- so a lost ack never loses or duplicates a trial;
+    * server-side errors come back typed (``error_type``) and are
+      re-raised as the matching exceptions class;
+    * typed ``Overloaded`` (queue caps, draining, the connection-cap
+      refusal) is retried under the server's ``retry_after`` hint,
+      capped -- backpressure paces the client, it never strands it.
+
+    Retries are bounded: ``max_retries`` failed attempts on one op
+    escalate to :class:`PeerUnreachable` (transport) or re-raise the
+    last typed refusal (backpressure).  NOT thread-safe -- one
+    RemoteStudy per driving thread, like :class:`~.serve.frames.
+    FrameConn` underneath it.
+    """
+
+    def __init__(self, host, port, name, seed=0, connect_timeout=None,
+                 read_timeout=None, net_plan=None, key=None,
+                 max_retries=8, create=True, takeover=False):
+        from .serve.frames import (
+            DEFAULT_CONNECT_TIMEOUT, DEFAULT_READ_TIMEOUT,
+        )
+
+        self.host = host
+        self.port = int(port)
+        self.name = str(name)
+        self.connect_timeout = (
+            DEFAULT_CONNECT_TIMEOUT if connect_timeout is None
+            else float(connect_timeout)
+        )
+        self.read_timeout = (
+            DEFAULT_READ_TIMEOUT if read_timeout is None
+            else float(read_timeout)
+        )
+        self.net_plan = net_plan
+        self.key = key if key is not None else f"client/{name}"
+        self.max_retries = int(max_retries)
+        self.stats = collections.Counter()
+        self._conn = None
+        if create:
+            self.call({
+                "op": "create_study", "name": self.name,
+                "seed": int(seed), "takeover": bool(takeover),
+            })
+
+    # -- transport ---------------------------------------------------------
+    def _connect(self):
+        from .serve.frames import FrameConn, dial
+
+        if self._conn is None:
+            _sock, f = dial(
+                self.host, self.port,
+                connect_timeout=self.connect_timeout,
+                read_timeout=self.read_timeout,
+                net_plan=self.net_plan, key=self.key,
+            )
+            self._conn = FrameConn(f)
+        return self._conn
+
+    def _drop(self):
+        c, self._conn = self._conn, None
+        if c is not None:
+            c.close()
+
+    def close(self):
+        self._drop()
+
+    def call(self, req, mutate=None):
+        """One op, exactly-once under a hostile network: bounded
+        transport retries on fresh connections (``mutate`` rewrites
+        the request for resubmission -- the ask path's
+        ``recover=True``), bounded ``Overloaded`` backoff under the
+        server's own hint, typed re-raise for everything else."""
+        from .distributed.faults import SimulatedCrash
+        from .serve.frames import FrameError
+        from .serve.service import RETRY_AFTER_CAP
+
+        transport = (
+            NetworkTimeout, PeerUnreachable, ConnectionError,
+            FrameError, OSError,
+        )
+        last = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.stats["retries"] += 1
+                if mutate is not None:
+                    req = mutate(req)
+            try:
+                reply = self._connect().call(req)
+            except Overloaded as e:
+                # the front's connection-cap refusal rides the hello
+                # line: typed backpressure, retried under its hint
+                self._drop()
+                last = e
+                self.stats["typed:Overloaded"] += 1
+                time.sleep(min(  # graftlint: disable=GL303 the backoff IS the server's typed retry_after hint, capped and bounded by the attempt budget
+                    e.retry_after or 0.05, RETRY_AFTER_CAP
+                ))
+                continue
+            except SimulatedCrash:
+                # the armed NET crash point: this client "died" in the
+                # send/ack window.  Drop the conn so a harness that
+                # restarts the client on this object resumes clean,
+                # then die for real (BaseException propagates)
+                self._drop()
+                raise
+            except transport as e:
+                self._drop()
+                last = e
+                self.stats["transport_errors"] += 1
+                self.stats[f"transport:{type(e).__name__}"] += 1
+                time.sleep(min(0.01 * (attempt + 1), 0.05))  # graftlint: disable=GL303 bounded linear backoff under the max_retries attempt budget -- not an unbounded retry loop
+                continue
+            if reply.get("ok"):
+                return reply
+            etype = reply.get("error_type")
+            if etype == "Overloaded":
+                last = Overloaded(
+                    reply.get("error") or "overloaded",
+                    retry_after=reply.get("retry_after"),
+                    reason=reply.get("reason") or "queue_full",
+                )
+                self.stats["typed:Overloaded"] += 1
+                time.sleep(min(  # graftlint: disable=GL303 the backoff IS the server's typed retry_after hint, capped and bounded by the attempt budget
+                    last.retry_after or 0.05, RETRY_AFTER_CAP
+                ))
+                continue
+            self.stats[f"typed:{etype}"] += 1
+            exc = _REPLY_ERRORS.get(etype)
+            if exc is not None:
+                raise exc(reply.get("error") or etype)
+            if etype == "FrameError":
+                # the server closed past a framing error; the conn is
+                # dead -- retry on a fresh one
+                self._drop()
+                last = FrameError(reply.get("error") or "framing error")
+                self.stats["transport_errors"] += 1
+                continue
+            raise RuntimeError(
+                f"study {self.name!r}: server error "
+                f"{etype or '?'}: {reply.get('error')}"
+            )
+        if isinstance(last, Overloaded):
+            raise last
+        raise PeerUnreachable(
+            f"study {self.name!r}: {self.max_retries + 1} attempts "
+            f"exhausted against {self.host}:{self.port} (last: "
+            f"{type(last).__name__ if last else '?'}: {last})"
+        ) from (last if isinstance(last, Exception) else None)
+
+    # -- the study API -----------------------------------------------------
+    def ask(self, timeout=60.0):
+        """The next (tid, vals): resubmitted with ``recover=True``
+        after any transport failure, so a suggestion the service
+        already logged is re-delivered bitwise, never re-drawn."""
+        reply = self.call(
+            {"op": "ask", "study": self.name, "timeout": float(timeout)},
+            mutate=lambda r: dict(r, recover=True),
+        )
+        return reply["tid"], reply["vals"]
+
+    def tell(self, tid, loss, vals):
+        """Report one result.  ``vals`` is REQUIRED: a re-tell after a
+        lost ack must carry the full payload (the service refuses a
+        payload-less tell for a tid it no longer has outstanding), and
+        the WAL tid-dedup absorbs the duplicate exactly-once."""
+        self.call({
+            "op": "tell", "study": self.name, "tid": int(tid),
+            "loss": float(loss), "vals": vals,
+        })
+
+    def best(self):
+        return self.call({"op": "best", "study": self.name})["best"]
+
+    def close_study(self):
+        self.call({"op": "close_study", "study": self.name})
+        self._drop()

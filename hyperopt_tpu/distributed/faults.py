@@ -49,10 +49,11 @@ logger = logging.getLogger(__name__)
 
 __all__ = [
     "REAL_FS", "RealFS", "FaultPlan", "FaultyFS", "SimulatedCrash",
-    "DeviceFaultPlan",
+    "DeviceFaultPlan", "NetFaultPlan", "FaultyWire",
     "CRASH_POINTS", "DRIVER_CRASH_POINTS", "SERVE_CRASH_POINTS",
     "DEVICE_LOOP_CRASH_POINTS", "FLEET_CRASH_POINTS",
-    "OBS_CRASH_POINTS", "PILOT_CRASH_POINTS", "ALL_CRASH_POINTS",
+    "OBS_CRASH_POINTS", "PILOT_CRASH_POINTS", "NET_CRASH_POINTS",
+    "ALL_CRASH_POINTS",
 ]
 
 #: every named crash point the QUEUE protocol code declares (see module
@@ -209,10 +210,14 @@ PILOT_CRASH_POINTS = (
     "pilot_mid_scale_out",
 )
 
+from .netfaults import (  # noqa: E402 -- re-exported alongside FaultPlan
+    NET_CRASH_POINTS, NetFaultPlan, FaultyWire,
+)
+
 ALL_CRASH_POINTS = (
     CRASH_POINTS + DRIVER_CRASH_POINTS + SERVE_CRASH_POINTS
     + DEVICE_LOOP_CRASH_POINTS + FLEET_CRASH_POINTS + OBS_CRASH_POINTS
-    + PILOT_CRASH_POINTS
+    + PILOT_CRASH_POINTS + NET_CRASH_POINTS
 )
 
 #: the transient errno mix a flaky mount produces; FileNotFoundError

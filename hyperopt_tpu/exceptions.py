@@ -147,3 +147,21 @@ class ReplicaDead(ServeError):
     away from the router) was asked to serve: the router converts this
     into failover -- re-materializing the dead replica's studies on
     survivors -- and retries against the new owner."""
+
+
+class NetworkTimeout(ServeError):
+    """A socket read or write missed its deadline: the peer is
+    connected but silent (black-hole partition, hung handler, or a
+    slow-loris writer slower than the budget).  Raised instead of
+    blocking a handler or client thread forever; routed into the same
+    failover/retry machinery as a connection error -- the router marks
+    the backend suspect and re-routes, the client resubmits with the
+    exactly-once recover/re-tell discipline."""
+
+
+class PeerUnreachable(ServeError):
+    """A connection could not be established (refused, no route, DNS,
+    or connect deadline) or was exhausted after bounded retries: the
+    peer is gone rather than slow.  The terminal transport error a
+    client surfaces when every retry budget is spent -- always typed,
+    never a raw :class:`OSError`."""

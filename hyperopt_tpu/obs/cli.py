@@ -20,15 +20,24 @@ from __future__ import annotations
 
 import argparse
 import json
-import socket
 
 
 def _rpc(host, port, req, timeout=30.0):
-    with socket.create_connection((host, int(port)), timeout=timeout) as s:
-        f = s.makefile("rwb")
+    # graftstorm: dial() carries both connect and read deadlines, so a
+    # hung replica surfaces typed NetworkTimeout instead of stranding
+    # the console
+    from ..serve.frames import dial
+
+    sock, f = dial(
+        host, int(port), connect_timeout=timeout, read_timeout=timeout,
+    )
+    try:
         f.write((json.dumps(req) + "\n").encode("utf-8"))
         f.flush()
         line = f.readline()
+    finally:
+        f.close()
+        sock.close()
     if not line:
         raise ConnectionError(f"{host}:{port} closed the connection")
     return json.loads(line)

@@ -421,6 +421,22 @@ class Fleet:
         zombie whose fenced ops must all raise ``OwnershipLost``."""
         self.replicas[rid].partitioned = True
 
+    def heal(self, rid):
+        """The partition lifts (graftstorm): the replica was alive the
+        whole time and rejoins the ring.  Its resident study handles
+        still carry pre-partition claims, so its first routed op per
+        study raises ``OwnershipLost`` -- the router's adoption path
+        re-claims with ``create_study(takeover=True)`` (epoch bumped,
+        WAL-restored from the shared root) and the rejoin is client-
+        invisible.  Idempotent; a no-op for dead or unknown rids."""
+        with self._mlock:
+            replica = self.replicas.get(rid)
+            if replica is None or replica.dead:
+                return
+            replica.partitioned = False
+            self.ring.add(rid)  # failover removed it; re-placement is
+            # the same ~1/N key move as any membership change
+
     def failover(self, rid):
         """Re-materialize a dead replica's studies on ring survivors
         from their WAL+bundle pairs (tid-dedup exactly-once replay,

@@ -39,15 +39,20 @@ reader so callers distinguish it from a clean close).
 from __future__ import annotations
 
 import json
+import socket
 import struct
 
-from ..exceptions import HyperoptTpuError
+from ..exceptions import HyperoptTpuError, NetworkTimeout, PeerUnreachable
 
 __all__ = [
     "PROTO_V1",
     "PROTO_V2",
     "MAX_FRAME",
+    "DEFAULT_CONNECT_TIMEOUT",
+    "DEFAULT_READ_TIMEOUT",
     "FrameError",
+    "DeadlineFile",
+    "dial",
     "pack",
     "unpack",
     "read_frame",
@@ -57,6 +62,12 @@ __all__ = [
 
 PROTO_V1 = 1  # JSON-lines, lockstep (the original seam)
 PROTO_V2 = 2  # length-prefixed binary frames, pipelined
+
+#: graftstorm defaults: every outbound socket gets BOTH deadlines --
+#: nothing in the serve stack is allowed to block forever on a silent
+#: peer (the GL309 contract).
+DEFAULT_CONNECT_TIMEOUT = 5.0
+DEFAULT_READ_TIMEOUT = 30.0
 
 #: refuse to allocate for a frame longer than this (a malformed or
 #: hostile length prefix must be a typed error, not an OOM)
@@ -85,6 +96,98 @@ class FrameError(HyperoptTpuError):
     into a typed error reply (``error_type: "FrameError"``) and closes
     the connection -- past a framing error the stream offset is
     meaningless, so resynchronization is not attempted."""
+
+
+# ---------------------------------------------------------------------------
+# dialing: deadlines on every outbound socket
+# ---------------------------------------------------------------------------
+
+
+class DeadlineFile:
+    """File-object proxy that converts a missed socket deadline into
+    the typed :class:`~..exceptions.NetworkTimeout`.
+
+    ``socket.create_connection(timeout=...)`` leaves the timeout set on
+    the socket, so every ``makefile`` read/write inherits it -- but a
+    miss surfaces as ``socket.timeout``, which callers would have to
+    distinguish from real ``OSError`` transport failures by hand.  This
+    proxy does the conversion once, at the transport seam, so the
+    failover/retry machinery matches on the typed hierarchy."""
+
+    def __init__(self, f, peer=None):
+        self._f = f
+        self._peer = peer
+
+    def _timeout(self, op, e):
+        raise NetworkTimeout(
+            f"socket {op} missed its deadline"
+            + (f" (peer {self._peer})" if self._peer else "")
+        ) from e
+
+    def read(self, n=-1):
+        try:
+            return self._f.read(n)
+        except socket.timeout as e:
+            self._timeout("read", e)
+
+    def readline(self, limit=-1):
+        try:
+            return self._f.readline(limit)
+        except socket.timeout as e:
+            self._timeout("read", e)
+
+    def write(self, b):
+        try:
+            return self._f.write(b)
+        except socket.timeout as e:
+            self._timeout("write", e)
+
+    def flush(self):
+        try:
+            self._f.flush()
+        except socket.timeout as e:
+            self._timeout("write", e)
+
+    def close(self):
+        self._f.close()
+
+    @property
+    def closed(self):
+        return self._f.closed
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def dial(host, port, connect_timeout=DEFAULT_CONNECT_TIMEOUT,
+         read_timeout=DEFAULT_READ_TIMEOUT, net_plan=None, key=None):
+    """Open one deadline-armed transport to ``(host, port)``.
+
+    The single connection-creation seam for the whole serve stack
+    (client transport, router backend conns, probes, obs CLI): connect
+    failures surface typed :class:`~..exceptions.PeerUnreachable`, the
+    connect deadline stays on the socket as the read/write deadline
+    (missed reads surface typed :class:`~..exceptions.NetworkTimeout`
+    via :class:`DeadlineFile`), and an optional
+    :class:`~..distributed.netfaults.NetFaultPlan` wraps the handle so
+    chaos suites inject wire faults at exactly the production seam.
+
+    Returns ``(sock, f)`` -- the socket (for callers that need
+    ``close``/peer info) and the wrapped ``rwb`` file handle ready for
+    :class:`FrameConn`."""
+    try:
+        sock = socket.create_connection((host, port), timeout=connect_timeout)
+    except socket.timeout as e:
+        raise PeerUnreachable(
+            f"connect to {host}:{port} missed its {connect_timeout}s deadline"
+        ) from e
+    except OSError as e:
+        raise PeerUnreachable(f"connect to {host}:{port} failed: {e}") from e
+    sock.settimeout(read_timeout)
+    f = sock.makefile("rwb")
+    if net_plan is not None:
+        f = net_plan.wrap(f, sock=sock, key=key)
+    return sock, DeadlineFile(f, peer=f"{host}:{port}")
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +381,18 @@ class FrameConn:
             reply = json.loads(line)
         except ValueError as e:
             raise ConnectionError(f"garbled hello reply: {e}") from e
+        if not reply.get("ok") and reply.get("error_type") == "Overloaded":
+            # the server front's connection-cap refusal (graftstorm):
+            # a typed, retryable rejection sent pre-negotiation -- NOT
+            # an old server's unknown-op error, which must stay the
+            # silent JSON-line fallback
+            from ..exceptions import Overloaded
+
+            raise Overloaded(
+                reply.get("error") or "connection refused at the cap",
+                retry_after=reply.get("retry_after"),
+                reason=reply.get("reason") or "max_connections",
+            )
         self.binary = bool(
             reply.get("ok") and int(reply.get("proto", PROTO_V1)) >= PROTO_V2
         )

@@ -37,14 +37,28 @@ import threading
 import time
 
 from ..distributed.faults import REAL_FS, SimulatedCrash
-from ..exceptions import OwnershipLost, ReplicaDead
+from ..exceptions import (
+    NetworkTimeout, OwnershipLost, PeerUnreachable, ReplicaDead,
+)
 from ..obs.expo import merge_rows, render_prometheus, tag_rows
 from ..obs.registry import LATENCY_BUCKETS_S, MetricsRegistry
-from .frames import FrameConn, FrameError
+from .frames import (
+    DEFAULT_READ_TIMEOUT, FrameConn, FrameError, dial,
+)
 
 logger = logging.getLogger(__name__)
 
 __all__ = ["HashRing", "FleetRouter", "RouterServer", "main"]
+
+#: everything a forward can die of at the transport: raw socket
+#: failures, torn frames, garbled JSON-line replies, and the typed
+#: graftstorm deadline/reachability pair (ServeError subclasses, so
+#: they are NOT under OSError and must be named here) -- one tuple so
+#: every catch site routes the same set into failover.
+_NET_ERRORS = (
+    OSError, ConnectionError, FrameError, json.JSONDecodeError,
+    NetworkTimeout, PeerUnreachable,
+)
 
 
 def _h64(s):
@@ -159,6 +173,17 @@ class FleetRouter:
             self.fleet.failover(rid)
             retry = recover_op or op
             return retry(self.fleet.replicas[self.fleet.route(name)])
+        except OwnershipLost:
+            # a healed rejoiner (graftstorm): the partition lifted and
+            # the ring routed the study back, but the replica's
+            # resident handle still carries its pre-partition claim.
+            # Re-claim from the shared root (takeover bumps the epoch,
+            # WAL restore is tid-dedup exactly-once) and retry -- the
+            # rejoin is client-invisible
+            replica = self.fleet.replicas[self.fleet.route(name)]
+            replica.open_study(name, takeover=True)
+            retry = recover_op or op
+            return retry(replica)
 
     def _ack(self):
         self.fs.crashpoint("fleet_router_after_forward_before_ack")
@@ -242,11 +267,18 @@ class _Backend:
         self.host = host
         self.port = int(port)
 
-    def connect(self, timeout=10.0):
-        sock = socket.create_connection(
-            (self.host, self.port), timeout=timeout
+    def connect(self, timeout=10.0, read_timeout=DEFAULT_READ_TIMEOUT,
+                net_plan=None):
+        """Deadline-armed transport to this replica via
+        :func:`~.frames.dial`: connect failures are typed
+        :class:`PeerUnreachable`, hung reads typed
+        :class:`NetworkTimeout` -- a silent backend can no longer
+        strand a router handler thread."""
+        _sock, f = dial(
+            self.host, self.port, connect_timeout=timeout,
+            read_timeout=read_timeout, net_plan=net_plan, key=self.rid,
         )
-        return sock.makefile("rwb")
+        return f
 
 
 class RouterServer:
@@ -264,8 +296,18 @@ class RouterServer:
     """
 
     def __init__(self, backends, salt="", vnodes=64,
-                 probe_timeout=5.0, probe_backoff_cap=8):
+                 probe_timeout=5.0, probe_backoff_cap=8,
+                 read_timeout=DEFAULT_READ_TIMEOUT, net_plan=None,
+                 idle_timeout=300.0, max_conns=256):
         self.backends = {b.rid: b for b in backends}
+        # graftstorm socket hygiene: per-op read deadline on every
+        # backend conn, idle deadline + bounded conn count on the
+        # client front, and an optional NetFaultPlan injected at the
+        # backend dial seam (chaos suites storm the real sockets)
+        self.read_timeout = float(read_timeout)
+        self.net_plan = net_plan
+        self.idle_timeout = idle_timeout
+        self.max_conns = int(max_conns)
         self.ring = HashRing(self.backends, salt=salt, vnodes=vnodes)
         self._lock = threading.Lock()
         self._dead = set()
@@ -322,7 +364,11 @@ class RouterServer:
         c = conns.get(rid)
         if c is None:
             c = conns[rid] = FrameConn(
-                self.backends[rid].connect(timeout=timeout)
+                self.backends[rid].connect(
+                    timeout=timeout,
+                    read_timeout=min(self.read_timeout, float(timeout)),
+                    net_plan=self.net_plan,
+                )
             )
         return c
 
@@ -402,8 +448,7 @@ class RouterServer:
                     ))
                     continue
                 return reply
-            except (OSError, ConnectionError, FrameError,
-                    json.JSONDecodeError) as e:
+            except _NET_ERRORS as e:
                 last_exc = e
                 self._drop_conn(conns, rid)
                 self._mark_dead(rid)
@@ -452,15 +497,14 @@ class RouterServer:
                     "op": "ask_batch", "names": group,
                     "timeout": timeout,
                 })))
-            except (OSError, ConnectionError, FrameError):
+            except _NET_ERRORS:
                 self._drop_conn(conns, rid)
                 self._mark_dead(rid)
                 retry.extend(group)
         for rid, group, c, fut in flights:
             try:
                 reply = c.drain(fut)
-            except (OSError, ConnectionError, FrameError,
-                    json.JSONDecodeError):
+            except _NET_ERRORS:
                 self._drop_conn(conns, rid)
                 self._mark_dead(rid)
                 retry.extend(group)
@@ -493,7 +537,7 @@ class RouterServer:
                 continue
             try:
                 replies[rid] = self._rpc(conns, rid, {"op": op})
-            except (OSError, ConnectionError, FrameError) as e:
+            except _NET_ERRORS as e:
                 self._drop_conn(conns, rid)
                 replies[rid] = {"ok": False, "error": str(e)}
         if op == "ready":
@@ -525,8 +569,7 @@ class RouterServer:
                 continue
             try:
                 reply = self._rpc(conns, rid, {"op": "metrics"})
-            except (OSError, ConnectionError, FrameError,
-                    json.JSONDecodeError):
+            except _NET_ERRORS:
                 self._drop_conn(conns, rid)
                 continue
             if reply.get("ok"):
@@ -552,8 +595,7 @@ class RouterServer:
                 reply = self._rpc(
                     conns, rid, {"op": "trace", "tail": tail}
                 )
-            except (OSError, ConnectionError, FrameError,
-                    json.JSONDecodeError):
+            except _NET_ERRORS:
                 self._drop_conn(conns, rid)
                 continue
             if reply.get("ok"):
@@ -594,8 +636,7 @@ class RouterServer:
                     timeout=self.probe_timeout,
                 )
                 ok = bool(reply.get("ok"))
-            except (OSError, ConnectionError, FrameError,
-                    json.JSONDecodeError):
+            except _NET_ERRORS:
                 self._drop_conn(self._probe_conns, rid)
                 ok = False
             self._probe_hist.observe_since(t0)
@@ -664,14 +705,36 @@ class RouterServer:
         """Bind the client front; returns the (not yet serving)
         ``ThreadingTCPServer`` exactly like ``service.serve_forever``
         -- including the graftburst hello negotiation, so a binary
-        pipelining client gets frames end to end through the router."""
+        pipelining client gets frames end to end through the router.
+
+        graftstorm hygiene: every accepted connection carries the
+        router's ``idle_timeout`` as its socket deadline (an idle or
+        half-open peer is reaped, never a stranded handler thread),
+        and at most ``max_conns`` connections are served at once --
+        one past the cap gets a typed ``Overloaded`` refusal
+        (``reason: "max_connections"``) and a close, the GL306 shape
+        applied at the socket layer."""
         import socketserver
 
         from .frames import PROTO_V2, read_frame, write_frame
+        from .service import RETRY_AFTER_CAP
 
         router = self
+        idle = self.idle_timeout
+        plan = self.net_plan
+        slots = threading.BoundedSemaphore(self.max_conns)
 
         class Handler(socketserver.StreamRequestHandler):
+            timeout = idle  # StreamRequestHandler: settimeout in setup()
+
+            def setup(self):
+                super().setup()
+                if plan is not None:
+                    self.rfile, self.wfile = plan.wrap_pair(
+                        self.rfile, self.wfile, sock=self.connection,
+                        key="router-front",
+                    )
+
             def _send(self, reply, binary):
                 if binary:
                     write_frame(self.wfile, reply)
@@ -682,6 +745,28 @@ class RouterServer:
                 self.wfile.flush()
 
             def handle(self):
+                if not slots.acquire(blocking=False):
+                    try:
+                        self._send({
+                            "ok": False,
+                            "error": "router connection cap reached",
+                            "error_type": "Overloaded",
+                            "reason": "max_connections",
+                            "retry_after": min(0.05, RETRY_AFTER_CAP),
+                        }, False)
+                    except OSError:
+                        pass
+                    return
+                try:
+                    self._handle_conn()
+                except ConnectionError:
+                    # the peer reset or vanished mid-request (storm
+                    # weather, not a router bug): close quietly
+                    return
+                finally:
+                    slots.release()
+
+            def _handle_conn(self):
                 conns = {}  # this thread's backend connections
                 binary = False
                 try:
@@ -746,6 +831,10 @@ class RouterServer:
                         if "rid" in req:
                             reply = dict(reply, rid=req["rid"])
                         self._send(reply, binary)
+                except socket.timeout:
+                    # idle deadline: a silent or half-open client is
+                    # reaped -- close quietly, no stranded thread
+                    return
                 finally:
                     for c in conns.values():
                         c.close()
@@ -800,6 +889,23 @@ def main(argv=None):
         "probing",
     )
     parser.add_argument(
+        "--read-timeout", type=float, default=DEFAULT_READ_TIMEOUT,
+        help="per-op read deadline on every backend connection "
+        "(graftstorm: a hung backend surfaces typed NetworkTimeout "
+        "and takes the failover path instead of stranding a handler)",
+    )
+    parser.add_argument(
+        "--idle-timeout", type=float, default=300.0,
+        help="per-connection idle deadline on the client front: an "
+        "idle or half-open client is reaped after this many seconds",
+    )
+    parser.add_argument(
+        "--max-conns", type=int, default=256,
+        help="bound on concurrently served client connections; one "
+        "past the cap gets a typed Overloaded refusal "
+        "(reason max_connections) instead of an unbounded accept loop",
+    )
+    parser.add_argument(
         "--probe-backoff-cap", type=int, default=8,
         help="max sweeps skipped between probes of a persistently-"
         "down backend (exponential backoff 1, 2, 4, ... capped here; "
@@ -818,6 +924,8 @@ def main(argv=None):
     router = RouterServer(
         backends, salt=args.salt, vnodes=args.vnodes,
         probe_backoff_cap=args.probe_backoff_cap,
+        read_timeout=args.read_timeout,
+        idle_timeout=args.idle_timeout, max_conns=args.max_conns,
     )
     server = router.serve_forever(host=args.host, port=args.port)
     if args.probe_interval > 0:

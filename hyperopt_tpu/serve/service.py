@@ -1011,7 +1011,8 @@ def _handle_request(service, req):
         return _serve_error_reply(e)
 
 
-def serve_forever(service, host="127.0.0.1", port=0):
+def serve_forever(service, host="127.0.0.1", port=0,
+                  idle_timeout=300.0, max_conns=256, net_plan=None):
     """Bind the TCP front; returns the (not yet serving)
     ``ThreadingTCPServer`` -- call ``.serve_forever()`` (the console
     script does) or drive it from a thread (the tests do).
@@ -1024,12 +1025,37 @@ def serve_forever(service, host="127.0.0.1", port=0):
     replies echo the request's ``rid`` when it carries one, so a
     pipelining client can keep many requests in flight.  A framing
     error gets a typed ``FrameError`` reply and the connection closes
-    -- never a hang."""
+    -- never a hang.
+
+    graftstorm hygiene: ``idle_timeout`` is each accepted socket's
+    read deadline (an idle or half-open peer is reaped, never a
+    stranded handler thread); at most ``max_conns`` connections are
+    served at once -- one past the cap gets a typed ``Overloaded``
+    refusal (``reason: "max_connections"``) and a close, the GL306
+    queue-cap shape applied at the socket layer.  ``net_plan`` (a
+    :class:`~hyperopt_tpu.distributed.netfaults.NetFaultPlan`) wraps
+    every accepted connection so chaos suites storm the real server
+    seam."""
+    import socket as _socket
     import socketserver
 
     from .frames import PROTO_V2, FrameError, read_frame, write_frame
 
+    idle = idle_timeout
+    plan = net_plan
+    slots = threading.BoundedSemaphore(int(max_conns))
+
     class Handler(socketserver.StreamRequestHandler):
+        timeout = idle  # StreamRequestHandler: settimeout in setup()
+
+        def setup(self):
+            super().setup()
+            if plan is not None:
+                self.rfile, self.wfile = plan.wrap_pair(
+                    self.rfile, self.wfile, sock=self.connection,
+                    key="serve-front",
+                )
+
         def _send(self, reply, binary):
             if binary:
                 write_frame(self.wfile, reply)
@@ -1040,6 +1066,32 @@ def serve_forever(service, host="127.0.0.1", port=0):
             self.wfile.flush()
 
         def handle(self):
+            if not slots.acquire(blocking=False):
+                try:
+                    self._send({
+                        "ok": False,
+                        "error": "server connection cap reached",
+                        "error_type": "Overloaded",
+                        "reason": "max_connections",
+                        "retry_after": 0.05,
+                    }, False)
+                except OSError:
+                    pass
+                return
+            try:
+                self._handle_conn()
+            except _socket.timeout:
+                # idle deadline: a silent or half-open client is
+                # reaped -- close quietly, no stranded thread
+                return
+            except ConnectionError:
+                # the peer reset or vanished mid-request (storm
+                # weather, not a server bug): close quietly
+                return
+            finally:
+                slots.release()
+
+        def _handle_conn(self):
             binary = False
             while True:
                 if binary:
@@ -1191,6 +1243,17 @@ def main(argv=None):
         help="dispatch the obs.device_metrics io_callback twin every "
         "N rounds (0 = off: exactly zero extra dispatches)",
     )
+    parser.add_argument(
+        "--idle-timeout", type=float, default=300.0,
+        help="per-connection idle deadline (seconds): an idle or "
+        "half-open client is reaped instead of stranding a handler "
+        "thread (graftstorm)",
+    )
+    parser.add_argument(
+        "--max-conns", type=int, default=256,
+        help="bound on concurrently served connections; one past the "
+        "cap gets a typed Overloaded refusal (reason max_connections)",
+    )
     args = parser.parse_args(argv)
 
     mesh = None
@@ -1215,7 +1278,10 @@ def main(argv=None):
         owner=args.owner, recorder=recorder,
         device_metrics_every=args.device_metrics_every,
     )
-    server = serve_forever(service, host=args.host, port=args.port)
+    server = serve_forever(
+        service, host=args.host, port=args.port,
+        idle_timeout=args.idle_timeout, max_conns=args.max_conns,
+    )
     host, port = server.server_address[:2]
     print(f"hyperopt-tpu-serve listening on {host}:{port}", flush=True)
     try:

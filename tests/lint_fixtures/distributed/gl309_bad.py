@@ -1,0 +1,24 @@
+"""GL309 true positives: socket ops with no deadline in scope in
+fault-domain library code -- the hung-read shapes graftstorm retires.
+A silent peer (black-hole partition, slow-loris writer, hung handler)
+parks each of these threads forever."""
+
+import socket
+
+
+def fetch_status(host, port):
+    # connect blocks for the OS default AND the socket inherits no
+    # read deadline
+    sock = socket.create_connection((host, port))  # GL309
+    f = sock.makefile("rwb")  # GL309: no settimeout/dial in scope
+    f.write(b'{"op": "status"}\n')
+    f.flush()
+    return f.readline()
+
+
+class Probe:
+    def __init__(self, sock):
+        self.sock = sock
+
+    def pump(self):
+        return self.sock.recv(4096)  # GL309: bare blocking read

@@ -1,0 +1,33 @@
+"""GL309 near-misses: the deadline-carrying shapes the rule must NOT
+flag.  The blessed dial() seam; an explicit settimeout before the
+blocking ops; create_connection with a timeout (positional or
+keyword)."""
+
+import socket
+
+from hyperopt_tpu.serve.frames import dial
+
+
+def fetch_status(host, port):
+    # the graftstorm contract: dial() carries connect AND read
+    # deadlines by construction
+    sock, f = dial(host, port, connect_timeout=5.0, read_timeout=30.0)
+    f.write(b'{"op": "status"}\n')
+    f.flush()
+    return f.readline()
+
+
+def fetch_manual(host, port):
+    sock = socket.create_connection((host, port), timeout=5.0)
+    sock.settimeout(30.0)
+    f = sock.makefile("rwb")  # deadline evidence in scope: settimeout
+    return f.readline()
+
+
+class Probe:
+    def __init__(self, sock):
+        self.sock = sock
+
+    def pump(self, budget):
+        self.sock.settimeout(budget)
+        return self.sock.recv(4096)

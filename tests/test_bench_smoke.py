@@ -23,6 +23,9 @@ def test_bench_prints_one_json_line():
     env["BENCH_SERVE_STUDIES"] = "8"  # CI-sized serve batch
     env["BENCH_SERVE_ROUNDS"] = "3"
     env["BENCH_BURST_CLIENTS"] = "32"  # CI-sized concurrent-client burst
+    env["BENCH_STORM_REPLICAS"] = "2"  # CI-sized hostile-network fleet
+    env["BENCH_STORM_STUDIES"] = "3"
+    env["BENCH_STORM_ROUNDS"] = "4"
     out = subprocess.run(
         [sys.executable, "bench.py"],
         capture_output=True, text=True, timeout=1200, env=env,
@@ -156,6 +159,15 @@ def test_bench_prints_one_json_line():
     assert 0 <= d["wal_fsyncs_per_tell"] < 0.9
     assert 0 < d["client_cobatch_occupancy"] <= 1.0
     assert d["burst_config"]["n_clients"] == 32
+    # round-23 graftstorm rows: the routed fleet under the seeded
+    # client-wire storm plus a mid-run partition+heal -- throughput
+    # stays positive with faults armed, faulted-op recovery is a real
+    # measurement (0.0 only when the storm injected nothing), and the
+    # absorption rate is a sane per-op fraction
+    assert d["fleet_asks_per_sec_under_storm"] > 0
+    assert d["net_fault_recovery_ms"] >= 0
+    assert 0 <= d["net_typed_error_rate"] < 1
+    assert d["storm_config"]["n_replicas"] == 2
     # round-19 graftscope rows: tracing-armed overhead fractions
     # (deterministic zero-extra-dispatch half pinned in test_obs.py;
     # these are the measured wall-clock halves), span throughput, and

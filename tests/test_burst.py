@@ -655,6 +655,86 @@ def test_concurrent_fmin_cobatch_one_service_bitwise_solo():
 
 
 # ---------------------------------------------------------------------------
+# graftstorm twins: the TCP round-trips again, under a seeded storm
+# ---------------------------------------------------------------------------
+
+
+def _storm_roundtrip(net_plan=None, front_plan=None, seed=3, rounds=6):
+    """The pipelined TCP round-trip driven through the retrying
+    ``RemoteStudy`` client: the clean run and its storm twin share
+    this driver, so any divergence is the storm's."""
+    from hyperopt_tpu.client import RemoteStudy
+
+    svc = SuggestService(
+        SPACE, background=True, max_batch=8, n_startup_jobs=2, **ALGO_KW,
+    )
+    srv = serve_forever(svc, port=0, net_plan=front_plan)
+    _spawn(srv)
+    host, port = srv.server_address[:2]
+    try:
+        c = RemoteStudy(
+            host, port, "s", seed=seed, net_plan=net_plan,
+            read_timeout=10.0,
+        )
+        stream = []
+        for _ in range(rounds):
+            tid, vals = c.ask(timeout=30)
+            c.tell(tid, loss_fn(vals), vals)
+            stream.append((tid, json.dumps(vals, sort_keys=True)))
+        stats = dict(c.stats)
+        count = int(svc.scheduler.study("s").buf.count)
+        c.close()
+        return stream, count, stats
+    finally:
+        _teardown(svc, srv)
+
+
+def test_client_wire_storm_twin_bitwise_clean_run():
+    """Default-off NetFaultPlan armed on the CLIENT wire of the TCP
+    round-trip: resets mid-frame, latency, truncate-then-close -- the
+    recover/re-tell discipline lands every op exactly once and the
+    stream is bitwise the clean run's."""
+    from hyperopt_tpu.distributed.faults import NetFaultPlan
+
+    clean_stream, clean_count, clean_stats = _storm_roundtrip()
+    assert clean_stats.get("transport_errors", 0) == 0
+    plan = NetFaultPlan(
+        seed=11, reset_rate=0.15, latency=0.001, truncate_rate=0.1,
+        burst=2,
+    )
+    stream, count, stats = _storm_roundtrip(net_plan=plan)
+    assert stream == clean_stream  # the storm is stream-invisible
+    assert count == clean_count == 6
+    assert (
+        plan.stats.get("net:reset", 0) + plan.stats.get("net:truncate", 0)
+    ) > 0, "the storm never actually injected"
+    assert stats["transport_errors"] > 0  # ...and the client absorbed it
+
+
+def test_server_front_storm_twin_bitwise_clean_run():
+    """The same storm injected on the SERVER front's accepted
+    connections (``serve_forever(net_plan=...)``'s wrap_pair seam):
+    torn replies and reset reads surface as transport errors the
+    client retries through -- exactly-once, bitwise."""
+    from hyperopt_tpu.distributed.faults import NetFaultPlan
+
+    clean_stream, clean_count, _ = _storm_roundtrip(seed=5, rounds=5)
+    plan = NetFaultPlan(
+        seed=12, reset_rate=0.12, latency=0.001, truncate_rate=0.08,
+        burst=2,
+    )
+    stream, count, stats = _storm_roundtrip(
+        front_plan=plan, seed=5, rounds=5
+    )
+    assert stream == clean_stream
+    assert count == clean_count == 5
+    assert (
+        plan.stats.get("net:reset", 0) + plan.stats.get("net:truncate", 0)
+    ) > 0, "the storm never actually injected"
+    assert stats["transport_errors"] > 0
+
+
+# ---------------------------------------------------------------------------
 # CI gates: the burst modules stay lint- and trace-clean
 # ---------------------------------------------------------------------------
 

@@ -369,6 +369,51 @@ def test_partitioned_zombie_never_double_serves(tmp_path, clean_run):
     fleet.shutdown()
 
 
+def test_partition_heals_zombie_rejoins_client_invisibly(
+    tmp_path, clean_run
+):
+    """The graftstorm heal half of the zombie story: the partition
+    LIFTS.  The replica was alive the whole time; ``Fleet.heal`` puts
+    it back on the ring, its first routed op per study raises
+    ``OwnershipLost`` (stale pre-partition claim), and the router's
+    adoption path re-claims with ``takeover=True`` -- the rejoin is
+    client-invisible: zero lost, zero duplicates, streams bitwise the
+    never-partitioned run's."""
+    clean_streams, clean_state = clean_run
+    root = str(tmp_path / "heal")
+    fleet = make_fleet(root)
+    client = Client(fleet)
+    for i, n in enumerate(NAMES):
+        client.create(n, seed=100 + i)
+    streams = {n: [] for n in NAMES}
+    drive(client, streams, 1)
+
+    victim = victim_rid()
+    fleet.partition(victim)
+    drive(client, streams, 1)  # failover serves the zombie's studies
+    assert victim not in fleet.ring.nodes
+    assert not fleet.replicas[victim].dead  # partitioned-but-ALIVE
+
+    fleet.heal(victim)
+    assert victim in fleet.ring.nodes
+    assert not fleet.replicas[victim].partitioned
+    # the healed rejoiner owns its old keys again, with stale claims
+    owned = [n for n in NAMES if fleet.route(n) == victim]
+    assert owned, "the heal never routed anything back"
+    with pytest.raises(OwnershipLost):
+        fleet.replicas[victim].ask(owned[0], timeout=5)
+
+    drive(client, streams, R - 2)  # adoption re-claims, client-invisibly
+    state = final_state(fleet)
+    assert_zero_lost_zero_duplicate(state)
+    assert streams == clean_streams
+    assert_states_bitwise_equal(state, clean_state)
+    # and the healed replica really did end up serving its keys again
+    for n in owned:
+        assert fleet.route(n) == victim
+    fleet.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # rolling restart: drain-migrate with typed backpressure only
 # ---------------------------------------------------------------------------

@@ -19,7 +19,7 @@ PACK_RULES = [
     "GL101", "GL102", "GL103", "GL104",
     "GL201", "GL202", "GL203",
     "GL301", "GL302", "GL303", "GL304", "GL305", "GL306", "GL307",
-    "GL308",
+    "GL308", "GL309",
 ]
 
 
@@ -73,6 +73,9 @@ def test_known_finding_counts():
     # one per-record fsync + one per-item durable_pickle; the barrier
     # helpers and the loop-defined closure must contribute none
     assert len(_lint(_fixture_path("GL308", "bad"))) == 2
+    # a timeout-less create_connection, the makefile it feeds, and a
+    # bare recv; the dial()/settimeout shapes must contribute none
+    assert len(_lint(_fixture_path("GL309", "bad"))) == 3
 
 
 def test_partial_wrapped_functions_resolve_as_jitted():
