@@ -1624,6 +1624,90 @@ def bench_mlp_tune(n_evals=512, batch=32, n_epochs=8):
         return None
 
 
+def bench_compiled_asha(n_evals_flat=128, n_evals_asha=256, batch=16,
+                        eta=2, rung_epochs=1, n_rungs=3):
+    """graftrung time-to-quality: the fused-ASHA compiled sweep vs the
+    flat compiled sweep on mlp-tune, same backend.  Flat trains every
+    config to full fidelity (the asha ladder's survivor budget of
+    ``rung_epochs * (eta**n_rungs - 1) / (eta - 1)`` epochs); asha
+    spends the lane-epochs early stopping saves on ~2x more configs and
+    is timed to the moment it reaches the flat sweep's final best loss
+    (progress rows give per-bracket host timestamps).  Returns a dict
+    of stamped rows, or None on failure."""
+    try:
+        from hyperopt_tpu.device_loop import compile_fmin
+        from hyperopt_tpu.models.synthetic import (
+            mlp_tune_objective,
+            mlp_tune_space,
+        )
+
+        total_ep = rung_epochs * (eta ** n_rungs - 1) // (eta - 1)
+        chunk = batch  # one bracket per chunk: progress-row resolution
+
+        def build(n_evals, rows, **kw):
+            return compile_fmin(
+                mlp_tune_objective(n_epochs=total_ep),
+                mlp_tune_space(), max_evals=n_evals, batch_size=batch,
+                chunk_size=chunk, progress_every=1,
+                progress_callback=lambda row: rows.append(
+                    (time.perf_counter(), row["best_loss"])
+                ),
+                **kw,
+            )
+
+        rows_flat, rows_asha = [], []
+        flat = build(n_evals_flat, rows_flat)
+        asha = build(
+            n_evals_asha, rows_asha,
+            asha={"eta": eta, "rung_epochs": rung_epochs,
+                  "n_rungs": n_rungs},
+        )
+        flat(seed=0)
+        asha(seed=0)  # compile both before timing
+        rows_flat.clear()
+        t0f = time.perf_counter()
+        out_f = flat(seed=1)
+        t_flat_total = time.perf_counter() - t0f
+        rows_asha.clear()
+        t0a = time.perf_counter()
+        out_a = asha(seed=1)
+        t_asha_total = time.perf_counter() - t0a
+
+        # the quality target is the flat sweep's final best -- unless
+        # asha's full-fidelity best never reached it, in which case the
+        # easier of the two finals keeps both times defined and the
+        # ratio honest (and the reached_flat_best row says which)
+        q = max(out_f["best_loss"], out_a["best_loss"])
+
+        def first_at(rows, t0):
+            for t, b in rows:
+                if b <= q:
+                    return t - t0
+            return None
+
+        t_f = first_at(rows_flat, t0f)
+        t_a = first_at(rows_asha, t0a)
+        return {
+            "speedup_x": (t_f / t_a) if t_f and t_a else None,
+            "flat_seconds_to_quality": t_f,
+            "asha_seconds_to_quality": t_a,
+            "flat_seconds_total": t_flat_total,
+            "asha_seconds_total": t_asha_total,
+            "flat_best_loss": out_f["best_loss"],
+            "asha_best_loss": out_a["best_loss"],
+            "quality_target": q,
+            "asha_reached_flat_best": bool(
+                out_a["best_loss"] <= out_f["best_loss"]
+            ),
+        }
+    except Exception:  # secondary metric must never sink the headline
+        import traceback
+
+        print("bench_compiled_asha failed:", file=sys.stderr)
+        traceback.print_exc()
+        return None
+
+
 def bench_callback_overhead(n_evals=512, batch=32, n_chunks=8):
     """What the io_callback observability seam costs: the chunked
     device loop timed with the progress callback streaming a row EVERY
@@ -1770,8 +1854,10 @@ def main():
     # amortization ratio, and co-batched round occupancy
     burst_rows = bench_burst(
         space,
+        # 10^4 concurrent on accelerators (ROADMAP item 1's sustained-
+        # fleet scale; CPU rounds keep a size that finishes in minutes)
         n_clients=int(os.environ.get(
-            "BENCH_BURST_CLIENTS", "1000" if on_accel else "64"
+            "BENCH_BURST_CLIENTS", "10000" if on_accel else "64"
         )),
         n_studies=int(os.environ.get("BENCH_BURST_STUDIES", "4")),
         asks_per_client=int(os.environ.get("BENCH_BURST_ASKS", "8")),
@@ -1843,6 +1929,17 @@ def main():
     )
     mlp_evals, mlp_batch = (2048, 64) if on_accel else (128, 16)
     mlp_rate = bench_mlp_tune(n_evals=mlp_evals, batch=mlp_batch)
+    # round-24 graftrung rows: fused-ASHA time-to-quality vs the flat
+    # compiled sweep (same backend, same objective family)
+    ca_flat, ca_asha, ca_batch = (
+        (2048, 4096, 64) if on_accel else (128, 256, 16)
+    )
+    ca_flat = int(os.environ.get("BENCH_ASHA_FLAT", ca_flat))
+    ca_asha = int(os.environ.get("BENCH_ASHA_EVALS", ca_asha))
+    ca_batch = int(os.environ.get("BENCH_ASHA_BATCH", ca_batch))
+    compiled_asha = bench_compiled_asha(
+        n_evals_flat=ca_flat, n_evals_asha=ca_asha, batch=ca_batch
+    )
     cb_evals, cb_batch = (4096, 128) if on_accel else (256, 16)
     cb_frac = bench_callback_overhead(n_evals=cb_evals, batch=cb_batch)
     if platform != "cpu":
@@ -1963,6 +2060,39 @@ def main():
                 "device_loop_callback_overhead_frac": (
                     round(cb_frac, 4) if cb_frac is not None else None
                 ),
+                # round-24 graftrung rows (compile_fmin(asha=)): fused
+                # rung-based early stopping vs the flat compiled sweep,
+                # keyed by backend+config like every device-loop row
+                "compiled_asha_vs_flat_speedup_x": (
+                    round(compiled_asha["speedup_x"], 2)
+                    if compiled_asha and compiled_asha["speedup_x"]
+                    else None
+                ),
+                "compiled_asha_seconds_to_quality": (
+                    round(compiled_asha["asha_seconds_to_quality"], 3)
+                    if compiled_asha
+                    and compiled_asha["asha_seconds_to_quality"]
+                    is not None else None
+                ),
+                "compiled_flat_seconds_to_quality": (
+                    round(compiled_asha["flat_seconds_to_quality"], 3)
+                    if compiled_asha
+                    and compiled_asha["flat_seconds_to_quality"]
+                    is not None else None
+                ),
+                "compiled_asha_best_loss": (
+                    round(compiled_asha["asha_best_loss"], 5)
+                    if compiled_asha else None
+                ),
+                "compiled_asha_reached_flat_best": (
+                    compiled_asha["asha_reached_flat_best"]
+                    if compiled_asha else None
+                ),
+                "compiled_asha_config": {
+                    "backend": platform, "n_evals_flat": ca_flat,
+                    "n_evals_asha": ca_asha, "batch": ca_batch,
+                    "eta": 2, "rung_epochs": 1, "n_rungs": 3,
+                },
                 "seconds_to_best_at_1k": round(sec_1k, 2),
                 "best_loss_at_1k": round(best_1k, 5),
                 "seconds_to_best_at_1k_spec8": round(spec_sec_1k, 2),

@@ -150,6 +150,8 @@ def compile_fmin(
     resume=False,
     fs=None,
     metrics_registry=None,
+    asha=None,
+    artifact_callback=None,
 ):
     """Compile a full HPO experiment into one reusable device program.
 
@@ -241,6 +243,34 @@ def compile_fmin(
         ``device_loop_after_chunk_before_ckpt`` /
         ``device_loop_after_ckpt_before_next_chunk`` plus the durable
         saver's torn-publish window).
+      asha: graftrung -- fuse rung-based successive-halving early
+        stopping (ASHA, Li et al.) INSIDE the compiled scan.  A dict
+        ``{"eta": 2, "rung_epochs": 1, "n_rungs": None}``: each scan
+        step runs one BRACKET of ``batch_size`` fresh configs (so
+        ``batch_size`` must be a power of ``eta``); rung ``r`` trains
+        the live lanes ``rung_epochs * eta**r`` further epochs, then an
+        on-device promotion (:func:`hyperopt_tpu.hyperband.rung_rank`)
+        keeps the best ``1/eta`` and the survivors compact into a
+        statically narrower vmap width -- no host round trip between
+        rungs, and the ladder supersedes the objective's ``n_epochs``.
+        Requires a :class:`TrainableObjective`; composes with
+        ``chunk_size`` (rung/bracket boundaries align to chunk
+        boundaries, so checkpoints/resume stay bitwise -- the promotion
+        record ``rung_of`` rides the carry and the durable bundle) and
+        with ``mesh``/``trial_axis`` (rung training shard_maps over the
+        gcd-sized sub-mesh, :func:`hyperopt_tpu.parallel.mesh.
+        rung_submesh`; a 1-device sub-mesh is bitwise the unsharded
+        program); refuses ``loss_threshold``/``no_progress_steps``/
+        ``cand_axis``/vectorized seed sweeps.  ``best``/``best_loss``
+        rank FULL-FIDELITY trials only; the result dict gains
+        ``rung_of`` [N] and an ``asha`` ladder-metadata dict.
+      artifact_callback: host callable receiving one dict per bracket
+        (``{"bracket", "slot", "loss", "params"}`` -- the full-fidelity
+        winner's slot, loss, and trained params pytree as host numpy),
+        streamed through the same declared-``io_callback`` seam as
+        progress rows.  Requires ``asha=`` and ``chunk_size=``; when
+        unset, dispatches use the callback-free twin and never even
+        stack the winner rows (zero extra dispatches, zero overhead).
 
     ``fn`` may also be a :class:`TrainableObjective` -- a stateful
     per-trial training loop (``init_fn``/``step_fn``/``loss_fn``,
@@ -361,7 +391,10 @@ def compile_fmin(
         elif trial_axis in mesh.shape:
             shard_trials = True
             n_dev = int(mesh.shape[trial_axis])
-            if B % n_dev:
+            # asha= rung evaluation shard_maps over a gcd-sized sub-mesh
+            # (rung_submesh), so shrinking rung widths need not divide
+            # the axis; only the plain GSPMD population path requires it
+            if B % n_dev and asha is None:
                 raise ValueError(
                     f"batch_size={B} must be a multiple of mesh axis "
                     f"{trial_axis!r} size {n_dev}"
@@ -380,6 +413,74 @@ def compile_fmin(
     accepts_active = (
         not trainable and "active" in inspect.signature(fn).parameters
     )
+    init_accepts_active = trainable and (
+        "active" in inspect.signature(fn.init_fn).parameters
+    )
+
+    # ---- graftrung (asha=): fused rung-based early stopping --------------
+    asha_mode = asha is not None
+    a_eta = a_rung_epochs = a_n_rungs = None
+    asha_ladder = None
+    if asha_mode:
+        if not isinstance(asha, dict):
+            raise ValueError(
+                "asha= takes a dict of rung options "
+                '({"eta", "rung_epochs", "n_rungs"})'
+            )
+        unknown = set(asha) - {"eta", "rung_epochs", "n_rungs"}
+        if unknown:
+            raise ValueError(
+                f"unknown asha option(s) {sorted(unknown)}; expected "
+                "eta|rung_epochs|n_rungs"
+            )
+        if not trainable:
+            raise ValueError(
+                "asha= fuses rung-based early stopping into the "
+                "per-trial training loop; fn must be a TrainableObjective"
+            )
+        if loss_threshold is not None or no_progress_steps is not None:
+            raise ValueError(
+                "asha= does not compose with loss_threshold/"
+                "no_progress_steps (rung promotion IS the early "
+                "stopping); drop one"
+            )
+        if cand_axis is not None:
+            raise ValueError(
+                "asha= does not compose with cand_axis (bracket "
+                "populations shard over trial_axis; there is no "
+                "sequential candidate sweep to shard)"
+            )
+        a_eta = int(asha.get("eta", 2))
+        a_rung_epochs = int(asha.get("rung_epochs", 1))
+        from .hyperband import rung_schedule
+
+        try:
+            asha_ladder = rung_schedule(
+                B, a_eta, asha.get("n_rungs"), a_rung_epochs
+            )
+        except ValueError as e:
+            raise ValueError(
+                f"asha bracket geometry: {e} (batch_size is the "
+                "bracket population)"
+            ) from None
+        a_n_rungs = len(asha_ladder)
+    if artifact_callback is not None:
+        if not asha_mode:
+            raise ValueError(
+                "artifact_callback streams rung-winner params; it "
+                "requires asha="
+            )
+        if not chunked:
+            raise ValueError(
+                "artifact_callback rides the chunked scan path; pass "
+                "chunk_size= to enable it"
+            )
+    # the rung seam shard_maps explicit device blocks (compile_sha's
+    # graftmesh idiom) instead of GSPMD constraints on the suggest batch
+    asha_shard = False
+    if asha_mode and shard_trials:
+        asha_shard = True
+        shard_trials = False
 
     def eval_batch(values, active):
         """values/active [D, B] -> losses [B] via the user objective."""
@@ -390,6 +491,29 @@ def compile_fmin(
             })
         return fn(cfg)
 
+    def _trial_cfg(vcol, acol):
+        """One trial's hyperparameter dict with inactive-branch dims
+        MASKED to 0.0.  The suggest kernels sample every dim and leave
+        unsampled-branch values in place; the host driver's domain memo
+        simply omits inactive labels, but a scalar dict cannot -- so
+        conditional-space trainables pin them to 0.0 instead of training
+        on another branch's garbage (PR-10 residue)."""
+        return {
+            label: jnp.where(acol[d], vcol[d], 0.0)
+            for d, label in enumerate(ps.labels)
+        }
+
+    def _init_one(k, vcol, acol):
+        """Build one trial's carried state; ``init_fn`` may accept the
+        per-dim ``active`` mask (keyword, like plain objectives) to
+        size/shape conditional branches itself."""
+        cfg = _trial_cfg(vcol, acol)
+        if init_accepts_active:
+            return fn.init_fn(k, cfg, active={
+                label: acol[d] for d, label in enumerate(ps.labels)
+            })
+        return fn.init_fn(k, cfg)
+
     def eval_batch_trainable(key, values, active):
         """The stateful seam: per-trial init -> n_epochs inner
         ``fori_loop`` training -> loss, vmapped over the trial batch.
@@ -398,9 +522,8 @@ def compile_fmin(
         ekeys = jax.random.split(jax.random.fold_in(key, 0x7EA1), B)
 
         def one(vcol, acol, k):
-            del acol  # trainable cfgs are scalar dicts; inactive dims 0
-            cfg = {label: vcol[d] for d, label in enumerate(ps.labels)}
-            state = fn.init_fn(k, cfg)
+            cfg = _trial_cfg(vcol, acol)
+            state = _init_one(k, vcol, acol)
             state = jax.lax.fori_loop(
                 0, fn.n_epochs,
                 lambda e, s: fn.step_fn(s, cfg, e),
@@ -566,6 +689,175 @@ def compile_fmin(
         best_i = jnp.argmin(keyed)
         return values, active, losses, valid, best_i, n_done
 
+    # ---- graftrung bracket machinery (asha=) -----------------------------
+    # One scan step = one BRACKET: B fresh configs; rung 0 trains every
+    # lane ``rung_epochs`` epochs; an on-device promotion (shared
+    # ``hyperband.rung_rank``: stable argsort, non-finite last) keeps the
+    # best B/eta, and the survivors COMPACT into a statically narrower
+    # vmap width to train eta x deeper -- rung by rung, unrolled at trace
+    # time.  Masking dead lanes would save nothing under vmap (every lane
+    # still computes); compaction is where the early-stopping compute win
+    # comes from.  The promotion record (``rung_of``: the highest rung
+    # each history slot reached, -1 for warm/untouched slots) rides the
+    # scan carry next to the history, so chunk checkpoints capture it and
+    # kill-and-resume stays bitwise; suggest keys fold the same GLOBAL
+    # bracket index as the plain scan's step index, so chunked == flat.
+    run_asha = None
+    _asha_sub = None
+    _asha_k = 1
+    if asha_mode:
+        from .hyperband import rung_rank
+
+        if asha_shard:
+            from .parallel.mesh import rung_submesh
+
+            # ONE sub-mesh for the whole program, sized by the SMALLEST
+            # rung (every wider rung width is a power-of-eta multiple of
+            # it, so one gcd covers the whole ladder; per-rung sub-mesh
+            # shrinking would put multiple device sets in one program).
+            # k == 1 degenerates to the unsharded body: the bitwise-
+            # parity anchor.
+            _asha_sub, _asha_k = rung_submesh(
+                mesh, trial_axis, asha_ladder[-1][0]
+            )
+
+        def _build_rung_train(width, n_ep, e0):
+            """The rung-``r`` trainer at STATIC width: every live lane
+            advances ``n_ep`` epochs from cumulative offset ``e0`` (the
+            epoch counter a survivor sees is continuous across rungs,
+            exactly ``compile_sha``'s ladder), then reports its loss."""
+
+            def unsharded(states, vals, act):
+                def one(s, vcol, acol):
+                    cfg = _trial_cfg(vcol, acol)
+                    s = jax.lax.fori_loop(
+                        e0, e0 + n_ep,
+                        lambda e, ss: fn.step_fn(ss, cfg, e),
+                        s,
+                    )
+                    return s, fn.loss_fn(s, cfg)
+
+                return jax.vmap(one, in_axes=(0, 1, 1))(states, vals, act)
+
+            if not asha_shard or _asha_k == 1:
+                return unsharded
+            from jax.sharding import PartitionSpec as Pspec
+
+            from .parallel.sharded import _shard_map
+
+            def block(states, vals, act):
+                # each device trains its member block collective-free;
+                # the rung boundary pays ONE loss all_gather so the
+                # (replicated) promotion ranking sees every member
+                st, ls = unsharded(states, vals, act)
+                return st, jax.lax.all_gather(ls, trial_axis, tiled=True)
+
+            return _shard_map()(
+                block, mesh=_asha_sub,
+                in_specs=(Pspec(trial_axis), Pspec(None, trial_axis),
+                          Pspec(None, trial_axis)),
+                out_specs=(Pspec(trial_axis), Pspec()),
+                check_vma=False,
+            )
+
+        _rung_train_fns = [
+            _build_rung_train(width, n_ep, e0)
+            for width, n_ep, e0 in asha_ladder
+        ]
+
+        def asha_bracket(base_key, c0, carry, i, collect=False):
+            """One bracket: suggest B, write the slots, then the unrolled
+            compacting rung ladder.  ``collect=True`` additionally
+            returns the full-fidelity winner's (slot, loss, trained
+            params) for the artifact io_callback seam."""
+            hist, rung_of = carry
+            values, active, losses, valid = hist
+            key = jax.random.fold_in(jax.random.fold_in(base_key, c0), i)
+            new_vals, new_act = suggest(key, values, active, losses, valid)
+            ekeys = jax.random.split(jax.random.fold_in(key, 0x7EA1), B)
+            cur_states = jax.vmap(_init_one, in_axes=(0, 1, 1))(
+                ekeys, new_vals, new_act
+            )
+            # every bracket member owns its history slot up front;
+            # per-rung losses and the promotion record overwrite in place
+            idx = c0 + i * B + jnp.arange(B)
+            values = values.at[:, idx].set(new_vals)
+            active = active.at[:, idx].set(new_act)
+            valid = valid.at[idx].set(True)
+            cur_slots = idx
+            cur_vals, cur_act = new_vals, new_act
+            win = None
+            for r, (width, n_ep, e0) in enumerate(asha_ladder):
+                cur_states, cur_losses = _rung_train_fns[r](
+                    cur_states, cur_vals, cur_act
+                )
+                cur_losses = cur_losses.astype(jnp.float32)
+                losses = losses.at[cur_slots].set(cur_losses)
+                rung_of = rung_of.at[cur_slots].set(jnp.int32(r))
+                order = rung_rank(cur_losses, 1, width)[0]
+                if r + 1 < a_n_rungs:
+                    keep = asha_ladder[r + 1][0]
+                    sel = order[:keep]
+                    cur_states = jax.tree_util.tree_map(
+                        lambda x: x[sel], cur_states
+                    )
+                    cur_vals = cur_vals[:, sel]
+                    cur_act = cur_act[:, sel]
+                    cur_slots = cur_slots[sel]
+                elif collect:
+                    w = order[0]
+                    win = {
+                        "slot": cur_slots[w].astype(jnp.int32),
+                        "loss": cur_losses[w],
+                        "params": jax.tree_util.tree_map(
+                            lambda x: x[w], cur_states
+                        ),
+                    }
+            new_carry = (
+                HistoryState(values, active, losses, valid), rung_of
+            )
+            return (new_carry, win) if collect else new_carry
+
+        def _asha_summary(hist, rung_of):
+            """Progress 'best' = best among FULL-FIDELITY trials only: a
+            rung-0 loss after one epoch is not comparable to a survivor's
+            (the host-ASHA runners report the same way)."""
+            ok = hist.valid & jnp.isfinite(hist.losses) & (
+                rung_of == jnp.int32(a_n_rungs - 1)
+            )
+            best = jnp.min(jnp.where(ok, hist.losses, jnp.inf))
+            done = jnp.sum(hist.valid.astype(jnp.int32))
+            return best, done
+
+        def _asha_best_host(losses_np, valid_np, rung_np):
+            ok = (
+                valid_np & np.isfinite(losses_np)
+                & (rung_np == a_n_rungs - 1)
+            )
+            keyed = np.where(ok, losses_np, np.inf)
+            if not np.isfinite(keyed).any():
+                # degenerate fallback (every full-fidelity trial failed):
+                # best finite loss at any rung, so _package_result can
+                # still name a config before raising on the all-failed case
+                keyed = np.where(
+                    valid_np & np.isfinite(losses_np), losses_np, np.inf
+                )
+            return int(np.argmin(keyed))
+
+        @jax.jit
+        def run_asha(seed_arr, values, active, losses, valid, rung_of, c0):
+            base_key = jax.random.key(seed_arr)
+
+            def body(carry, i):
+                return asha_bracket(base_key, c0, carry, i), None
+
+            (hist, rung_of), _ = jax.lax.scan(
+                body,
+                (HistoryState(values, active, losses, valid), rung_of),
+                jnp.arange(n_steps),
+            )
+            return (*tuple(hist), rung_of)
+
     # ---- chunked-scan machinery (chunk_size=) ----------------------------
     # the flat scan above dispatches once; the chunked twin dispatches one
     # compiled chunk program per chunk so every boundary is a progress /
@@ -575,7 +867,134 @@ def compile_fmin(
     run_chunk = run_chunk_cb = None
     ck_guard = None
     resume_default = bool(resume)
-    if chunked:
+    if chunked and asha_mode:
+        from jax.experimental import io_callback
+
+        chunk_steps = -(-int(chunk_size) // B)
+        n_chunks = -(-n_steps // chunk_steps)
+
+        def _asha_chunk_impl(seed_arr, values, active, losses, valid,
+                             rung_of, c0, chunk_idx, collect=False):
+            base_key = jax.random.key(seed_arr)
+
+            def body(carry, j):
+                i = chunk_idx * chunk_steps + j
+                if collect:
+                    # tail-padded steps emit the zero winner row; the
+                    # artifact sink drops them by count on the host
+                    return jax.lax.cond(
+                        i < n_steps,
+                        lambda c: asha_bracket(
+                            base_key, c0, c, i, collect=True
+                        ),
+                        lambda c: (c, _winner_zeros()),
+                        carry,
+                    )
+                return jax.lax.cond(
+                    i < n_steps,
+                    lambda c: asha_bracket(base_key, c0, c, i),
+                    lambda c: c,
+                    carry,
+                ), None
+
+            (hist, rung_of), ys = jax.lax.scan(
+                body,
+                (HistoryState(values, active, losses, valid), rung_of),
+                jnp.arange(chunk_steps),
+            )
+            best, done = _asha_summary(hist, rung_of)
+            out = (*tuple(hist), rung_of, best, done)
+            return (out, ys) if collect else out
+
+        run_chunk = jax.jit(_asha_chunk_impl)
+
+        if artifact_callback is not None:
+            # abstract one-trial state pytree: the zero template the
+            # padded tail steps emit in place of a winner row
+            _state_struct = jax.eval_shape(
+                lambda s, v, a: _init_one(jax.random.key(s), v, a),
+                jax.ShapeDtypeStruct((), np.uint32),
+                jax.ShapeDtypeStruct((D,), jnp.float32),
+                jax.ShapeDtypeStruct((D,), jnp.bool_),
+            )
+
+            def _winner_zeros():
+                return {
+                    "loss": jnp.float32(0),
+                    "params": jax.tree_util.tree_map(
+                        lambda t: jnp.zeros(t.shape, t.dtype),
+                        _state_struct,
+                    ),
+                    "slot": jnp.int32(0),
+                }
+
+        if progress_callback is not None or artifact_callback is not None:
+            if progress_callback is not None:
+                def _progress_sink(best, done, chunk_idx):
+                    progress_callback({
+                        "chunk": int(chunk_idx),
+                        "trials_done": int(done),
+                        "best_loss": float(best),
+                    })
+
+            if artifact_callback is not None:
+                def _artifact_sink(slots, wlosses, params, chunk_idx):
+                    done_prev = int(chunk_idx) * int(chunk_steps)
+                    n_real = min(int(chunk_steps), n_steps - done_prev)
+                    for j in range(n_real):
+                        artifact_callback({
+                            "bracket": done_prev + j,
+                            "slot": int(slots[j]),
+                            "loss": float(wlosses[j]),
+                            "params": jax.tree_util.tree_map(
+                                lambda x: np.asarray(x)[j], params
+                            ),
+                        })
+
+            def _asha_cb_impl(seed_arr, values, active, losses, valid,
+                              rung_of, c0, chunk_idx):
+                if artifact_callback is not None:
+                    out, ys = _asha_chunk_impl(
+                        seed_arr, values, active, losses, valid,
+                        rung_of, c0, chunk_idx, collect=True,
+                    )
+                    # rung winners stream through the SAME declared
+                    # io_callback seam as progress rows (GL401's
+                    # per-program escape hatch): one ordered callback
+                    # per chunk carrying every bracket winner's trained
+                    # params -- cadence-off dispatches never build ys
+                    io_callback(
+                        _artifact_sink, None, ys["slot"], ys["loss"],
+                        ys["params"], chunk_idx, ordered=True,
+                    )
+                else:
+                    out = _asha_chunk_impl(
+                        seed_arr, values, active, losses, valid,
+                        rung_of, c0, chunk_idx,
+                    )
+                if progress_callback is not None:
+                    io_callback(
+                        _progress_sink, None, out[5], out[6], chunk_idx,
+                        ordered=True,
+                    )
+                return out
+
+            run_chunk_cb = jax.jit(_asha_cb_impl)
+
+        if checkpoint_path is not None:
+            from .hyperband import _algo_identity, _space_fingerprint
+            from .pyll.base import as_apply
+
+            ck_guard = [
+                "device-loop-chunk", 1, str(algo),
+                _space_fingerprint(as_apply(space)), _algo_identity(fn),
+                int(n_steps), int(B), int(chunk_steps), int(cap),
+                # the asha ladder is part of the experiment identity: a
+                # bundle from a different rung geometry must refuse
+                "asha", a_eta, a_rung_epochs, a_n_rungs,
+            ]
+
+    elif chunked:
         from jax.experimental import io_callback
 
         from .ops.kernels import history_summary
@@ -654,6 +1073,9 @@ def compile_fmin(
         if init is not None:
             iv, ia, il, ivd, init_c0, _ = _unpack_init(init)
             init_state = (iv, ia, il, ivd)
+            if asha_mode:
+                # warm trials predate this run's brackets: no rung record
+                init_state += (np.full(cap, -1, dtype=np.int32),)
         if resume_now:
             if checkpoint_path is None:
                 raise ValueError("resume=True needs checkpoint_path")
@@ -681,22 +1103,28 @@ def compile_fmin(
                 start_chunk = int(bundle["chunk_next"])
                 state = (bundle["values"], bundle["active"],
                          bundle["losses"], bundle["valid"])
+                if asha_mode:
+                    state += (bundle["rung_of"],)
         if state is None:
             if init_state is not None:
                 state, c0 = init_state, init_c0
             else:
                 state = _zero_state()
         out = None
+        n_state = 5 if asha_mode else 4
         for ci in range(start_chunk, n_chunks):
+            # artifact streaming is per-bracket, not cadenced: every
+            # chunk dispatches the cb twin when it is armed
             use_cb = run_chunk_cb is not None and (
-                (ci + 1) % int(progress_every) == 0
+                artifact_callback is not None
+                or (ci + 1) % int(progress_every) == 0
                 or ci == n_chunks - 1
             )
             prog = run_chunk_cb if use_cb else run_chunk
             out = prog(
                 np.uint32(seed_u), *state, np.int32(c0), np.int32(ci)
             )
-            state = out[:4]
+            state = out[:n_state]
             fs_.crashpoint("device_loop_after_chunk_before_ckpt")
             if checkpoint_path is not None and (
                 (ci + 1) % int(checkpoint_every) == 0
@@ -705,27 +1133,36 @@ def compile_fmin(
                 from .utils.checkpoint import save_device_chunk
 
                 host = jax.device_get(state)  # one batched fetch
-                save_device_chunk(checkpoint_path, {
+                bundle = {
                     "guard": ck_guard, "seed": seed_u, "c0": int(c0),
                     "chunk_next": ci + 1, "n_chunks": int(n_chunks),
                     "values": np.asarray(host[0]),
                     "active": np.asarray(host[1]),
                     "losses": np.asarray(host[2]),
                     "valid": np.asarray(host[3]),
-                }, fs=fs_)
+                }
+                if asha_mode:
+                    bundle["rung_of"] = np.asarray(host[4])
+                save_device_chunk(checkpoint_path, bundle, fs=fs_)
                 fs_.crashpoint(
                     "device_loop_after_ckpt_before_next_chunk"
                 )
-        values, active, losses, valid = (
-            np.asarray(a) for a in jax.device_get(state)
-        )
+        host = [np.asarray(a) for a in jax.device_get(state)]
+        values, active, losses, valid = host[:4]
+        rung_np = host[4] if asha_mode else None
         n_ran = n_steps * B
         total = c0 + n_ran
-        keyed = np.where(valid & np.isfinite(losses), losses, np.inf)
-        best_i = int(np.argmin(keyed))
+        if asha_mode:
+            best_i = _asha_best_host(losses, valid, rung_np)
+        else:
+            keyed = np.where(
+                valid & np.isfinite(losses), losses, np.inf
+            )
+            best_i = int(np.argmin(keyed))
         return _package_result(
             values[:, :total], active[:, :total], losses[:total],
             best_i, n_ran, total, return_trials,
+            rung_of_np=None if rung_np is None else rung_np[:total],
         )
 
     cat_dims = set(ps.cat_idx.tolist())
@@ -779,6 +1216,14 @@ def compile_fmin(
         return outs
 
     def _zero_state():
+        zeros = (
+            np.zeros((D, cap), dtype=np.float32),
+            np.zeros((D, cap), dtype=bool),
+            np.zeros(cap, dtype=np.float32),
+            np.zeros(cap, dtype=bool),
+        )
+        if asha_mode:  # promotion record: -1 = no rung reached yet
+            zeros += (np.full(cap, -1, dtype=np.int32),)
         if jax.process_count() > 1:
             # multi-process (jax.distributed) runtime: inputs
             # committed to one local device cannot feed a global-mesh
@@ -786,19 +1231,9 @@ def compile_fmin(
             # inputs are placed by jit as fully-replicated over the
             # global mesh (same contract as
             # parallel.sharded._history_inputs)
-            return (
-                np.zeros((D, cap), dtype=np.float32),
-                np.zeros((D, cap), dtype=bool),
-                np.zeros(cap, dtype=np.float32),
-                np.zeros(cap, dtype=bool),
-            )
+            return zeros
         if not zero_buffers:  # non-donated, so safely reusable
-            zero_buffers.append(jax.device_put((
-                np.zeros((D, cap), dtype=np.float32),
-                np.zeros((D, cap), dtype=bool),
-                np.zeros(cap, dtype=np.float32),
-                np.zeros(cap, dtype=bool),
-            )))
+            zero_buffers.append(jax.device_put(zeros))
         return zero_buffers[0]
 
     def _unpack_init(init):
@@ -846,12 +1281,41 @@ def compile_fmin(
         if isinstance(seed, (list, tuple)) or (
             isinstance(seed, np.ndarray) and seed.ndim > 0
         ):
+            if asha_mode:
+                raise ValueError(
+                    "asha= does not compose with vectorized seed "
+                    "sweeps; run seeds individually"
+                )
             if init is not None:
                 raise ValueError(
                     "init= resume is single-seed; run the seed sweep "
                     "fresh or resume seeds individually"
                 )
             return _runner_seeds(list(seed), return_trials)
+        if asha_mode:
+            if init is None:
+                c0 = 0
+                state0 = _zero_state()
+            else:
+                values0, active0, losses0, valid0, c0, _ = (
+                    _unpack_init(init)
+                )
+                state0 = (values0, active0, losses0, valid0,
+                          np.full(cap, -1, dtype=np.int32))
+            out_dev = run_asha(
+                np.uint32(int(seed) % (2**32)), *state0, np.int32(c0)
+            )
+            values, active, losses, valid, rung_np = (
+                np.asarray(a) for a in jax.device_get(out_dev)
+            )
+            n_ran = n_steps * B
+            total = c0 + n_ran
+            best_i = _asha_best_host(losses, valid, rung_np)
+            return _package_result(
+                values[:, :total], active[:, :total], losses[:total],
+                best_i, n_ran, total, return_trials,
+                rung_of_np=rung_np[:total],
+            )
         if init is None:
             c0 = 0
             best0 = np.float32(np.inf)
@@ -885,7 +1349,7 @@ def compile_fmin(
         )
 
     def _package_result(values_np, active_np, losses_np, bi, n_ran, total,
-                        return_trials):
+                        return_trials, rung_of_np=None):
         if not np.isfinite(losses_np).any():
             from .exceptions import AllTrialsFailed
 
@@ -912,6 +1376,16 @@ def compile_fmin(
             "n_evals": n_ran,
             "n_total": total,
         }
+        if rung_of_np is not None:
+            # graftrung promotion record: highest rung each slot reached
+            # (-1 = warm/untouched); full fidelity is rung n_rungs-1
+            out["rung_of"] = rung_of_np
+            out["asha"] = {
+                "eta": a_eta,
+                "rung_epochs": a_rung_epochs,
+                "n_rungs": a_n_rungs,
+                "ladder": [tuple(row) for row in asha_ladder],
+            }
         if return_trials:
             out["trials"] = _to_trials(ps, values_np, active_np, losses_np)
         return out
@@ -919,11 +1393,14 @@ def compile_fmin(
     # the jitted experiment program itself, exposed for the graftir
     # registry (analysis/ir.py traces it over abstract inputs) -- the
     # runner closure is the only other holder
-    runner._compiled_run = run
+    runner._compiled_run = run_asha if asha_mode else run
     runner._history_capacity = cap
     runner._packed_space = ps
     runner._compiled_chunk = run_chunk
     runner._compiled_chunk_cb = run_chunk_cb
+    if asha_mode:
+        runner._asha_ladder = list(asha_ladder)
+        runner._asha_submesh_devices = _asha_k
     if chunked:
         runner._chunk_geometry = {
             "chunk_steps": chunk_steps,
@@ -1073,6 +1550,100 @@ def _registry_train_step(p):
         algo="tpe", n_startup_jobs=2, n_EI_candidates=8,
     )
     return ProgramCapture(fn=runner._compiled_run, args=_scan_args(runner))
+
+
+def _asha_args(runner, tail_dtypes):
+    """The asha program families' abstract inputs: seed + the FIVE
+    carry arrays (history + ``rung_of`` promotion record) + tail."""
+    import jax
+    import jax.numpy as jnp
+
+    cap = runner._history_capacity
+    D = runner._packed_space.n_dims
+    return (
+        jax.ShapeDtypeStruct((), np.uint32),           # seed
+        jax.ShapeDtypeStruct((D, cap), jnp.float32),   # values
+        jax.ShapeDtypeStruct((D, cap), jnp.bool_),     # active
+        jax.ShapeDtypeStruct((cap,), jnp.float32),     # losses
+        jax.ShapeDtypeStruct((cap,), jnp.bool_),       # valid
+        jax.ShapeDtypeStruct((cap,), jnp.int32),       # rung_of
+    ) + tuple(jax.ShapeDtypeStruct((), dt) for dt in tail_dtypes)
+
+
+def _asha_registry_runner(**kw):
+    """One shared build for the graftrung registry family: a tiny
+    mlp-tune bracket (B=4, eta=2, two rungs) -- small enough to trace
+    fast, structurally identical to production ladders."""
+    from .models.synthetic import mlp_tune_objective, mlp_tune_space
+
+    return compile_fmin(
+        mlp_tune_objective(n_epochs=1, n_train=32, in_dim=4, hidden=8),
+        mlp_tune_space(), max_evals=8, batch_size=4,
+        algo="tpe", n_startup_jobs=2, n_EI_candidates=8,
+        asha={"eta": 2, "rung_epochs": 1, "n_rungs": 2}, **kw,
+    )
+
+
+@register_program(
+    "device_loop.asha_scan",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_asha_scan(p):
+    """The fused-ASHA experiment scan (``asha=``): per-bracket suggest,
+    the unrolled compacting rung ladder (train -> rank -> gather
+    survivors) and the ``rung_of`` promotion record, all inside one
+    program -- the graftrung tentpole's flat anchor."""
+    import jax.numpy as jnp
+
+    runner = _asha_registry_runner()
+    return ProgramCapture(
+        fn=runner._compiled_run, args=_asha_args(runner, (jnp.int32,))
+    )
+
+
+@register_program(
+    "device_loop.asha_chunked_scan",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_asha_chunked_scan(p):
+    """One chunk of the fused-ASHA scan: the same bracket math over
+    ``chunk_steps`` global bracket indices plus the full-fidelity
+    summary reductions.  Callback-free -- cadence-off dispatches must
+    stay that way."""
+    import jax.numpy as jnp
+
+    runner = _asha_registry_runner(chunk_size=4)
+    return ProgramCapture(
+        fn=runner._compiled_chunk,
+        args=_asha_args(runner, (jnp.int32, jnp.int32)),
+    )
+
+
+@register_program(
+    "device_loop.asha_chunked_scan_cb",
+    families=("hyperopt_tpu.device_loop:compile_fmin",),
+)
+def _registry_asha_chunked_scan_cb(p):
+    """The streaming twin of ``device_loop.asha_chunked_scan``: the
+    identical chunk body plus the DECLARED ordered ``io_callback``\\ s
+    -- the progress row and the per-bracket rung-winner artifact rows
+    (trained params out of the running program).  GL401's explicit
+    per-program escape hatch covers both."""
+    import jax.numpy as jnp
+
+    runner = _asha_registry_runner(
+        chunk_size=4,
+        progress_callback=lambda row: None,
+        artifact_callback=lambda row: None,
+    )
+    return ProgramCapture(
+        fn=runner._compiled_chunk_cb,
+        args=_asha_args(runner, (jnp.int32, jnp.int32)),
+        allowed_callbacks=("io_callback",),
+        # shares the bracket closure with device_loop.asha_chunked_scan
+        # (same build, callbacks appended): promotion already pinned
+        x64_check=False,
+    )
 
 
 def _to_trials(ps, values, active, losses, trials=None):

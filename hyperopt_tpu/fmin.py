@@ -919,7 +919,11 @@ def fmin(
     passes builder knobs through (``batch_size``, ``chunk_size``,
     ``progress_callback``, ``checkpoint_path``/``resume`` for
     kill-and-resume, ``seed`` to pin the device seed, or a prebuilt
-    ``runner=`` for compile reuse across calls).
+    ``runner=`` for compile reuse across calls).  A
+    ``TrainableObjective`` may add ``compiled_options={"asha": {...}}``
+    (graftrung): rung-based successive-halving early stopping fused
+    inside the compiled scan -- per-bracket promotions on-device, no
+    host round trip between rungs; see ``compile_fmin``'s ``asha=``.
     """
     if algo is None:
         if bool(engine) or ask_ahead is not None:

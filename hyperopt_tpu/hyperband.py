@@ -40,6 +40,8 @@ __all__ = [
     "compile_sha",
     "compile_hyperband",
     "budget_aware",
+    "rung_schedule",
+    "rung_rank",
 ]
 
 
@@ -152,6 +154,63 @@ def _int_log(ratio, eta):
         b *= eta
         k += 1
     return k
+
+
+def rung_schedule(n_configs, eta, n_rungs=None, steps_per_rung=1):
+    """The shared SHA rung ladder: ``[(width, steps, offset), ...]``.
+
+    ONE definition of the successive-halving geometry for every
+    on-device runner (:func:`compile_sha`'s per-rung programs and the
+    compiled-ASHA device loop, :func:`hyperopt_tpu.device_loop.
+    compile_fmin` with ``asha=``), so the two regimes cannot drift:
+    rung ``r`` runs its surviving ``n_configs // eta**r`` members for
+    ``steps_per_rung * eta**r`` INCREMENTAL steps (budgets continue
+    from the trained state -- learning-curve halving), starting at
+    cumulative step ``offset``.  ``n_configs`` must be a power of
+    ``eta`` so every promotion keeps an exact ``1/eta``;``n_rungs``
+    defaults to halving down to a single survivor.
+    """
+    p0 = int(n_configs)
+    eta = int(eta)
+    if eta < 2:
+        raise ValueError(f"eta={eta} must be >= 2")
+    max_rungs = _int_log(p0, eta)
+    if eta**max_rungs != p0:
+        raise ValueError(
+            f"n_configs={p0} must be a power of eta={eta}"
+        )
+    if n_rungs is None:
+        n_rungs = max_rungs + 1
+    if not 1 <= int(n_rungs) <= max_rungs + 1:
+        raise ValueError(
+            f"n_rungs={n_rungs} must be in [1, {max_rungs + 1}] for "
+            f"n_configs={p0}, eta={eta}"
+        )
+    ladder = []
+    offset = 0
+    for r in range(int(n_rungs)):
+        steps = int(steps_per_rung) * eta**r
+        ladder.append((p0 // eta**r, steps, offset))
+        offset += steps
+    return ladder
+
+
+def rung_rank(losses, replicas, p_live):
+    """Shared on-device promotion ranking: ``[R * p_live]`` losses ->
+    ``[R, p_live]`` GLOBAL member indices, best first within each
+    bracket.  Non-finite losses rank last (inf-keyed); ties break by
+    member order (stable argsort) -- the single promotion rule both
+    :func:`compile_sha` rung programs and the compiled-ASHA scan use,
+    so a rung's survivors are the same members under every execution
+    model."""
+    import jax.numpy as jnp
+
+    keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
+    by_rep = keyed.reshape(replicas, p_live)
+    order = jnp.argsort(by_rep, axis=1)  # [R, p_live]
+    return order + (
+        jnp.arange(replicas, dtype=order.dtype)[:, None] * p_live
+    )
 
 
 def successive_halving(
@@ -604,16 +663,10 @@ def compile_sha(
     R = int(replicas)
     if R < 1:
         raise ValueError(f"replicas={R} must be >= 1")
-    max_rungs = int(round(math.log(P0, eta)))
-    if eta**max_rungs != P0:
-        raise ValueError(f"n_configs={P0} must be a power of eta={eta}")
-    if n_rungs is None:
-        n_rungs = max_rungs + 1
-    if not 1 <= n_rungs <= max_rungs + 1:
-        raise ValueError(
-            f"n_rungs={n_rungs} must be in [1, {max_rungs + 1}] for "
-            f"n_configs={P0}, eta={eta}"
-        )
+    # the shared SHA geometry (also the compiled-ASHA device loop's):
+    # validates the power-of-eta population and rung count in one place
+    ladder = rung_schedule(P0, eta, n_rungs, steps_per_rung)
+    n_rungs = len(ladder)
     def _validate_leading(state):
         leading = {x.shape[0] for x in jax.tree.leaves(state)}
         if leading != {R * P0}:
@@ -694,13 +747,8 @@ def compile_sha(
                 losses = jax.lax.with_sharding_constraint(
                     losses, NamedSharding(mesh, PartitionSpec())
                 )
-            keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
             # rank WITHIN each bracket; emit global member indices
-            by_rep = keyed.reshape(R, p_live)
-            order = jnp.argsort(by_rep, axis=1)  # [R, p_live]
-            order = order + (
-                jnp.arange(R, dtype=order.dtype)[:, None] * p_live
-            )
+            order = rung_rank(losses, R, p_live)
             if mode == "constraint":
                 from jax.sharding import NamedSharding, PartitionSpec
 
@@ -719,17 +767,14 @@ def compile_sha(
         Returns ``(jitted_fn, member_sharding)`` -- the runner places
         rung inputs with the sharding before each call, since sub-mesh
         device sets shrink with the rung population."""
-        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import NamedSharding
         from jax.sharding import PartitionSpec as Pspec
 
+        from .parallel.mesh import rung_submesh
         from .parallel.sharded import _shard_map
 
         m = R * p_live
-        n_dev_total = int(mesh.shape[trial_axis])
-        k = math.gcd(m, n_dev_total)
-        sub = Mesh(
-            np.asarray(list(mesh.devices.flat)[:k]), (trial_axis,)
-        )
+        sub, k = rung_submesh(mesh, trial_axis, m)
         p_loc = m // k
 
         def body(state, log_h, key):
@@ -751,12 +796,7 @@ def compile_sha(
             losses = jax.lax.all_gather(
                 losses_seq[-1], trial_axis, tiled=True
             )
-            keyed = jnp.where(jnp.isfinite(losses), losses, jnp.inf)
-            by_rep = keyed.reshape(R, p_live)
-            order = jnp.argsort(by_rep, axis=1)
-            order = order + (
-                jnp.arange(R, dtype=order.dtype)[:, None] * p_live
-            )
+            order = rung_rank(losses, R, p_live)
             return state, losses, order
 
         fn = jax.jit(_shard_map()(
@@ -769,9 +809,7 @@ def compile_sha(
 
     rung_fns = []
     rung_shardings = []  # shard_map mode: per-rung member placement
-    p = P0
-    for r in range(n_rungs):
-        n_steps_r = int(steps_per_rung) * eta**r
+    for p, n_steps_r, _ in ladder:
         if mode == "shard_map":
             fn, sharding = make_rung_sharded(n_steps_r, p)
             rung_fns.append(fn)
@@ -779,8 +817,6 @@ def compile_sha(
         else:
             rung_fns.append(make_rung(n_steps_r, p))
             rung_shardings.append(None)
-        if r < n_rungs - 1:
-            p //= eta
 
     # -- durable-mode snapshot machinery (rung-boundary checkpoints) ------
     sched_guard = (P0, R, int(eta), int(n_rungs), int(steps_per_rung))

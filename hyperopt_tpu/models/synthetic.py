@@ -19,7 +19,8 @@ import numpy as np
 from .. import hp
 
 __all__ = ["SyntheticDomain", "DOMAINS", "battery", "mixed_space", "branin_fn",
-           "hartmann6_fn", "mlp_tune_objective", "mlp_tune_space"]
+           "hartmann6_fn", "mlp_tune_objective", "mlp_tune_space",
+           "cond_tune_objective", "cond_tune_space"]
 
 
 class SyntheticDomain:
@@ -430,6 +431,105 @@ def mlp_tune_objective(n_epochs=8, n_train=256, in_dim=8, hidden=32,
         momentum = jax.tree.map(
             lambda m, g, p: cfg["momentum"] * m - cfg["lr"] * (
                 g + cfg["wd"] * p
+            ),
+            momentum, grads, params,
+        )
+        params = jax.tree.map(lambda p, m: p + m, params, momentum)
+        return params, momentum
+
+    def loss_fn(state, cfg):
+        params, _ = state
+        return _mse(params)
+
+    return TrainableObjective(init_fn, step_fn, loss_fn, n_epochs=n_epochs)
+
+
+def cond_tune_space():
+    """A CONDITIONAL training search space (nested ``hp.choice``):
+    regularizer family on the outer choice, a Nesterov-style boost
+    behind a second choice nested inside the momentum branch.  The
+    device loop's active-mask contract is what makes this trainable
+    on-device: off-branch dims arrive as 0.0 (the host driver simply
+    omits them), so :func:`cond_tune_objective` reads every label
+    unconditionally without gating on the choice index itself."""
+    return {
+        "ct_lr": hp.loguniform("ct_lr", math.log(1e-3), math.log(1.0)),
+        "reg": hp.choice("ct_reg", [
+            {"kind": "none"},
+            {
+                "kind": "l2",
+                "wd": hp.loguniform(
+                    "ct_wd", math.log(1e-6), math.log(1e-1)
+                ),
+            },
+            {
+                "kind": "momentum",
+                "mu": hp.uniform("ct_mu", 0.0, 0.99),
+                "nest": hp.choice("ct_nest", [
+                    {"boost": "off"},
+                    {
+                        "boost": "on",
+                        "extra": hp.uniform("ct_extra", 0.0, 1.0),
+                    },
+                ]),
+            },
+        ]),
+    }
+
+
+def cond_tune_objective(n_epochs=4, n_train=64, in_dim=4, hidden=8,
+                        seed=0):
+    """The conditional-space twin of :func:`mlp_tune_objective` (pair
+    with :func:`cond_tune_space`).  Deliberately reads the off-branch
+    dims (``ct_wd``/``ct_mu``/``ct_extra``) UNGATED -- correct if and
+    only if the compiled scan masks inactive-branch columns to 0.0 at
+    init, exactly the host driver's omit-inactive-labels semantics
+    (the PR-10 residue the graftrung PR closes).  ``init_fn`` takes the
+    ``active=`` mask keyword: the l2 branch starts from a smaller-norm
+    head (branch-aware init sizing through the declared seam)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..device_loop import TrainableObjective
+
+    key = jax.random.key(seed)
+    kx, kw, kn = jax.random.split(key, 3)
+    X = jax.random.normal(kx, (n_train, in_dim), jnp.float32)
+    w_true = jax.random.normal(kw, (in_dim,), jnp.float32)
+    y = jnp.tanh(X @ w_true) + 0.1 * jax.random.normal(
+        kn, (n_train,), jnp.float32
+    )
+
+    def _mse(params):
+        h = jnp.tanh(X @ params["w1"] + params["b1"])
+        pred = h @ params["w2"] + params["b2"]
+        return jnp.mean((pred - y) ** 2)
+
+    def init_fn(k, cfg, active):
+        k1, k2 = jax.random.split(k)
+        scale = jnp.where(active["ct_wd"], 0.25, 0.5)
+        params = {
+            "w1": scale * jax.random.normal(
+                k1, (in_dim, hidden), jnp.float32
+            ),
+            "b1": jnp.zeros((hidden,), jnp.float32),
+            "w2": scale * jax.random.normal(
+                k2, (hidden,), jnp.float32
+            ),
+            "b2": jnp.zeros((), jnp.float32),
+        }
+        momentum = jax.tree.map(jnp.zeros_like, params)
+        return params, momentum
+
+    def step_fn(state, cfg, epoch):
+        del epoch
+        params, momentum = state
+        # every conditional knob read bare: 0.0 off-branch by contract
+        lr = cfg["ct_lr"] * (1.0 + cfg["ct_extra"])
+        grads = jax.grad(_mse)(params)
+        momentum = jax.tree.map(
+            lambda m, g, p: cfg["ct_mu"] * m - lr * (
+                g + cfg["ct_wd"] * p
             ),
             momentum, grads, params,
         )

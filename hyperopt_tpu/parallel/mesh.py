@@ -23,6 +23,7 @@ __all__ = [
     "force_host_cpu_devices",
     "mesh_from_spec",
     "registry_cpu_mesh",
+    "rung_submesh",
     "study_mesh",
     "subprocess_env_with_devices",
     "CAND_AXIS",
@@ -116,6 +117,28 @@ def registry_cpu_mesh(n_devices=REGISTRY_MESH_DEVICES, axis=STUDY_AXIS):
             f"{int(n_devices)})"
         )
     return Mesh(np.asarray(devices[: int(n_devices)]), (axis,))
+
+
+def rung_submesh(mesh, axis, members):
+    """The gcd-sized per-rung sub-mesh of the SHA/ASHA shard_map seam.
+
+    A rung's (shrinking) member count rarely stays divisible by the
+    full mesh width, so the rung shards over the first
+    ``gcd(members, mesh.shape[axis])`` devices instead -- late tiny
+    rungs shrink their sub-mesh rather than breaking divisibility, and
+    a 1-device sub-mesh degenerates to the unsharded program (the
+    bitwise-parity anchor).  ONE definition shared by
+    :func:`hyperopt_tpu.hyperband.compile_sha`'s per-rung programs and
+    the compiled-ASHA device loop (:func:`hyperopt_tpu.device_loop.
+    compile_fmin` with ``asha=``).  Returns ``(sub_mesh, n_devices)``.
+    """
+    import math
+
+    from jax.sharding import Mesh
+
+    k = math.gcd(int(members), int(mesh.shape[axis]))
+    sub = Mesh(np.asarray(list(mesh.devices.flat)[:k]), (axis,))
+    return sub, k
 
 
 def force_host_cpu_devices(n=8):

@@ -23,6 +23,9 @@ def test_bench_prints_one_json_line():
     env["BENCH_SERVE_STUDIES"] = "8"  # CI-sized serve batch
     env["BENCH_SERVE_ROUNDS"] = "3"
     env["BENCH_BURST_CLIENTS"] = "32"  # CI-sized concurrent-client burst
+    env["BENCH_ASHA_FLAT"] = "32"  # CI-sized graftrung sweep pair
+    env["BENCH_ASHA_EVALS"] = "64"
+    env["BENCH_ASHA_BATCH"] = "8"
     env["BENCH_STORM_REPLICAS"] = "2"  # CI-sized hostile-network fleet
     env["BENCH_STORM_STUDIES"] = "3"
     env["BENCH_STORM_ROUNDS"] = "4"
@@ -71,6 +74,21 @@ def test_bench_prints_one_json_line():
     assert d["mlp_tune_trials_per_sec"] > 0
     assert d["mlp_tune_config"]["backend"] == "cpu"
     assert d["device_loop_callback_overhead_frac"] >= 0
+    # round-24 graftrung rows (compile_fmin(asha=)): the fused-ASHA
+    # time-to-quality pair is stamped on every backend -- both
+    # wall-clocks measured, the ratio defined whenever both sweeps hit
+    # the shared quality target, and the config keyed by backend so
+    # rounds stay comparable
+    assert d["compiled_asha_seconds_to_quality"] > 0
+    assert d["compiled_flat_seconds_to_quality"] > 0
+    assert d["compiled_asha_vs_flat_speedup_x"] is None or (
+        d["compiled_asha_vs_flat_speedup_x"] > 0
+    )
+    assert d["compiled_asha_best_loss"] >= 0
+    assert d["compiled_asha_reached_flat_best"] in (True, False)
+    assert d["compiled_asha_config"]["backend"] == "cpu"
+    assert d["compiled_asha_config"]["n_evals_asha"] == 64
+    assert d["compiled_asha_config"]["eta"] == 2
     # round-5 fields: cache stamp always present; asha-on-device keys
     # exist (None off-accelerator)
     assert d["compilation_cache"] in (True, False)

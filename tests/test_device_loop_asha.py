@@ -1,0 +1,405 @@
+"""graftrung: rung-based early stopping fused inside the compiled scan.
+
+The round-19 acceptance contracts:
+
+* ``compile_fmin(asha=)`` turns each scan step into a full ASHA bracket
+  -- every config trains a rung of epochs, promotions are computed
+  ON-DEVICE, and survivors are COMPACTED (gathered) to train deeper
+  inside the same compiled program; ``best`` ranks full-fidelity trials
+  only and the result stream gains ``rung_of``/``asha`` metadata;
+* the chunked ASHA scan (including a padded tail chunk) is BITWISE
+  identical to the flat ASHA scan -- the per-bracket key folds the
+  global step index, so chunk geometry changes nothing;
+* a 1-device ``rung_submesh`` program is BITWISE the unsharded program
+  (the graftmesh degenerate-anchor idiom); wider sub-meshes are
+  structurally identical (same promotions, finite stream);
+* kill-and-resume at EVERY device-loop crash point x chunk (= bracket)
+  boundary is bitwise the uninterrupted run, with foreign-asha-geometry
+  bundles refused (the guard pins eta/rung_epochs/n_rungs);
+* ``artifact_callback`` streams each bracket's winner (slot, loss,
+  TRAINED params) through the declared ``io_callback`` seam; cadence
+  off compiles NO callback twin (zero extra dispatches, pinned on the
+  compiled-function attribute);
+* conditional spaces: the device loop masks inactive-branch dims to
+  0.0 before ``init_fn``/``step_fn`` see them, matching the host
+  driver's omit-inactive-labels semantics (allclose; bitwise pins are
+  reserved for device-vs-device streams), and the masking is
+  OBSERVABLE -- an unmasked host recompute diverges wherever the
+  suggest kernels left other-branch garbage in inactive cells.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from hyperopt_tpu import Trials, fmin
+from hyperopt_tpu.device_loop import compile_fmin
+from hyperopt_tpu.distributed.faults import (
+    DEVICE_LOOP_CRASH_POINTS,
+    FaultPlan,
+    SimulatedCrash,
+)
+from hyperopt_tpu.exceptions import CheckpointError
+from hyperopt_tpu.hyperband import rung_schedule
+from hyperopt_tpu.models.synthetic import (
+    cond_tune_objective,
+    cond_tune_space,
+    mlp_tune_objective,
+    mlp_tune_space,
+)
+
+N_EVALS = 24
+BATCH = 8  # bracket population; 3 brackets of ladder (8,1)->(4,2)->(2,4)
+ASHA = {"eta": 2, "rung_epochs": 1, "n_rungs": 3}
+KW = dict(
+    max_evals=N_EVALS, batch_size=BATCH, algo="tpe", n_startup_jobs=2,
+    n_EI_candidates=8,
+)
+SEED = 5
+
+
+def _mlp():
+    return (
+        mlp_tune_objective(n_epochs=1, n_train=32, in_dim=4, hidden=8),
+        mlp_tune_space(),
+    )
+
+
+_RESULTS = {}
+
+
+def _flat_asha():
+    """The flat (unchunked, unsharded) ASHA run: the bitwise anchor."""
+    if "flat" not in _RESULTS:
+        obj, space = _mlp()
+        _RESULTS["flat"] = compile_fmin(obj, space, asha=ASHA, **KW)(
+            seed=SEED
+        )
+    return _RESULTS["flat"]
+
+
+def _assert_stream_equal(a, b):
+    """The FULL ASHA result stream, bitwise: every drawn value, every
+    activity bit, every rung loss, every promotion decision, and the
+    derived full-fidelity best."""
+    for f in ("values", "active", "losses", "rung_of"):
+        np.testing.assert_array_equal(a[f], b[f], err_msg=f)
+    assert a["best_loss"] == b["best_loss"]
+    assert a["best_index"] == b["best_index"]
+    assert a["best"] == b["best"]
+
+
+# ---------------------------------------------------------------------------
+# ladder geometry
+# ---------------------------------------------------------------------------
+
+
+def test_rung_schedule_ladder_invariants():
+    full = rung_schedule(8, 2, None, 1)
+    assert full == [(8, 1, 0), (4, 2, 1), (2, 4, 3), (1, 8, 7)]
+    for (w0, s0, o0), (w1, s1, o1) in zip(full, full[1:]):
+        assert w1 * 2 == w0          # eta-fold survivor cut
+        assert s1 == s0 * 2          # eta-fold fidelity growth
+        assert o1 == o0 + s0         # cumulative epoch offsets
+    assert rung_schedule(8, 2, 3, 1) == [(8, 1, 0), (4, 2, 1), (2, 4, 3)]
+    with pytest.raises(ValueError, match="power of eta"):
+        rung_schedule(6, 2)
+
+
+# ---------------------------------------------------------------------------
+# the fused bracket: flat, chunked, sharded
+# ---------------------------------------------------------------------------
+
+
+def test_flat_asha_rung_stream_and_full_fidelity_best():
+    out = _flat_asha()
+    assert out["n_evals"] == N_EVALS
+    rung_of = out["rung_of"]
+    assert rung_of.shape == (N_EVALS,)
+    # 3 brackets of 8: each stops 4 at rung 0, 2 at rung 1, 2 at rung 2
+    counts = np.bincount(rung_of + 1, minlength=4)
+    assert list(counts) == [0, 12, 6, 6]
+    assert np.isfinite(out["losses"]).all()
+    # best ranks FULL-FIDELITY trials only
+    full = rung_of == ASHA["n_rungs"] - 1
+    assert full[out["best_index"]]
+    assert out["best_loss"] == out["losses"][full].min()
+    assert out["asha"]["ladder"] == [(8, 1, 0), (4, 2, 1), (2, 4, 3)]
+    assert out["asha"]["eta"] == 2
+    # seed-deterministic; a different seed draws a different stream
+    obj, space = _mlp()
+    runner = compile_fmin(obj, space, asha=ASHA, **KW)
+    _assert_stream_equal(out, runner(seed=SEED))
+    assert not np.array_equal(runner(seed=SEED + 1)["losses"], out["losses"])
+
+
+def test_chunked_asha_bitwise_parity_with_flat():
+    obj, space = _mlp()
+    # chunk_size=8 -> 1 bracket per chunk, 3 chunks
+    out = compile_fmin(obj, space, chunk_size=8, asha=ASHA, **KW)(seed=SEED)
+    _assert_stream_equal(_flat_asha(), out)
+
+
+def test_padded_tail_chunk_asha_bitwise_parity():
+    obj, space = _mlp()
+    # chunk_size=16 -> 2 brackets per chunk, 2 chunks; the tail chunk
+    # runs one masked no-op bracket past n_steps
+    out = compile_fmin(obj, space, chunk_size=16, asha=ASHA, **KW)(seed=SEED)
+    _assert_stream_equal(_flat_asha(), out)
+
+
+def test_one_device_submesh_bitwise_parity(cpu_mesh):
+    """The graftmesh degenerate anchor: a 1-device sub-mesh must take
+    the shard_map seam and still be bitwise the unsharded program."""
+    obj, space = _mlp()
+    runner = compile_fmin(
+        obj, space, mesh=cpu_mesh(1, "trial"), trial_axis="trial",
+        asha=ASHA, **KW,
+    )
+    assert runner._asha_submesh_devices == 1
+    _assert_stream_equal(_flat_asha(), runner(seed=SEED))
+
+
+def test_sharded_submesh_structural_parity(cpu_mesh):
+    """Wider sub-meshes change vmap block widths (CPU libm vectorizes
+    differently), so the pin is structural: the gcd sub-mesh covers the
+    whole shrinking ladder, promotions match the ladder geometry, and
+    the stream is finite and deterministic."""
+    obj, space = _mlp()
+    runner = compile_fmin(
+        obj, space, mesh=cpu_mesh(4, "trial"), trial_axis="trial",
+        asha=ASHA, **KW,
+    )
+    # gcd(smallest rung width 2, mesh axis 4) = 2
+    assert runner._asha_submesh_devices == 2
+    out = runner(seed=SEED)
+    assert list(np.bincount(out["rung_of"] + 1, minlength=4)) == [0, 12, 6, 6]
+    assert np.isfinite(out["losses"]).all()
+    full = out["rung_of"] == ASHA["n_rungs"] - 1
+    assert full[out["best_index"]]
+    _assert_stream_equal(out, runner(seed=SEED))
+
+
+# ---------------------------------------------------------------------------
+# kill-and-resume at every crash point x bracket boundary
+# ---------------------------------------------------------------------------
+
+
+def test_kill_and_resume_every_crash_point_and_boundary_bitwise(tmp_path):
+    """THE resume acceptance: arm each device-loop crash point at each
+    chunk (= bracket/rung-ladder) boundary, kill, resume -- the
+    completed stream including every promotion decision must be bitwise
+    the uninterrupted run's, for every (point, boundary)."""
+    obj, space = _mlp()
+    path = str(tmp_path / "asha.ckpt")
+    plan = FaultPlan(seed=0)
+    runner = compile_fmin(
+        obj, space, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, fs=plan.fs(), asha=ASHA, **KW,
+    )
+    ref = runner(seed=SEED)
+    _assert_stream_equal(_flat_asha(), ref)  # durability changes nothing
+    n_chunks = runner._chunk_geometry["n_chunks"]
+    assert n_chunks == 3
+    for point in DEVICE_LOOP_CRASH_POINTS:
+        for at in range(1, n_chunks + 1):
+            if os.path.exists(path):
+                os.remove(path)
+            plan.arm(point, at=at)
+            with pytest.raises(SimulatedCrash):
+                runner(seed=SEED)
+            out = runner(seed=SEED, resume=True)
+            _assert_stream_equal(ref, out)
+    # resume of a COMPLETED run packages straight from the bundle
+    out = runner(seed=SEED, resume=True)
+    _assert_stream_equal(ref, out)
+
+
+def test_resume_refuses_foreign_asha_geometry(tmp_path):
+    obj, space = _mlp()
+    path = str(tmp_path / "asha.ckpt")
+    runner = compile_fmin(
+        obj, space, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, asha=ASHA, **KW,
+    )
+    runner(seed=SEED)
+    with pytest.raises(CheckpointError, match="seed"):
+        runner(seed=SEED + 1, resume=True)
+    # same experiment, different rung geometry -> different guard
+    foreign = compile_fmin(
+        obj, space, chunk_size=8, checkpoint_path=path,
+        checkpoint_every=1, asha={"eta": 2, "rung_epochs": 2}, **KW,
+    )
+    with pytest.raises(CheckpointError, match="refusing to resume"):
+        foreign(seed=SEED, resume=True)
+
+
+# ---------------------------------------------------------------------------
+# artifact streaming through the declared io_callback seam
+# ---------------------------------------------------------------------------
+
+
+def test_artifact_callback_streams_winners_and_changes_nothing():
+    obj, space = _mlp()
+    ref = _flat_asha()
+    rows, prog = [], []
+    runner = compile_fmin(
+        obj, space, chunk_size=8, artifact_callback=rows.append,
+        progress_callback=prog.append, asha=ASHA, **KW,
+    )
+    out = runner(seed=SEED)
+    # observability changes NOTHING: bitwise the flat stream
+    _assert_stream_equal(ref, out)
+    # one winner per bracket, in bracket order, padded tail rows dropped
+    assert [r["bracket"] for r in rows] == [0, 1, 2]
+    for row in rows:
+        assert set(row) == {"bracket", "slot", "loss", "params"}
+        # the winner's loss IS its history entry, and the slot is a
+        # full-fidelity survivor of its own bracket
+        assert np.float32(row["loss"]) == np.float32(ref["losses"][row["slot"]])
+        assert ref["rung_of"][row["slot"]] == ASHA["n_rungs"] - 1
+        assert row["bracket"] * BATCH <= row["slot"] < (row["bracket"] + 1) * BATCH
+        # TRAINED params crossed the seam as host numpy
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(row["params"])
+        assert leaves
+        assert all(isinstance(l, (np.ndarray, np.generic)) for l in leaves)
+    assert prog  # progress rows still ride the same chunk program
+    # a second run re-fires the stream (no one-shot callback state)
+    rows.clear()
+    runner(seed=SEED)
+    assert [r["bracket"] for r in rows] == [0, 1, 2]
+
+
+def test_artifact_cadence_off_compiles_no_callback_twin():
+    """Zero-extra-dispatch pin: with no callbacks requested, the chunk
+    program has NO io_callback twin to dispatch through -- not a twin
+    that happens to be skipped."""
+    obj, space = _mlp()
+    runner = compile_fmin(obj, space, chunk_size=8, asha=ASHA, **KW)
+    assert runner._compiled_chunk_cb is None
+    _assert_stream_equal(_flat_asha(), runner(seed=SEED))
+
+
+# ---------------------------------------------------------------------------
+# conditional spaces: masked init/step parity with the host driver
+# ---------------------------------------------------------------------------
+
+
+def test_conditional_space_masked_host_parity_and_observability():
+    """Satellite contract: the device loop pins inactive-branch dims to
+    0.0 before the trainable sees them -- exactly the host driver's
+    omit-inactive-labels semantics -- and ``init_fn(..., active=)``
+    receives the activity mask.  Proven two ways: a masked host
+    recompute matches the device stream (allclose: vmap batching
+    reorders fp ops by 1 ulp), and an UNMASKED recompute diverges on
+    trials whose inactive cells carry other-branch garbage."""
+    import jax
+    import jax.numpy as jnp
+
+    obj = cond_tune_objective(n_epochs=3, n_train=32, in_dim=4, hidden=8)
+    space = cond_tune_space()
+    B, seed, n = 4, 7, 12
+    runner = compile_fmin(
+        obj, space, max_evals=n, batch_size=B, algo="tpe",
+        n_startup_jobs=2, n_EI_candidates=8,
+    )
+    out = runner(seed=seed)
+    labels = list(runner._packed_space.labels)
+    inact = ~out["active"][:, :n]
+    assert inact.any(), "space never produced an inactive dim"
+    # the suggest kernels really do leave garbage in inactive cells --
+    # without masking there would be nothing to prove
+    garbage = inact & (np.abs(out["values"][:, :n]) > 1e-9)
+    assert garbage.any()
+
+    base = jax.random.key(np.uint32(seed))
+
+    def host_loss(t, masked):
+        i, lane = t // B, t % B
+        key = jax.random.fold_in(jax.random.fold_in(base, 0), i)
+        ek = jax.random.split(jax.random.fold_in(key, 0x7EA1), B)[lane]
+        vcol, acol = out["values"][:, t], out["active"][:, t]
+        cfg = {
+            lab: jnp.float32(vcol[d] if (acol[d] or not masked) else 0.0)
+            for d, lab in enumerate(labels)
+        }
+        act = {lab: jnp.asarray(bool(acol[d])) for d, lab in enumerate(labels)}
+        st = obj.init_fn(ek, cfg, active=act)
+        for e in range(obj.n_epochs):
+            st = obj.step_fn(st, cfg, e)
+        return float(obj.loss_fn(st, cfg))
+
+    masked = np.array([host_loss(t, True) for t in range(n)])
+    unmasked = np.array([host_loss(t, False) for t in range(n)])
+    dev = out["losses"][:n]
+    assert np.allclose(masked, dev, rtol=1e-5, atol=1e-7)
+    # observability: on trials carrying inactive garbage, training on
+    # that garbage lands somewhere else
+    garbage_trials = garbage.any(axis=0)
+    diverged = np.abs(unmasked - dev) > 1e-4
+    assert (diverged & garbage_trials).any()
+
+
+def test_asha_on_conditional_space():
+    obj = cond_tune_objective(n_epochs=3, n_train=32, in_dim=4, hidden=8)
+    runner = compile_fmin(
+        obj, cond_tune_space(), max_evals=16, batch_size=4, algo="tpe",
+        n_startup_jobs=2, n_EI_candidates=8,
+        asha={"eta": 2, "rung_epochs": 1},
+    )
+    out = runner(seed=7)
+    # full ladder for B=4, eta=2: (4,1)->(2,2)->(1,4)
+    assert list(np.bincount(out["rung_of"] + 1, minlength=4)) == [0, 8, 4, 4]
+    assert np.isfinite(out["best_loss"])
+    assert out["rung_of"][out["best_index"]] == 2
+
+
+# ---------------------------------------------------------------------------
+# option surface + fmin routing
+# ---------------------------------------------------------------------------
+
+
+def test_asha_option_validation(cpu_mesh):
+    obj, space = _mlp()
+    with pytest.raises(ValueError, match="dict of rung options"):
+        compile_fmin(obj, space, asha=3, **KW)
+    with pytest.raises(ValueError, match="unknown asha option"):
+        compile_fmin(obj, space, asha={"eta": 2, "rungs": 3}, **KW)
+    with pytest.raises(ValueError, match="TrainableObjective"):
+        compile_fmin(lambda cfg: cfg["lr"], space, asha=ASHA, **KW)
+    with pytest.raises(ValueError, match="power of eta"):
+        compile_fmin(obj, space, asha=ASHA, **dict(KW, batch_size=6))
+    with pytest.raises(ValueError, match="loss_threshold"):
+        compile_fmin(obj, space, asha=ASHA, loss_threshold=0.1, **KW)
+    with pytest.raises(ValueError, match="cand_axis"):
+        compile_fmin(
+            obj, space, asha=ASHA, mesh=cpu_mesh(2, "cand"),
+            trial_axis=None, cand_axis="cand", **KW,
+        )
+    with pytest.raises(ValueError, match="requires asha="):
+        compile_fmin(obj, space, chunk_size=8, artifact_callback=print, **KW)
+    with pytest.raises(ValueError, match="chunk_size"):
+        compile_fmin(obj, space, asha=ASHA, artifact_callback=print, **KW)
+    runner = compile_fmin(obj, space, asha=ASHA, **KW)
+    with pytest.raises(ValueError, match="seed sweep"):
+        runner(seed=[0, 1])
+
+
+def test_fmin_compiled_options_asha_routing():
+    obj, space = _mlp()
+    trials = Trials()
+    best = fmin(
+        obj, space, compiled=True, max_evals=16, trials=trials,
+        rstate=np.random.default_rng(3),
+        compiled_options=dict(
+            batch_size=8, n_startup_jobs=2, n_EI_candidates=8,
+            asha={"eta": 2, "rung_epochs": 1, "n_rungs": 3},
+        ),
+    )
+    assert len(trials) == 16
+    assert set(best) <= {"lr", "momentum", "wd", "init_scale"}
+    losses = trials.losses()
+    assert len(losses) == 16 and all(np.isfinite(losses))

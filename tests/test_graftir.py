@@ -60,8 +60,10 @@ def test_ir_gate_clean_and_fast():
     # 15 -> 25 s when the chunked/trainable device-loop families grew
     # it 14 -> 18 -- the train_step trace runs grad through an MLP --
     # and 25 -> 40 s when the graftmesh shard_map families grew it
-    # 18 -> 22: each traces AND lowers over the forced 4-device mesh)
-    assert elapsed < 40.0, f"--ir took {elapsed:.2f}s (budget 40s)"
+    # 18 -> 22: each traces AND lowers over the forced 4-device mesh,
+    # and 40 -> 55 s when the graftrung asha families grew it 23 -> 26:
+    # each traces the unrolled rung ladder's full training pyramid)
+    assert elapsed < 55.0, f"--ir took {elapsed:.2f}s (budget 55s)"
 
 
 def test_manifest_covers_every_registered_program():
