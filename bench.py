@@ -125,6 +125,29 @@ def bench_ir():
     return result.programs_checked, result.contract_drift
 
 
+def bench_wire():
+    """graftwire (hyperopt-tpu-lint --wire) over the protocol seams:
+    how many wire ops checked out across both fronts, how many drifted
+    from the committed wire_contracts.json, and the fraction of
+    registered crash points some test actually arms -- stamped so a
+    dead fault window or a silent reply-shape change is visible in the
+    round JSON even when nobody ran the fast tier.
+
+    Returns (wire_ops_checked, wire_contract_drift,
+    crash_points_armed_frac); the fraction must be 1.0 on a healthy
+    tree (the GL604 satellite) and the smoke test pins it.  Pure AST
+    -- no server starts, no socket opens."""
+    from hyperopt_tpu.analysis.wire import check_wire
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    result = check_wire(root=repo)
+    frac = (
+        result.crash_points_armed / result.crash_points_total
+        if result.crash_points_total else 0.0
+    )
+    return result.ops_checked, result.contract_drift, round(frac, 4)
+
+
 def bench_rtt(n_calls=20):
     """Dispatch round-trip of a trivial device program, in ms.
 
@@ -1961,6 +1984,8 @@ def main():
     ir_programs_checked, ir_contract_drift = bench_ir()
     (trace_findings_total, trace_rules_checked,
      lockdep_inversions_observed) = bench_trace()
+    (wire_ops_checked, wire_contract_drift,
+     crash_points_armed_frac) = bench_wire()
 
     print(
         json.dumps(
@@ -2165,6 +2190,13 @@ def main():
                 "trace_findings_total": trace_findings_total,
                 "trace_rules_checked": trace_rules_checked,
                 "lockdep_inversions_observed": lockdep_inversions_observed,
+                # round-20 graftwire rows: wire ops checked across both
+                # fronts, reply-contract drift vs wire_contracts.json
+                # (0 on a healthy tree), and the armed fraction of the
+                # crash-point registries (1.0 = no dead fault windows)
+                "wire_ops_checked": wire_ops_checked,
+                "wire_contract_drift": wire_contract_drift,
+                "crash_points_armed_frac": crash_points_armed_frac,
                 "rtt_ms": round(rtt_ms, 2),
                 "compilation_cache": cache_dir is not None,
                 "batch": batch,

@@ -28,6 +28,14 @@ table mapping each rule to the PR that motivated it):
   futures resolved under a lock, threads started mid-``__init__``,
   daemon threads tearing durable state; paired with a runtime lockdep
   sanitizer (:mod:`.lockdep`) the serve suites arm at test time
+* GL6xx -- graftwire: static wire-protocol & fault-surface contract
+  checks over the serve seams (:mod:`.wire`,
+  ``hyperopt-tpu-lint --wire``) -- op-surface symmetry between the
+  service/router fronts and every client/test call site, per-op
+  reply-field drift against the committed ``wire_contracts.json``,
+  ServeError subclasses unmapped at the client reply seam, crash
+  points no test ever arms, durable write seams outside any crash
+  window, and ``retry_after`` replies built without the cap path
 
 Inline suppression::
 
@@ -46,7 +54,14 @@ and ``tokenize`` only.
 
 from .baseline import load_baseline, write_baseline
 from .engine import Finding, LintResult, lint_paths, lint_source
-from .report import format_ir_json, format_ir_text, format_json, format_text
+from .report import (
+    format_ir_json,
+    format_ir_text,
+    format_json,
+    format_text,
+    format_wire_json,
+    format_wire_text,
+)
 from .rules import RULES
 
 __all__ = [
@@ -61,6 +76,8 @@ __all__ = [
     "format_json",
     "format_ir_text",
     "format_ir_json",
+    "format_wire_text",
+    "format_wire_json",
 ]
 
 # NOTE: the graftir checker itself (analysis.ir) imports lazily -- it

@@ -26,6 +26,14 @@ contract changes with ``--ir --update-contracts``.
 :mod:`.trace`): lock-domain inference and lock-discipline checks over
 the same path arguments, with the identical exit-code contract,
 ``--format json``, pragma, and baseline workflow as the default pack.
+
+``--wire`` switches to the graftwire wire-protocol pack (GL6xx, see
+:mod:`.wire`): it checks the FIXED protocol surfaces (service/router
+dispatch, client call sites, typed-error mapping, crash-point
+registries), not the path arguments, against the committed
+``wire_contracts.json`` (resolved next to the package by default --
+cwd-independent).  Accept deliberate reply-shape changes with
+``--wire --update-contracts``.
 """
 
 from __future__ import annotations
@@ -36,7 +44,14 @@ import sys
 
 from . import baseline as baseline_mod
 from .engine import lint_paths
-from .report import format_ir_json, format_ir_text, format_json, format_text
+from .report import (
+    format_ir_json,
+    format_ir_text,
+    format_json,
+    format_text,
+    format_wire_json,
+    format_wire_text,
+)
 from .rules import RULES
 
 __all__ = ["main"]
@@ -92,14 +107,22 @@ def _build_parser():
         "and baseline workflow",
     )
     p.add_argument(
+        "--wire", action="store_true",
+        help="run the graftwire wire-protocol pack (GL6xx: op-surface "
+        "symmetry, reply-contract drift, typed-error mapping, crash-"
+        "point arming) over the protocol seams; same exit contract, "
+        "formats, and baseline workflow",
+    )
+    p.add_argument(
         "--contracts", default=None, metavar="FILE",
-        help="program-contracts manifest for --ir (default: the "
-        "committed program_contracts.json next to the package)",
+        help="contracts manifest for --ir / --wire (default: the "
+        "committed program_contracts.json / wire_contracts.json next "
+        "to the package)",
     )
     p.add_argument(
         "--update-contracts", action="store_true",
-        help="with --ir: re-pin the shape/cost manifest to the current "
-        "programs instead of diffing against it",
+        help="with --ir or --wire: re-pin the manifest to the current "
+        "programs/reply shapes instead of diffing against it",
     )
     p.add_argument(
         "--list-rules", action="store_true",
@@ -148,6 +171,60 @@ def _main_ir(args):
     return 0 if result.clean else 1
 
 
+def _main_wire(args):
+    from . import wire as wire_mod
+
+    # the same cwd-independence discipline as the AST path: pick the
+    # committed baseline up from the cwd, anchor everything at its home
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        if os.path.exists(DEFAULT_BASELINE):
+            baseline_path = DEFAULT_BASELINE
+    if args.no_baseline:
+        baseline_path = None
+    root = args.root
+    if root is None and baseline_path is not None:
+        root = os.path.dirname(os.path.abspath(baseline_path))
+
+    try:
+        counter = None
+        if baseline_path is not None and not args.write_baseline:
+            counter = baseline_mod.load_baseline(baseline_path)
+        result = wire_mod.check_wire(
+            contracts_path=args.contracts, update=args.update_contracts,
+            root=root, baseline=counter,
+        )
+    except (FileNotFoundError, ValueError, OSError) as e:
+        print(f"hyperopt-tpu-lint: error: {e}", file=sys.stderr)
+        return 2
+    except Exception as e:  # internal failure is 2, never a traceback
+        print(
+            f"hyperopt-tpu-lint: internal error: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.write_baseline:
+        out = baseline_path or DEFAULT_BASELINE
+        baseline_mod.write_baseline(out, result.findings)
+        print(
+            f"wrote {len(result.findings)} finding(s) to {out}",
+            file=sys.stderr,
+        )
+        return 0
+    if result.updated:
+        print(
+            f"pinned {result.ops_checked} op reply contract(s) to "
+            f"{result.contracts_path}",
+            file=sys.stderr,
+        )
+    print(
+        format_wire_json(result) if args.format == "json"
+        else format_wire_text(result)
+    )
+    return 0 if result.clean else 1
+
+
 def main(argv=None):
     parser = _build_parser()
     args = parser.parse_args(argv)
@@ -158,21 +235,27 @@ def main(argv=None):
             print(f"{r.id}  {r.name:28s} {r.summary}")
         return 0
 
-    if args.update_contracts and not args.ir:
+    if args.update_contracts and not (args.ir or args.wire):
         print(
-            "hyperopt-tpu-lint: error: --update-contracts requires --ir",
+            "hyperopt-tpu-lint: error: --update-contracts requires "
+            "--ir or --wire",
             file=sys.stderr,
         )
         return 2
-    if args.ir and args.trace:
+    packs = [f for f, on in (
+        ("--ir", args.ir), ("--trace", args.trace), ("--wire", args.wire),
+    ) if on]
+    if len(packs) > 1:
         print(
-            "hyperopt-tpu-lint: error: --ir and --trace are separate "
-            "packs; run them as two invocations",
+            f"hyperopt-tpu-lint: error: {' and '.join(packs)} are "
+            "separate packs; run them as separate invocations",
             file=sys.stderr,
         )
         return 2
     if args.ir:
         return _main_ir(args)
+    if args.wire:
+        return _main_wire(args)
 
     baseline_path = args.baseline
     if baseline_path is None and not args.no_baseline:

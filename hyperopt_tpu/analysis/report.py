@@ -8,7 +8,10 @@ import json
 
 from .rules import RULES
 
-__all__ = ["format_text", "format_json", "result_summary"]
+__all__ = [
+    "format_text", "format_json", "result_summary",
+    "wire_summary", "format_wire_text", "format_wire_json",
+]
 
 
 def result_summary(result):
@@ -81,6 +84,56 @@ def format_ir_json(result):
     return json.dumps(
         {
             "summary": ir_summary(result),
+            "findings": [f.to_dict() for f in result.findings],
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def wire_summary(result):
+    """Summary block of a :class:`~.wire.WireResult` (the bench stamps
+    ``wire_ops_checked`` / ``wire_contract_drift`` /
+    ``crash_points_armed_frac`` from this)."""
+    return {
+        "total": len(result.findings),
+        "ops_checked": result.ops_checked,
+        "contract_drift": result.contract_drift,
+        "crash_points_total": result.crash_points_total,
+        "crash_points_armed": result.crash_points_armed,
+        "errors_checked": result.errors_checked,
+        "pragma_suppressed": result.n_suppressed,
+        "baseline_matched": result.n_baseline_matched,
+        "baseline_size": result.baseline_size,
+        "contracts": result.contracts_path,
+        "updated": result.updated,
+    }
+
+
+def format_wire_text(result):
+    lines = []
+    for f in result.findings:
+        rule = RULES.get(f.rule)
+        name = f" ({rule.name})" if rule else ""
+        lines.append(f"{f.path}:{f.line}: {f.rule}{name} {f.message}")
+    s = wire_summary(result)
+    lines.append(
+        f"graftwire: {s['total']} finding(s) across "
+        f"{s['ops_checked']} op(s), "
+        f"{s['contract_drift']} with contract drift, "
+        f"{s['crash_points_armed']}/{s['crash_points_total']} crash "
+        f"point(s) armed "
+        f"({s['baseline_matched']} baselined, "
+        f"{s['pragma_suppressed']} suppressed)"
+        + (" [contracts updated]" if result.updated else "")
+    )
+    return "\n".join(lines)
+
+
+def format_wire_json(result):
+    return json.dumps(
+        {
+            "summary": wire_summary(result),
             "findings": [f.to_dict() for f in result.findings],
         },
         indent=2,

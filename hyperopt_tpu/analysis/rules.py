@@ -123,6 +123,39 @@ for _id, _name, _summary in (
 ):
     RULES[_id] = Rule(_id, _name, _summary)
 
+# graftwire (GL6xx) rules run via analysis/wire.py over the wire-
+# protocol and fault surfaces (service/router dispatch, client call
+# sites, typed-error mapping, crash-point registries), selected by
+# `hyperopt-tpu-lint --wire`.  Same registration posture as GL4xx/
+# GL5xx: metadata-only rows so --list-rules and GL001 pragma
+# validation cover the pack.
+for _id, _name, _summary in (
+    ("GL601", "wire-op-asymmetry",
+     "a client-sent op has no server handler, a handled op has no "
+     "client or test caller, or a global op one front handles the "
+     "other silently refuses untyped"),
+    ("GL602", "wire-contract-drift",
+     "an op's extracted reply-field set drifted from the committed "
+     "wire_contracts.json (accept deliberate changes with --wire "
+     "--update-contracts)"),
+    ("GL603", "unmapped-serve-error",
+     "a ServeError subclass never appears at the client reply seam "
+     "(_REPLY_ERRORS) -- the wire error would surface as a generic "
+     "RuntimeError instead of its typed exception"),
+    ("GL604", "dead-crash-point",
+     "a name registered in a *_CRASH_POINTS tuple is never armed or "
+     "iterated by any test -- an untested crash window"),
+    ("GL605", "durable-seam-without-crash-point",
+     "a durable write seam (fsync/rename/WAL append) in serve// "
+     "distributed/ has no crashpoint() in its function scope -- the "
+     "torn-state window is uninjectable"),
+    ("GL606", "retry-after-without-cap",
+     "a retry_after-carrying reply is built from a bare numeric "
+     "without the RETRY_AFTER_CAP/jitter path -- clients can be told "
+     "to back off unboundedly"),
+):
+    RULES[_id] = Rule(_id, _name, _summary)
+
 
 def _is_test_file(ctx):
     base = ctx.parts[-1] if ctx.parts else ""

@@ -165,10 +165,19 @@ DEVICE_LOOP_CRASH_POINTS = (
 #:                                             restored (the router
 #:                                             lazily adopts on the
 #:                                             ring owner)
+#:     fleet_claim_tmp_before_rename           claim publish: the temp
+#:                                             claim doc is fsynced but
+#:                                             the rename never landed
+#:                                             -- the old claim (or no
+#:                                             claim) stays visible and
+#:                                             a re-acquire wins cleanly;
+#:                                             the orphan ``.tmp.<pid>``
+#:                                             is fsck's to sweep
 FLEET_CRASH_POINTS = (
     "fleet_router_after_forward_before_ack",
     "fleet_migrate_after_snapshot_before_handoff",
     "fleet_migrate_after_handoff_before_restore",
+    "fleet_claim_tmp_before_rename",
 )
 
 #: crash point of graftscope's flight-recorder export (hyperopt_tpu/

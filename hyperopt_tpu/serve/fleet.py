@@ -155,6 +155,7 @@ class StudyClaim:
             with self.fs.open(tmp, "w") as f:
                 f.write(json.dumps(doc, sort_keys=True))
                 self.fs.fsync(f)
+            self.fs.crashpoint("fleet_claim_tmp_before_rename")
             self.fs.rename(tmp, self.path)
 
         _common.with_retries(_write, label="claim publish")

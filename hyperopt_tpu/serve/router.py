@@ -395,6 +395,8 @@ class RouterServer:
             return self._aggregate_trace(conns, req.get("tail"))
         if op == "ask_batch":
             return self._ask_batch(req, conns)
+        if op == "drain":
+            return self._drain_all(req, conns)
         name = req.get("name") or req.get("study")
         if not name:
             return {"ok": False, "error": f"op {op!r} needs a study name"}
@@ -529,6 +531,35 @@ class RouterServer:
                 {"op": "ask", "study": name, "timeout": timeout}, conns
             )
         return {"ok": True, "results": results}
+
+    def _drain_all(self, req, conns):
+        """Fleet-wide drain broadcast: forward ``drain`` to every live
+        backend so the whole fleet stops admitting new asks at once;
+        the reply's ``retry_after`` is the slowest backend's comeback
+        hint (each already jittered server-side), capped."""
+        from .service import RETRY_AFTER_CAP
+
+        fwd = {"op": "drain"}
+        if req.get("timeout") is not None:
+            fwd["timeout"] = req["timeout"]
+        replicas, hints = {}, []
+        for rid in sorted(self.backends):
+            if rid in self._alive_excluded():
+                continue
+            try:
+                reply = self._rpc(conns, rid, fwd)
+            except _NET_ERRORS:
+                self._drop_conn(conns, rid)
+                self._mark_dead(rid)
+                replicas[rid] = False
+                continue
+            replicas[rid] = bool(reply.get("draining"))
+            if reply.get("retry_after") is not None:
+                hints.append(float(reply["retry_after"]))
+        return {
+            "ok": True, "draining": True, "replicas": replicas,
+            "retry_after": min(max(hints, default=0.0), RETRY_AFTER_CAP),
+        }
 
     def _aggregate(self, op, conns):
         replies = {}

@@ -85,7 +85,7 @@ def _study_guard(algo, space):
     return ["graftserve", 1, str(algo), _space_fingerprint(as_apply(space))]
 
 
-class StudyPersistence:
+class StudyPersistence:  # graftlint: disable=GL605 the serve crash windows fire at the scheduler batching layer (serve_after_wal_before_dispatch / group-commit barriers bracket these appends from above), and the TellWAL primitive itself rides the driver windows
     """Per-study WAL + snapshot bundle rooted at ``<root>/<name>``.
 
     Artifacts: ``<name>.wal`` (the :class:`TellWAL`: ``open`` / ``ask``
@@ -1073,7 +1073,7 @@ def serve_forever(service, host="127.0.0.1", port=0,
                         "error": "server connection cap reached",
                         "error_type": "Overloaded",
                         "reason": "max_connections",
-                        "retry_after": 0.05,
+                        "retry_after": min(0.05, RETRY_AFTER_CAP),
                     }, False)
                 except OSError:
                     pass

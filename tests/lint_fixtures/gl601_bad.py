@@ -1,0 +1,32 @@
+"""GL601 true positives: a client op nothing handles, a handler
+nothing calls, and a global op only the service front dispatches (the
+router would refuse it untyped).  One file plays server AND client."""
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    if op == "stats":
+        return {"ok": True, "stats": {}}
+    name = req.get("study")
+    if op == "ask":
+        return {"ok": True, "tid": 1, "vals": {}}
+    return {"ok": False, "error": "unknown"}
+
+
+class RouterServer:
+    def handle_request(self, req, conns):
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True, "router": True}
+        name = req.get("name") or req.get("study")
+        if not name:
+            return {"ok": False, "error": "needs a study name"}
+        return self.forward(req)
+
+
+def drive(conn):
+    conn.call({"op": "ping"})
+    conn.call({"op": "ask", "study": "demo"})
+    conn.call({"op": "frobnicate"})

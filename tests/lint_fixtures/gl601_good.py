@@ -1,0 +1,34 @@
+"""GL601 near miss: the same universe, symmetric -- every sent op has
+a handler, every handler a caller, and the one global op both fronts
+dispatch."""
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    if op == "stats":
+        return {"ok": True, "stats": {}}
+    name = req.get("study")
+    if op == "ask":
+        return {"ok": True, "tid": 1, "vals": {}}
+    return {"ok": False, "error": "unknown"}
+
+
+class RouterServer:
+    def handle_request(self, req, conns):
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "pong": True, "router": True}
+        if op == "stats":
+            return {"ok": True, "stats": {}}
+        name = req.get("name") or req.get("study")
+        if not name:
+            return {"ok": False, "error": "needs a study name"}
+        return self.forward(req)
+
+
+def drive(conn):
+    conn.call({"op": "ping"})
+    conn.call({"op": "stats"})
+    conn.call({"op": "ask", "study": "demo"})

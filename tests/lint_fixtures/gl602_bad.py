@@ -1,0 +1,18 @@
+"""GL602 true positives against contracts pinned from the good twin:
+``ask`` renamed a reply field (vals -> values) and the ``best`` arm is
+gone while the manifest still pins it (a stale row)."""
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    name = req.get("study")
+    if op == "ask":
+        return {"ok": True, "tid": 1, "values": {}}
+    return {"ok": False, "error": "unknown"}
+
+
+def drive(conn):
+    conn.call({"op": "ping"})
+    conn.call({"op": "ask", "study": "demo"})

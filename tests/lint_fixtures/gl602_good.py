@@ -1,0 +1,21 @@
+"""GL602 near miss: the reply shapes this universe's manifest pins --
+the bad twin drifts one field and drops one op against contracts built
+from THIS file."""
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    name = req.get("study")
+    if op == "ask":
+        return {"ok": True, "tid": 1, "vals": {}}
+    if op == "best":
+        return {"ok": True, "best": None}
+    return {"ok": False, "error": "unknown"}
+
+
+def drive(conn):
+    conn.call({"op": "ping"})
+    conn.call({"op": "ask", "study": "demo"})
+    conn.call({"op": "best", "study": "demo"})

@@ -1,0 +1,20 @@
+"""GL603 true positive: a ServeError subclass the client reply seam
+never maps -- its wire error_type would surface as a generic
+RuntimeError instead of the typed class."""
+
+
+class ServeError(RuntimeError):
+    pass
+
+
+class Overloaded(ServeError):
+    pass
+
+
+class StudyPoisoned(ServeError):
+    pass
+
+
+_REPLY_ERRORS = {
+    "Overloaded": Overloaded,
+}

@@ -1,0 +1,20 @@
+"""GL603 near miss: every ServeError subclass is mapped by name at the
+client reply seam."""
+
+
+class ServeError(RuntimeError):
+    pass
+
+
+class Overloaded(ServeError):
+    pass
+
+
+class StudyPoisoned(ServeError):
+    pass
+
+
+_REPLY_ERRORS = {
+    "Overloaded": Overloaded,
+    "StudyPoisoned": StudyPoisoned,
+}

@@ -1,0 +1,8 @@
+"""GL604 true positives: a crash-point registry no test ever arms or
+iterates (the fixture test passes NO test evidence alongside this
+file) -- two dead fault windows."""
+
+SERVE_CRASH_POINTS = (
+    "serve_before_snapshot",
+    "serve_after_snapshot",
+)

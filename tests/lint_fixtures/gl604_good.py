@@ -1,0 +1,10 @@
+"""GL604 near miss: the registry rides with a test that arms its
+point by name (this file plays both the faults and tests roles)."""
+
+SERVE_CRASH_POINTS = (
+    "serve_before_snapshot",
+)
+
+
+def test_crash_before_snapshot(plan):
+    plan.arm("serve_before_snapshot", at=1)

@@ -1,0 +1,11 @@
+"""GL605 true positive: the tmp + fsync + rename durable publish with
+no crash point bracketing the torn-state window."""
+import json
+
+
+def publish(fs, path, doc):
+    tmp = path + ".tmp"
+    with fs.open(tmp, "w") as f:
+        f.write(json.dumps(doc))
+        fs.fsync(f)
+    fs.rename(tmp, path)

@@ -1,0 +1,12 @@
+"""GL605 near miss: the same publish with the window armed -- a chaos
+suite can kill the process between fsync and rename."""
+import json
+
+
+def publish(fs, path, doc):
+    tmp = path + ".tmp"
+    with fs.open(tmp, "w") as f:
+        f.write(json.dumps(doc))
+        fs.fsync(f)
+    fs.crashpoint("claim_tmp_before_rename")
+    fs.rename(tmp, path)

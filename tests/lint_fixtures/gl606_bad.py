@@ -1,0 +1,17 @@
+"""GL606 true positive: a refusal reply carries a hand-built numeric
+``retry_after`` outside the RETRY_AFTER_CAP/jitter path."""
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    return {
+        "ok": False,
+        "error": "server is draining",
+        "retry_after": 0.25,
+    }
+
+
+def drive(conn):
+    conn.call({"op": "ping"})

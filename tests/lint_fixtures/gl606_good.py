@@ -1,0 +1,19 @@
+"""GL606 near miss: the same refusal with the hint capped through
+RETRY_AFTER_CAP."""
+
+RETRY_AFTER_CAP = 5.0
+
+
+def _handle_request(service, req):
+    op = req.get("op")
+    if op == "ping":
+        return {"ok": True, "pong": True}
+    return {
+        "ok": False,
+        "error": "server is draining",
+        "retry_after": min(0.25, RETRY_AFTER_CAP),
+    }
+
+
+def drive(conn):
+    conn.call({"op": "ping"})

@@ -133,6 +133,13 @@ def test_bench_prints_one_json_line():
     assert d["trace_findings_total"] == 0
     assert d["trace_rules_checked"] == 7
     assert d["lockdep_inversions_observed"] == 1
+    # round-20: graftwire protocol rows -- both fronts' op surfaces
+    # checked, zero drift against the committed wire_contracts.json,
+    # and EVERY registered crash point armed by some test (the GL604
+    # no-dead-fault-windows satellite, pinned at exactly 1.0)
+    assert d["wire_ops_checked"] >= 15
+    assert d["wire_contract_drift"] == 0
+    assert d["crash_points_armed_frac"] == 1.0
     # round-10: crash-recovery cost rows -- the per-trial durability
     # overhead is measured (WAL append + amortized bundle publish) and
     # stamped both raw and relative to the fused dispatch time

@@ -354,6 +354,53 @@ def test_tcp_router_routes_and_fails_over(tmp_path):
         rsrv.server_close()
 
 
+def test_tcp_router_drain_broadcast(tmp_path):
+    """``drain`` on the router front fans out to every live backend:
+    one op quiesces the whole fleet, each backend names its own
+    (capped) comeback hint and the router reports the slowest."""
+    from hyperopt_tpu.serve.fleet import fleet_salt
+    from hyperopt_tpu.serve.router import RouterServer, _Backend
+    from hyperopt_tpu.serve.service import RETRY_AFTER_CAP, serve_forever
+
+    root = str(tmp_path / "root")
+    svcs, servers = {}, {}
+    for rid in ("b0", "b1"):
+        svc = SuggestService(
+            SPACE, root=root, owner=rid, background=True, max_batch=8,
+            n_startup_jobs=2, **ALGO_KW,
+        )
+        srv = serve_forever(svc, port=0)
+        _spawn(srv)
+        svcs[rid], servers[rid] = svc, srv
+    backends = [
+        _Backend(rid, *servers[rid].server_address[:2])
+        for rid in ("b0", "b1")
+    ]
+    router = RouterServer(backends, salt=fleet_salt("tpe", SPACE))
+    rsrv = router.serve_forever(port=0)
+    _spawn(rsrv)
+    cli = _Client(*rsrv.server_address[:2])
+    try:
+        assert cli.rpc(op="create_study", name="d0", seed=1)["ok"]
+        r = cli.rpc(op="drain", timeout=5.0)
+        assert r["ok"] and r["draining"] is True
+        assert r["replicas"] == {"b0": True, "b1": True}
+        assert 0 < r["retry_after"] <= RETRY_AFTER_CAP
+        # every backend entered draining mode from the ONE router op
+        assert all(svc.scheduler.draining for svc in svcs.values())
+    finally:
+        cli.close()
+        for rid in ("b0", "b1"):
+            try:
+                servers[rid].shutdown()
+                servers[rid].server_close()
+                svcs[rid].shutdown()
+            except Exception:
+                pass
+        rsrv.shutdown()
+        rsrv.server_close()
+
+
 # ---------------------------------------------------------------------------
 # CI/tooling satellite: the static tiers cover the new modules
 # ---------------------------------------------------------------------------
@@ -386,4 +433,5 @@ def test_fleet_crash_points_registered():
         "fleet_router_after_forward_before_ack",
         "fleet_migrate_after_snapshot_before_handoff",
         "fleet_migrate_after_handoff_before_restore",
+        "fleet_claim_tmp_before_rename",
     }

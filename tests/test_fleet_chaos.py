@@ -478,7 +478,27 @@ def test_fleet_points_registered():
         "fleet_router_after_forward_before_ack",
         "fleet_migrate_after_snapshot_before_handoff",
         "fleet_migrate_after_handoff_before_restore",
+        "fleet_claim_tmp_before_rename",
     }
+
+
+def test_claim_publish_crash_before_rename(tmp_path):
+    """fleet_claim_tmp_before_rename: the claim doc is fsynced to its
+    tmp path but the rename never lands -- the store still shows NO
+    claim, so a restarted replica's re-acquire wins cleanly and the
+    orphan ``.tmp.<pid>`` never shadows the real claim."""
+    from hyperopt_tpu.serve.fleet import StudyClaim
+
+    root = str(tmp_path / "claims")
+    plan = FaultPlan(seed=7).arm("fleet_claim_tmp_before_rename", at=1)
+    with pytest.raises(SimulatedCrash):
+        StudyClaim.acquire(root, "s00", "r0", fs=plan.fs())
+    # the rename never happened: no claim is visible at the real path
+    assert StudyClaim.read(root, "s00") is None
+    # the restarted replica (fresh process, no plan) acquires cleanly
+    claim = StudyClaim.acquire(root, "s00", "r1")
+    assert claim.is_live()
+    assert StudyClaim.read(root, "s00")["replica"] == "r1"
 
 
 # ---------------------------------------------------------------------------

@@ -65,6 +65,25 @@ def test_package_trace_clean_against_baseline(repo_cwd):
     assert result.n_files > 50  # the whole package, not a subset
 
 
+def test_package_wire_clean_against_contracts(repo_cwd):
+    # the graftwire protocol gate (hyperopt-tpu-lint --wire): the wire
+    # surfaces must match the committed wire_contracts.json, every
+    # ServeError subclass must be mapped at the client seam, and EVERY
+    # registered crash point must be armed by some test -- with zero
+    # grandfathered findings
+    from hyperopt_tpu.analysis.wire import check_wire
+
+    baseline = load_baseline(BASELINE)
+    t0 = time.perf_counter()
+    result = check_wire(baseline=baseline)
+    elapsed = time.perf_counter() - t0
+    assert result.clean, result.findings
+    assert elapsed < 5.0, f"wire lint took {elapsed:.2f}s (budget 5s)"
+    assert result.ops_checked >= 15  # both fronts, not a subset
+    assert result.crash_points_total > 0
+    assert result.crash_points_armed == result.crash_points_total
+
+
 def test_baseline_is_small_and_shrinking(repo_cwd):
     baseline = load_baseline(BASELINE)
     assert sum(baseline.values()) <= MAX_BASELINE_ENTRIES, (

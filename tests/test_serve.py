@@ -551,6 +551,15 @@ def test_socket_transport_roundtrip():
             assert not rpc(op="ask", study="nope")["ok"]
             assert not rpc(op="frobnicate")["ok"]
             assert rpc(op="close_study", study="demo")["ok"]
+            # the migration wire op: handoff evicts the local handle,
+            # so a follow-up ask is a typed UnknownStudy -- the
+            # router's cue to lazily re-adopt on the ring owner
+            assert rpc(op="create_study", name="mig", seed=5)["ok"]
+            ho = rpc(op="handoff_study", study="mig")
+            assert ho["ok"] and ho["handed_off"] == "mig"
+            gone = rpc(op="ask", study="mig")
+            assert not gone["ok"]
+            assert gone["error_type"] == "UnknownStudy"
     finally:
         server.shutdown()
         server.server_close()

@@ -1,0 +1,214 @@
+"""graftwire (GL6xx) rule-level pins: a bad/good fixture pair per rule
+with exact finding counts, pragma-suppression counting, the CLI pack
+selection/exit contract, and the three zero-test-execution mutation
+kill-checks over the REAL repo sources.
+
+The fixtures are single-file miniature universes fed straight to
+:func:`~hyperopt_tpu.analysis.wire.analyze` wearing whatever role hats
+the rule needs (server, client, seam, faults, durable, tests); the
+mutation checks feed :func:`check_wire` the real files with ONE seam
+textually broken and assert the named finding appears -- no server is
+started, no test is executed."""
+
+import json
+import os
+
+import pytest
+
+from hyperopt_tpu.analysis.wire import analyze, check_wire
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+
+def _read(name):
+    with open(os.path.join(FIXTURES, name)) as f:
+        return f.read()
+
+
+def _real(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def _roles_for(rule, src, kind):
+    """The role hats each fixture universe wears.  GL604's good twin
+    doubles as its own arming test; its bad twin deliberately ships
+    with NO test evidence (that absence IS the finding)."""
+    path = "fixture.py"
+    if rule == "GL603":
+        return {"exceptions": {path: src}, "reply_seam": {path: src}}
+    if rule == "GL604":
+        roles = {"faults": {path: src}}
+        if kind == "good":
+            roles["tests"] = {"test_fixture.py": src}
+        return roles
+    if rule == "GL605":
+        return {"durable": {path: src}}
+    return {"server": {path: src}, "clients": {path: src}}
+
+
+# rule -> exact finding count its bad fixture must trip (GL602 needs a
+# two-step manifest build and has its own test below)
+EXPECTED_COUNTS = {
+    "GL601": 3,
+    "GL603": 1,
+    "GL604": 2,
+    "GL605": 1,
+    "GL606": 1,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_COUNTS))
+def test_bad_fixture_trips_exactly_its_rule(rule):
+    src = _read(f"{rule.lower()}_bad.py")
+    findings, _, _ = analyze(**_roles_for(rule, src, "bad"))
+    assert [f.rule for f in findings] == [rule] * EXPECTED_COUNTS[rule], (
+        findings
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(EXPECTED_COUNTS) + ["GL602"])
+def test_good_fixture_is_clean(rule):
+    src = _read(f"{rule.lower()}_good.py")
+    findings, _, _ = analyze(**_roles_for(rule, src, "good"))
+    assert findings == [], findings
+
+
+def test_gl601_names_each_asymmetry():
+    findings, _, _ = analyze(
+        **_roles_for("GL601", _read("gl601_bad.py"), "bad")
+    )
+    msgs = " | ".join(f.message for f in findings)
+    assert "'frobnicate'" in msgs  # client op nothing handles
+    assert "no client or test caller" in msgs  # handler nothing calls
+    assert "not by the router front" in msgs  # global-op asymmetry
+
+
+def test_gl602_contract_drift_pair():
+    """Drift is measured against a manifest pinned from the GOOD twin:
+    the bad twin renames ask's ``vals`` field and drops the ``best``
+    arm the manifest still pins (a stale row) -- both field-level."""
+    good = _roles_for("GL602", _read("gl602_good.py"), "good")
+    bad = _roles_for("GL602", _read("gl602_bad.py"), "bad")
+    _, _, contracts = analyze(**good)
+    findings, stats, _ = analyze(contracts=contracts, **good)
+    assert findings == [] and stats["contract_drift"] == 0
+    findings, stats, _ = analyze(contracts=contracts, **bad)
+    assert [f.rule for f in findings] == ["GL602", "GL602"], findings
+    msgs = " | ".join(f.message for f in findings)
+    assert "'ask'" in msgs and "'vals'" in msgs and "'values'" in msgs
+    assert "'best'" in msgs and "no longer dispatches" in msgs
+    assert stats["contract_drift"] == 2
+
+
+def test_pragma_suppresses_wire_findings():
+    src = _read("gl606_bad.py").replace(
+        "def _handle_request(service, req):",
+        "def _handle_request(service, req):  "
+        "# graftlint: disable=GL606 fixture-only refusal hint",
+    )
+    findings, stats, _ = analyze(
+        server={"fixture.py": src}, clients={"fixture.py": src}
+    )
+    assert findings == []
+    assert stats["n_suppressed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# mutation kill-checks: break ONE real seam textually, run only the
+# static checker, and the named finding must appear -- zero test
+# execution, the whole point of the pack
+# ---------------------------------------------------------------------------
+
+
+def test_mutation_deleted_tell_handler_trips_gl601():
+    rel = "hyperopt_tpu/serve/service.py"
+    src = _real(rel)
+    mutant = src.replace('if op == "tell":', 'if op == "tell_disabled":')
+    assert mutant != src
+    res = check_wire(root=REPO, sources={rel: mutant})
+    hits = [
+        f for f in res.findings
+        if f.rule == "GL601" and "'tell'" in f.message
+    ]
+    assert hits, res.findings
+
+
+def test_mutation_renamed_reply_field_trips_gl602():
+    rel = "hyperopt_tpu/serve/service.py"
+    src = _real(rel)
+    mutant = src.replace(
+        'return {"ok": True, "tid": tid, "vals": vals}',
+        'return {"ok": True, "tid": tid, "values": vals}',
+    )
+    assert mutant != src
+    res = check_wire(root=REPO, sources={rel: mutant})
+    hits = [
+        f for f in res.findings
+        if f.rule == "GL602" and "'ask'" in f.message
+        and "'vals'" in f.message and "'values'" in f.message
+    ]
+    assert hits, res.findings
+
+
+def test_mutation_dropped_reply_error_trips_gl603():
+    rel = "hyperopt_tpu/client.py"
+    src = _real(rel)
+    mutant = src.replace('    "StudyPoisoned": StudyPoisoned,\n', '')
+    assert mutant != src
+    res = check_wire(root=REPO, sources={rel: mutant})
+    hits = [
+        f for f in res.findings
+        if f.rule == "GL603" and "StudyPoisoned" in f.message
+    ]
+    assert hits, res.findings
+
+
+def test_unmutated_repo_is_wire_clean():
+    res = check_wire(root=REPO)
+    assert res.clean, res.findings
+    assert res.crash_points_total > 0
+    assert res.crash_points_armed == res.crash_points_total
+
+
+# ---------------------------------------------------------------------------
+# the CLI contract: pack selection, exit codes, cwd-independence
+# ---------------------------------------------------------------------------
+
+
+def test_cli_wire_exit_codes(tmp_path, monkeypatch, capsys):
+    from hyperopt_tpu.analysis import wire as wire_mod
+    from hyperopt_tpu.analysis.cli import main
+
+    monkeypatch.chdir(REPO)
+    assert main(["--wire"]) == 0
+    assert main(["--ir", "--wire"]) == 2
+    assert main(["--trace", "--wire"]) == 2
+    assert main(["--update-contracts"]) == 2  # needs --ir or --wire
+    capsys.readouterr()
+    # an unreadable manifest is a usage error, never a traceback
+    garbage = tmp_path / "wire_contracts.json"
+    garbage.write_text("{not json")
+    assert main(["--wire", "--contracts", str(garbage)]) == 2
+    # a drifted manifest is findings
+    payload = wire_mod.load_contracts(
+        os.path.join(REPO, "wire_contracts.json")
+    )
+    payload["fronts"]["service"]["ask"] = ["ok"]
+    drifted = tmp_path / "drifted.json"
+    drifted.write_text(json.dumps(payload))
+    assert main(["--wire", "--contracts", str(drifted)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_wire_findings_identical_from_any_cwd(monkeypatch, capsys):
+    from hyperopt_tpu.analysis.cli import main
+
+    monkeypatch.chdir(REPO)
+    assert main(["--wire", "--format", "json"]) == 0
+    here = json.loads(capsys.readouterr().out)
+    monkeypatch.chdir("/")
+    assert main(["--wire", "--format", "json", "--root", REPO]) == 0
+    there = json.loads(capsys.readouterr().out)
+    assert here == there
